@@ -1,0 +1,194 @@
+"""Tier-1 unit tests: the analog of the reference's in-library unittest
+registry (src/rdunittest.c) run as test 0000 — bit-exactness golden vectors
+for exactly the layers the TPU offload must keep bit-exact (SURVEY.md §4).
+"""
+import struct
+
+import pytest
+
+from librdkafka_tpu.utils import varint
+from librdkafka_tpu.utils.buf import BufUnderflow, SegBuf, Slice
+from librdkafka_tpu.utils.crc import (crc32, crc32_combine, crc32c,
+                                      crc32c_combine)
+from librdkafka_tpu.utils.hash import (consistent_partition, murmur2,
+                                       murmur2_partition)
+
+
+# ---------------------------------------------------------------- varint ---
+class TestVarint:
+    @pytest.mark.parametrize("v,enc", [
+        (0, b"\x00"), (-1, b"\x01"), (1, b"\x02"), (-2, b"\x03"),
+        (63, b"\x7e"), (64, b"\x80\x01"), (-64, b"\x7f"),
+        (2147483647, b"\xfe\xff\xff\xff\x0f"),
+        (-2147483648, b"\xff\xff\xff\xff\x0f"),
+    ])
+    def test_zigzag_golden(self, v, enc):
+        assert varint.enc_i64(v) == enc
+        assert varint.dec_i64(enc) == (v, len(enc))
+        assert varint.size_i64(v) == len(enc)
+
+    def test_roundtrip_sweep(self):
+        # the same sweep idea as unittest_rdvarint (rdvarint.c:107)
+        for v in [0, 1, -1, 127, 128, -128, 1000, -1000, 2 ** 31, -2 ** 31,
+                  2 ** 62, -(2 ** 62), 2 ** 63 - 1, -(2 ** 63)]:
+            enc = varint.enc_i64(v)
+            assert varint.dec_i64(enc)[0] == v
+
+    def test_truncated_raises(self):
+        with pytest.raises(ValueError):
+            varint.dec_u64(b"\x80\x80")
+
+
+# ---------------------------------------------------------------- crc32c ---
+class TestCrc32c:
+    # RFC 3720 §B.4 vectors — the same set the reference checks in
+    # crc32c.c:388 (unit test).
+    def test_rfc3720_vectors(self):
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"\xff" * 32) == 0x62A8AB43
+        assert crc32c(bytes(range(32))) == 0x46DD794E
+        assert crc32c(bytes(range(31, -1, -1))) == 0x113FDB5C
+
+    def test_check_string(self):
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_incremental_equals_oneshot(self):
+        data = bytes(range(256)) * 7 + b"tail"
+        whole = crc32c(data)
+        inc = 0
+        for i in range(0, len(data), 37):
+            inc = crc32c(data[i:i + 37], inc)
+        assert inc == whole
+
+    def test_combine(self):
+        data = b"The quick brown fox jumps over the lazy dog" * 13
+        for split in [0, 1, 7, 64, len(data) - 1, len(data)]:
+            a, b = data[:split], data[split:]
+            assert crc32c_combine(crc32c(a), crc32c(b), len(b)) == crc32c(data)
+
+    def test_combine_tree(self):
+        # associative chunk combine — the TPU parallel-CRC primitive
+        import numpy as np
+        rng = np.random.default_rng(42)
+        data = rng.integers(0, 256, size=1 << 14, dtype=np.uint8).tobytes()
+        chunk = 1 << 10
+        crcs = [crc32c(data[i:i + chunk]) for i in range(0, len(data), chunk)]
+        acc = crcs[0]
+        for c in crcs[1:]:
+            acc = crc32c_combine(acc, c, chunk)
+        assert acc == crc32c(data)
+
+    def test_crc32_zlib_combine(self):
+        data = b"hello world, this is crc32" * 9
+        a, b = data[:17], data[17:]
+        assert crc32_combine(crc32(a), crc32(b), len(b)) == crc32(data)
+
+
+# --------------------------------------------------------------- murmur2 ---
+class TestMurmur2:
+    # Golden values from Apache Kafka's Utils.murmur2 (Java) — the
+    # compatibility contract checked by rdmurmur2.c:115 and
+    # tests/java/Murmur2Cli.java in the reference.
+    @pytest.mark.parametrize("key,signed_val", [
+        (b"21", -973932308),
+        (b"foobar", -790332482),
+        (b"a-little-bit-long-string", -985981536),
+        (b"a-little-bit-longer-string", -1486304829),
+        (b"lkjh234lh9fiuh90y23oiuhsafujhadof229phr9h19h89h8", -58897971),
+        (b"", 275646681),
+    ])
+    def test_java_golden(self, key, signed_val):
+        assert murmur2(key) == signed_val & 0xFFFFFFFF
+
+    def test_partitioner_positive(self):
+        for key in [b"21", b"foobar", b"x" * 100]:
+            p = murmur2_partition(key, 48)
+            assert 0 <= p < 48
+
+    def test_consistent(self):
+        assert consistent_partition(b"somekey", 7) == crc32(b"somekey") % 7
+
+
+# ------------------------------------------------------------------ buf ----
+class TestSegBuf:
+    def test_write_and_read(self):
+        b = SegBuf()
+        b.write(b"hello ")
+        b.write(b"world")
+        assert len(b) == 11
+        assert b.as_bytes() == b"hello world"
+
+    def test_backpatch_across_segments(self):
+        b = SegBuf()
+        p = b.write_i32(0)
+        b.push_ro(b"RO-SEGMENT")
+        b.write(b"tail")
+        b.update_i32(p, 0x01020304)
+        assert b.as_bytes()[:4] == b"\x01\x02\x03\x04"
+        # patch spanning the ro segment forces copy-on-write
+        b.write_update(2, b"\xaa\xbb\xcc\xdd")
+        assert b.as_bytes()[2:6] == b"\xaa\xbb\xcc\xdd"
+
+    def test_write_seek_rewind(self):
+        b = SegBuf()
+        b.write(b"0123456789")
+        b.push_ro(b"ABCDEF")
+        b.write_seek(12)   # keep "0123456789AB"
+        assert b.as_bytes() == b"0123456789AB"
+        b.write(b"xy")
+        assert b.as_bytes() == b"0123456789ABxy"
+        b.write_seek(0)
+        assert b.as_bytes() == b""
+
+    def test_splice_compressed_pattern(self):
+        # the writer_compress pattern: rewind over uncompressed records and
+        # splice the compressed blob as a read-only segment
+        # (rdkafka_msgset_writer.c:1191-1203)
+        b = SegBuf()
+        hdr = b.write(b"HDR-")
+        body_start = b.write(b"uncompressed-records-uncompressed-records")
+        comp = b"COMPRESSED"
+        b.write_seek(body_start)
+        b.push_ro(comp)
+        assert b.as_bytes() == b"HDR-COMPRESSED"
+        assert hdr == 0
+
+    def test_crc_over_region(self):
+        b = SegBuf()
+        b.write(b"aaa")
+        b.push_ro(b"bbbb")
+        b.write(b"cc")
+        assert b.crc32c(3, 7) == crc32c(b"bbbb")
+
+    def test_iovecs(self):
+        b = SegBuf()
+        b.write(b"one")
+        b.push_ro(b"two")
+        vs = b.iovecs()
+        assert b"".join(bytes(v) for v in vs) == b"onetwo"
+
+
+class TestSlice:
+    def test_reads(self):
+        s = Slice(struct.pack(">bhiq", -1, 2, 3, 4) + b"\x06tail")
+        assert s.read_i8() == -1
+        assert s.read_i16() == 2
+        assert s.read_i32() == 3
+        assert s.read_i64() == 4
+        assert s.read_varint() == 3
+        assert s.read(4) == b"tail"
+
+    def test_underflow(self):
+        s = Slice(b"\x00\x01")
+        with pytest.raises(BufUnderflow):
+            s.read_i32()
+        assert s.remains() == 2  # failed read consumes nothing
+
+    def test_narrow(self):
+        s = Slice(b"AABBBCC")
+        s.skip(2)
+        sub = s.narrow(3)
+        assert sub.read(3) == b"BBB"
+        with pytest.raises(BufUnderflow):
+            sub.read(1)
+        assert s.read(2) == b"CC"
