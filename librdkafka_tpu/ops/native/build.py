@@ -1,0 +1,25 @@
+"""Build the native codec library (g++ → _codec.so), cached by mtime."""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "codec.cpp")
+SO = os.path.join(_DIR, "_codec.so")
+_lock = threading.Lock()
+
+
+def build(force: bool = False) -> str:
+    """Compile codec.cpp to a shared library if stale; returns the .so path."""
+    with _lock:
+        if (not force and os.path.exists(SO)
+                and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
+            return SO
+        tmp = SO + ".tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               "-fvisibility=hidden", "-o", tmp, SRC]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, SO)
+        return SO

@@ -1,0 +1,198 @@
+"""Codec tests — the analog of the reference's 0017-compression.c plus
+format-conformance oracles: our own LZ4/snappy *encoders* must produce
+streams that the real liblz4/libsnappy system libraries decode to the
+original input (proving spec compliance, not just self-consistency).
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from librdkafka_tpu.ops import cpu
+
+# ---------------------------------------------------------------- corpora --
+
+def corpora():
+    rng = np.random.default_rng(7)
+    out = {
+        "empty": b"",
+        "one": b"x",
+        "short": b"hello snappy/lz4 world",
+        "zeros_1k": b"\x00" * 1024,
+        "zeros_200k": b"\x00" * 200_000,
+        "ascii_rep": b"the quick brown fox jumps over the lazy dog. " * 500,
+        "json_like": (b'{"user_id": 12345, "event": "click", "ts": 1690000000}\n'
+                      * 2000),
+        "random_1k": rng.integers(0, 256, 1024, dtype=np.uint8).tobytes(),
+        "random_100k": rng.integers(0, 256, 100_000, dtype=np.uint8).tobytes(),
+        "semi": b"".join(b"msg-%06d:" % i + bytes(rng.integers(0, 4, 32,
+                        dtype=np.uint8) + 97) for i in range(2000)),
+        "edge_12": b"abcdabcdabcd",
+        "edge_13": b"abcdabcdabcda",
+        "near_64k": b"ab" * 32767 + b"xyz",       # straddles one frame block
+        "over_64k": b"pattern-" * 20000,          # multi-block frame
+    }
+    return out
+
+
+CORPORA = corpora()
+IDS = list(CORPORA)
+
+
+# ----------------------------------------------------------- self round-trip
+@pytest.mark.parametrize("name", IDS)
+@pytest.mark.parametrize("codec", ["gzip", "snappy", "lz4", "zstd"])
+def test_roundtrip(codec, name):
+    data = CORPORA[name]
+    comp, dec = cpu.CODECS[codec]
+    assert dec(comp(data), len(data)) == data
+
+
+def test_compresses_compressible():
+    z = CORPORA["zeros_200k"]
+    # match length is capped (MAXMATCH) by the shared TPU-greedy spec, so
+    # ratios are bounded: lz4 ~45x on zeros, snappy (64-byte copies) ~20x
+    assert len(cpu.lz4_compress(z)) < len(z) // 40
+    assert len(cpu.snappy_compress(z)) < len(z) // 15
+
+
+def test_incompressible_not_expanded_much():
+    r = CORPORA["random_100k"]
+    assert len(cpu.lz4_compress(r)) < len(r) + 1024  # raw-block fallback
+
+
+# ------------------------------------------------------------ lz4 oracle ---
+_LZ4SO = "/lib/x86_64-linux-gnu/liblz4.so.1"
+
+
+@pytest.fixture(scope="module")
+def lz4lib():
+    if not os.path.exists(_LZ4SO):
+        pytest.skip("no system liblz4 oracle")
+    L = ctypes.CDLL(_LZ4SO)
+    L.LZ4_decompress_safe.restype = ctypes.c_int
+    L.LZ4_decompress_safe.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_int, ctypes.c_int]
+    return L
+
+
+@pytest.mark.parametrize("name", IDS)
+def test_lz4_block_vs_system_decoder(lz4lib, name):
+    data = CORPORA[name]
+    if len(data) > 65536:
+        data = data[:65536]  # block API is per-64KB-block
+    comp = cpu.lz4_block_compress(data)
+    dst = ctypes.create_string_buffer(max(len(data), 1))
+    r = lz4lib.LZ4_decompress_safe(comp, dst, len(comp), len(data))
+    assert r == len(data)
+    assert dst.raw[:r] == data
+
+
+@pytest.mark.parametrize("name", IDS)
+def test_lz4_frame_vs_system_decoder(lz4lib, name):
+    data = CORPORA[name]
+    comp = cpu.lz4_compress(data)
+    # LZ4F streaming decode via the real library
+    ctx = ctypes.c_void_p()
+    ver = lz4lib.LZ4F_getVersion()
+    err = lz4lib.LZ4F_createDecompressionContext(ctypes.byref(ctx), ver)
+    assert err == 0
+    try:
+        dst = ctypes.create_string_buffer(max(len(data), 1))
+        src = ctypes.create_string_buffer(comp, len(comp))
+        dst_sz = ctypes.c_size_t(len(data))
+        src_sz = ctypes.c_size_t(len(comp))
+        lz4lib.LZ4F_decompress.restype = ctypes.c_size_t
+        rc = lz4lib.LZ4F_decompress(ctx, dst, ctypes.byref(dst_sz),
+                                    src, ctypes.byref(src_sz), None)
+        assert rc == 0, f"LZ4F_decompress hint/err={rc}"
+        assert src_sz.value == len(comp)
+        assert dst.raw[:dst_sz.value] == data
+    finally:
+        lz4lib.LZ4F_freeDecompressionContext(ctx)
+
+
+def test_lz4_frame_decode_foreign(lz4lib):
+    """Our decoder must read frames produced by the real liblz4 too."""
+    data = CORPORA["json_like"]
+    bound_fn = lz4lib.LZ4F_compressFrameBound
+    bound_fn.restype = ctypes.c_size_t
+    bound_fn.argtypes = [ctypes.c_size_t, ctypes.c_void_p]
+    cap = bound_fn(len(data), None)
+    dst = ctypes.create_string_buffer(cap)
+    cf = lz4lib.LZ4F_compressFrame
+    cf.restype = ctypes.c_size_t
+    cf.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                   ctypes.c_size_t, ctypes.c_void_p]
+    n = cf(dst, cap, data, len(data), None)
+    assert not lz4lib.LZ4F_isError(n)
+    assert cpu.lz4_decompress(dst.raw[:n], len(data)) == data
+
+
+# --------------------------------------------------------- snappy oracle ---
+_SNSO = "/lib/x86_64-linux-gnu/libsnappy.so.1"
+
+
+@pytest.fixture(scope="module")
+def snlib():
+    if not os.path.exists(_SNSO):
+        pytest.skip("no system libsnappy oracle")
+    L = ctypes.CDLL(_SNSO)
+    L.snappy_uncompress.restype = ctypes.c_int
+    L.snappy_uncompress.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                    ctypes.c_char_p,
+                                    ctypes.POINTER(ctypes.c_size_t)]
+    return L
+
+
+@pytest.mark.parametrize("name", IDS)
+def test_snappy_vs_system_decoder(snlib, name):
+    data = CORPORA[name]
+    comp = cpu.snappy_compress(data)
+    out_len = ctypes.c_size_t(max(len(data), 1))
+    dst = ctypes.create_string_buffer(out_len.value)
+    rc = snlib.snappy_uncompress(comp, len(comp), dst, ctypes.byref(out_len))
+    assert rc == 0  # SNAPPY_OK
+    assert out_len.value == len(data)
+    assert dst.raw[:len(data)] == data
+
+
+def test_snappy_decode_foreign(snlib):
+    data = CORPORA["semi"]
+    snlib.snappy_max_compressed_length.restype = ctypes.c_size_t
+    cap = snlib.snappy_max_compressed_length(ctypes.c_size_t(len(data)))
+    dst = ctypes.create_string_buffer(cap)
+    out_len = ctypes.c_size_t(cap)
+    rc = snlib.snappy_compress(data, len(data), dst, ctypes.byref(out_len))
+    assert rc == 0
+    assert cpu.snappy_decompress(dst.raw[:out_len.value]) == data
+
+
+def test_snappy_java_framing():
+    data = CORPORA["ascii_rep"]
+    import struct
+    body = cpu.snappy_compress(data)
+    framed = (cpu.SNAPPY_JAVA_MAGIC + struct.pack(">ii", 1, 1)
+              + struct.pack(">i", len(body)) + body)
+    assert cpu.snappy_java_decompress(framed) == data
+
+
+# ---------------------------------------------------------------- native ---
+def test_native_crc32c_matches_python():
+    from librdkafka_tpu.utils.crc import crc32c as py_crc32c
+    for name, data in CORPORA.items():
+        assert cpu.crc32c(data) == py_crc32c(data), name
+    assert cpu.crc32c(b"123456789") == 0xE3069283
+
+
+def test_crc32c_many():
+    bufs = [CORPORA["short"], b"", CORPORA["random_1k"], CORPORA["zeros_1k"]]
+    out = cpu.crc32c_many(bufs)
+    assert list(out) == [cpu.crc32c(b) for b in bufs]
+
+
+def test_xxh32_known_vectors():
+    # public xxHash reference vectors
+    assert cpu.xxh32(b"", 0) == 0x02CC5D05
+    assert cpu.xxh32(b"Hello World", 0) == 0xB1FD16EE
