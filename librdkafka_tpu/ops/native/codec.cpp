@@ -174,8 +174,11 @@ EXPORT int64_t tk_lz4_block_compress(const uint8_t *src, int64_t n,
 
 // ------------------------------------------------------- LZ4 block decode --
 
-EXPORT int64_t tk_lz4_block_decompress(const uint8_t *src, int64_t n,
-                                       uint8_t *dst, int64_t cap) {
+// hist = decoded bytes present before dst (for linked-block frames whose
+// matches reach into previous blocks).
+static int64_t lz4_block_decompress_hist(const uint8_t *src, int64_t n,
+                                         uint8_t *dst, int64_t cap,
+                                         int64_t hist) {
     int64_t i = 0, o = 0;
     while (i < n) {
         uint8_t tok = src[i++];
@@ -189,7 +192,7 @@ EXPORT int64_t tk_lz4_block_decompress(const uint8_t *src, int64_t n,
         if (i == n) break;            // last sequence: literals only
         if (i + 2 > n) return -1;
         int64_t off = rd16le(src + i); i += 2;
-        if (off == 0 || off > o) return -1;
+        if (off == 0 || off > o + hist) return -1;
         int64_t mlen = (tok & 0x0F) + 4;
         if ((tok & 0x0F) == 15) {
             uint8_t b;
@@ -201,6 +204,11 @@ EXPORT int64_t tk_lz4_block_decompress(const uint8_t *src, int64_t n,
         o += mlen;
     }
     return o;
+}
+
+EXPORT int64_t tk_lz4_block_decompress(const uint8_t *src, int64_t n,
+                                       uint8_t *dst, int64_t cap) {
+    return lz4_block_decompress_hist(src, n, dst, cap, 0);
 }
 
 // ------------------------------------------------------------- LZ4 frame --
@@ -278,7 +286,8 @@ EXPORT int64_t tk_lz4f_decompress(const uint8_t *src, int64_t n,
             if (o + bsz > cap) return -4;
             memcpy(dst + o, src + i, bsz); o += bsz;
         } else {
-            int64_t dsz = tk_lz4_block_decompress(src + i, bsz, dst + o, cap - o);
+            int64_t dsz = lz4_block_decompress_hist(src + i, bsz, dst + o,
+                                                    cap - o, o);
             if (dsz < 0) return -5;
             o += dsz;
         }
