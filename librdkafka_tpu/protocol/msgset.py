@@ -1,0 +1,375 @@
+"""MessageSet (RecordBatch) v2 writer + reader, plus legacy v0/v1.
+
+This is the north-star seam (SURVEY.md §3.2): the reference builds each
+partition batch in rd_kafka_msgset_create_ProduceRequest
+(src/rdkafka_msgset_writer.c:1418) — write header, write records, compress
+(writer_compress :1129), rewind + splice the compressed segment
+(:1191-1203), then finalize by back-patching the v2 header and computing
+CRC32C over [Attributes..end] (:1252,1230). The consumer side parses and
+verifies in rd_kafka_msgset_reader.c (:950-1016, decompress :258-530).
+
+The writer here is deliberately split into three phases so that *many*
+partition batches can be compressed/checksummed in ONE batched codec-
+provider call (the TPU offload axis):
+
+    w = MsgsetWriterV2(...); w.build(msgs)       # phase 1: frame records
+    blobs = provider.compress_many(codec, [w.records_bytes ...])
+    wire = w.finalize(compressed=blob)           # phase 3: splice + CRC
+
+``finalize(None)`` is the uncompressed path. Single-shot ``write_batch()``
+wraps all three for the simple case.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+from ..utils import varint
+from ..utils.buf import SegBuf, Slice
+from ..utils.crc import crc32, crc32c
+from . import proto
+from .proto import (ATTR_CODEC_MASK, ATTR_CONTROL, ATTR_TRANSACTIONAL,
+                    CODEC_IDS, CODEC_NAMES)
+
+
+@dataclass
+class Record:
+    """A parsed (or to-be-written) record."""
+    key: Optional[bytes] = None
+    value: Optional[bytes] = None
+    headers: Sequence[tuple[str, Optional[bytes]]] = ()
+    timestamp: int = -1          # ms since epoch; -1 = now/unset
+    offset: int = -1             # absolute offset (reader fills this)
+    # batch-level context the reader attaches:
+    msgver: int = 2
+    is_control: bool = False
+    is_transactional: bool = False
+    producer_id: int = -1
+    timestamp_type: int = proto.TSTYPE_CREATE_TIME
+
+
+# ===================================================================== v2 ==
+
+class MsgsetWriterV2:
+    """RecordBatch v2 writer with deferred compression/CRC."""
+
+    def __init__(self, *, base_offset: int = 0, producer_id: int = -1,
+                 producer_epoch: int = -1, base_sequence: int = -1,
+                 transactional: bool = False, codec: Optional[str] = None,
+                 timestamp_type: int = proto.TSTYPE_CREATE_TIME):
+        self.base_offset = base_offset
+        self.producer_id = producer_id
+        self.producer_epoch = producer_epoch
+        self.base_sequence = base_sequence
+        self.transactional = transactional
+        self.codec = None if codec in (None, "none") else codec
+        self.timestamp_type = timestamp_type
+        self.records_bytes: bytes = b""
+        self.record_count = 0
+        self.first_timestamp = -1
+        self.max_timestamp = -1
+
+    # -- phase 1: frame records (uncompressed) ---------------------------
+    def build(self, msgs: Iterable[Record], now_ms: int) -> "MsgsetWriterV2":
+        rb = SegBuf()
+        count = 0
+        first_ts = -1
+        max_ts = -1
+        for i, m in enumerate(msgs):
+            ts = m.timestamp if m.timestamp and m.timestamp > 0 else now_ms
+            if first_ts < 0:
+                first_ts = ts
+            if ts > max_ts:
+                max_ts = ts
+            self._write_record(rb, m, i, ts - first_ts)
+            count += 1
+        if count == 0:
+            raise ValueError("empty batch")
+        self.records_bytes = rb.as_bytes()
+        self.record_count = count
+        self.first_timestamp = first_ts
+        self.max_timestamp = max_ts
+        return self
+
+    @staticmethod
+    def _write_record(rb: SegBuf, m: Record, offset_delta: int,
+                      ts_delta: int) -> None:
+        body = SegBuf()
+        body.write_i8(0)                      # record attributes (unused)
+        body.write_varint(ts_delta)
+        body.write_varint(offset_delta)
+        if m.key is None:
+            body.write_varint(-1)
+        else:
+            body.write_varint(len(m.key))
+            body.write(m.key)
+        if m.value is None:
+            body.write_varint(-1)
+        else:
+            body.write_varint(len(m.value))
+            body.write(m.value)
+        hdrs = m.headers or ()
+        body.write_varint(len(hdrs))
+        for hk, hv in hdrs:
+            hkb = hk.encode() if isinstance(hk, str) else hk
+            body.write_varint(len(hkb))
+            body.write(hkb)
+            if hv is None:
+                body.write_varint(-1)
+            else:
+                body.write_varint(len(hv))
+                body.write(hv)
+        rb.write_varint(len(body))
+        rb.write(body.as_bytes())
+
+    # -- phase 3: assemble header + (compressed) records, patch CRC ------
+    def finalize(self, compressed: Optional[bytes] = None) -> bytes:
+        """Return the wire RecordBatch. ``compressed`` is the codec output
+        for ``records_bytes`` (None = write uncompressed)."""
+        attrs = 0
+        if compressed is not None:
+            assert self.codec, "compressed bytes supplied without codec"
+            attrs |= CODEC_IDS[self.codec]
+        if self.timestamp_type == proto.TSTYPE_LOG_APPEND_TIME:
+            attrs |= proto.ATTR_TIMESTAMP_TYPE
+        if self.transactional:
+            attrs |= ATTR_TRANSACTIONAL
+
+        payload = compressed if compressed is not None else self.records_bytes
+
+        buf = SegBuf()
+        buf.write_i64(self.base_offset)                  # BaseOffset
+        len_pos = buf.write_i32(0)                       # Length (patched)
+        buf.write_i32(-1)                                # PartitionLeaderEpoch
+        buf.write_i8(2)                                  # Magic
+        crc_pos = buf.write_u32(0)                       # CRC (patched)
+        crc_start = buf.write_i16(attrs)                 # Attributes
+        buf.write_i32(self.record_count - 1)             # LastOffsetDelta
+        buf.write_i64(self.first_timestamp)
+        buf.write_i64(self.max_timestamp)
+        buf.write_i64(self.producer_id)
+        buf.write_i16(self.producer_epoch)
+        buf.write_i32(self.base_sequence)
+        buf.write_i32(self.record_count)
+        buf.push_ro(payload)                             # splice, zero-copy
+        buf.update_i32(len_pos, len(buf) - (proto.V2_OF_Length + 4))
+        buf.update_u32(crc_pos, buf.crc32c(crc_start))
+        return buf.as_bytes()
+
+    def write_batch(self, msgs, now_ms: int, compress_fn=None) -> bytes:
+        """One-shot build+compress+finalize (CPU path convenience)."""
+        self.build(msgs, now_ms)
+        comp = None
+        if self.codec and compress_fn is not None:
+            c = compress_fn(self.records_bytes)
+            if len(c) < len(self.records_bytes):  # only keep if smaller
+                comp = c
+            else:
+                self.codec = None
+        return self.finalize(comp)
+
+
+@dataclass
+class BatchInfo:
+    """Parsed RecordBatch header (reader side)."""
+    base_offset: int
+    length: int
+    magic: int
+    crc: int
+    attrs: int
+    last_offset_delta: int
+    first_timestamp: int
+    max_timestamp: int
+    producer_id: int
+    producer_epoch: int
+    base_sequence: int
+    record_count: int
+    codec: Optional[str]
+    is_transactional: bool
+    is_control: bool
+
+
+class CrcMismatch(Exception):
+    pass
+
+
+def read_batch_header(sl: Slice) -> BatchInfo:
+    base_offset = sl.read_i64()
+    length = sl.read_i32()
+    sl.read_i32()                 # partition leader epoch
+    magic = sl.read_i8()
+    if magic != 2:
+        raise ValueError(f"not a v2 batch (magic={magic})")
+    crc = sl.read_u32()
+    attrs = sl.read_i16()
+    last_delta = sl.read_i32()
+    first_ts = sl.read_i64()
+    max_ts = sl.read_i64()
+    pid = sl.read_i64()
+    epoch = sl.read_i16()
+    base_seq = sl.read_i32()
+    count = sl.read_i32()
+    return BatchInfo(
+        base_offset=base_offset, length=length, magic=magic, crc=crc,
+        attrs=attrs, last_offset_delta=last_delta, first_timestamp=first_ts,
+        max_timestamp=max_ts, producer_id=pid, producer_epoch=epoch,
+        base_sequence=base_seq, record_count=count,
+        codec=CODEC_NAMES.get(attrs & ATTR_CODEC_MASK),
+        is_transactional=bool(attrs & ATTR_TRANSACTIONAL),
+        is_control=bool(attrs & ATTR_CONTROL))
+
+
+def parse_records_v2(info: BatchInfo, records_bytes: bytes) -> list[Record]:
+    """Parse the (decompressed) records section of a v2 batch."""
+    sl = Slice(records_bytes)
+    tstype = (proto.TSTYPE_LOG_APPEND_TIME
+              if info.attrs & proto.ATTR_TIMESTAMP_TYPE
+              else proto.TSTYPE_CREATE_TIME)
+    out = []
+    for _ in range(info.record_count):
+        rec_len = sl.read_varint()
+        rsl = sl.narrow(rec_len)
+        rsl.read_i8()                       # record attributes
+        ts_delta = rsl.read_varint()
+        off_delta = rsl.read_varint()
+        klen = rsl.read_varint()
+        key = None if klen < 0 else rsl.read(klen)
+        vlen = rsl.read_varint()
+        value = None if vlen < 0 else rsl.read(vlen)
+        nh = rsl.read_varint()
+        headers = []
+        for _ in range(nh):
+            hklen = rsl.read_varint()
+            hk = rsl.read(hklen).decode("utf-8", "replace")
+            hvlen = rsl.read_varint()
+            hv = None if hvlen < 0 else rsl.read(hvlen)
+            headers.append((hk, hv))
+        out.append(Record(
+            key=key, value=value, headers=headers,
+            timestamp=info.first_timestamp + ts_delta,
+            offset=info.base_offset + off_delta, msgver=2,
+            is_control=info.is_control,
+            is_transactional=info.is_transactional,
+            producer_id=info.producer_id, timestamp_type=tstype))
+    return out
+
+
+def iter_batches(data: bytes):
+    """Yield (BatchInfo, records_payload, full_batch_bytes) for each complete
+    batch in a Fetch-response records blob. Brokers may return a partial
+    batch at the tail — it is skipped (reference reader behavior)."""
+    data = bytes(data)
+    sl = Slice(data)
+    while sl.remains() >= proto.V2_HEADER_SIZE:
+        start = sl.offset
+        try:
+            info = read_batch_header(sl)
+        except Exception:
+            return
+        batch_total = proto.V2_OF_Length + 4 + info.length
+        payload_len = batch_total - proto.V2_HEADER_SIZE
+        if payload_len < 0 or sl.remains() < payload_len:
+            return  # partial batch at tail
+        payload = sl.read(payload_len)
+        yield info, payload, data[start:start + batch_total]
+
+
+def verify_crc_v2(info: BatchInfo, full_batch: bytes) -> bool:
+    """CRC32C over [Attributes..end] must equal the stored CRC."""
+    return crc32c(full_batch[proto.V2_OF_Attributes:]) == info.crc
+
+
+# ================================================================= v0/v1 ==
+# Legacy MessageSet: [Offset i64][MessageSize i32][Crc u32(zlib)][Magic i8]
+# [Attributes i8][Timestamp i64 (v1 only)][Key bytes][Value bytes].
+# Compression wraps an inner MessageSet in a single wrapper message.
+# (reference: rdkafka_msgset_writer.c MsgVersion<2 paths, reader :530-720)
+
+def write_message_v01(buf: SegBuf, *, offset: int, magic: int, attrs: int,
+                      timestamp: int, key: Optional[bytes],
+                      value: Optional[bytes]) -> None:
+    buf.write_i64(offset)
+    size_pos = buf.write_i32(0)
+    crc_pos = buf.write_u32(0)
+    crc_start = buf.write_i8(magic)
+    buf.write_i8(attrs)
+    if magic == 1:
+        buf.write_i64(timestamp)
+    for b in (key, value):
+        if b is None:
+            buf.write_i32(-1)
+        else:
+            buf.write_i32(len(b))
+            buf.write(b)
+    end = len(buf)
+    buf.update_i32(size_pos, end - (size_pos + 4))
+    buf.update_u32(crc_pos, crc32(buf.as_bytes(crc_start, end)))
+
+
+def write_msgset_v01(msgs: Iterable[Record], *, magic: int, codec: Optional[str],
+                     now_ms: int, compress_fn=None,
+                     base_offset: int = 0) -> bytes:
+    inner = SegBuf()
+    n = 0
+    compressed = codec not in (None, "none") and compress_fn is not None
+    for i, m in enumerate(msgs):
+        ts = m.timestamp if m.timestamp and m.timestamp > 0 else now_ms
+        # v1 compression wrappers carry *relative* inner offsets 0..n-1;
+        # the wrapper offset is the absolute offset of the LAST message
+        # (reference reader fixup at rdkafka_msgset_reader.c:666).
+        off = i if (compressed and magic == 1) else base_offset + i
+        write_message_v01(inner, offset=off, magic=magic, attrs=0,
+                          timestamp=ts, key=m.key, value=m.value)
+        n += 1
+    raw = inner.as_bytes()
+    if not codec or codec == "none" or compress_fn is None:
+        return raw
+    comp = compress_fn(raw)
+    wrapper = SegBuf()
+    # wrapper offset: v1 uses last inner offset (relative-offset era), v0 uses 0
+    woffset = (base_offset + n - 1) if magic == 1 else base_offset
+    write_message_v01(wrapper, offset=woffset, magic=magic,
+                      attrs=CODEC_IDS[codec], timestamp=now_ms, key=None,
+                      value=comp)
+    return wrapper.as_bytes()
+
+
+def parse_msgset_v01(data: bytes, decompress_fn=None) -> list[Record]:
+    """Parse a legacy MessageSet, recursing into compression wrappers."""
+    out: list[Record] = []
+    sl = Slice(data)
+    while sl.remains() >= 12:
+        offset = sl.read_i64()
+        size = sl.read_i32()
+        if sl.remains() < size:
+            break  # partial trailing message
+        msl = sl.narrow(size)
+        msl.read_u32()  # crc (verified optionally at a higher layer)
+        magic = msl.read_i8()
+        attrs = msl.read_i8()
+        ts = -1
+        if magic >= 1:
+            ts = msl.read_i64()
+        klen = msl.read_i32()
+        key = None if klen < 0 else msl.read(klen)
+        vlen = msl.read_i32()
+        value = None if vlen < 0 else msl.read(vlen)
+        codec = CODEC_NAMES.get(attrs & ATTR_CODEC_MASK)
+        if codec and value is not None:
+            if decompress_fn is None:
+                raise ValueError(f"compressed ({codec}) legacy messageset "
+                                 "but no decompressor supplied")
+            inner = parse_msgset_v01(decompress_fn(codec, value),
+                                     decompress_fn)
+            if magic == 1 and inner:
+                # v1 wrapper carries absolute offset of LAST inner message;
+                # inner offsets are 0..n-1 relative (reference reader :666)
+                base = offset - (len(inner) - 1)
+                for r in inner:
+                    r.offset += base
+            out.extend(inner)
+        else:
+            out.append(Record(key=key, value=value, timestamp=ts,
+                              offset=offset, msgver=magic))
+    return out
