@@ -1,0 +1,281 @@
+"""Mock cluster smoke tests via raw sockets (reference: 0009-mock_cluster.c):
+the mock must act as a protocol oracle — produced wire bytes come back from
+Fetch verbatim (modulo the broker's BaseOffset patch)."""
+import socket
+import struct
+
+import pytest
+
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.ops import cpu
+from librdkafka_tpu.protocol import apis, proto
+from librdkafka_tpu.protocol.msgset import (MsgsetWriterV2, Record,
+                                            iter_batches, parse_records_v2,
+                                            verify_crc_v2)
+from librdkafka_tpu.protocol.proto import ApiKey
+from librdkafka_tpu.client.errors import Err
+
+NOW = 1_690_000_000_000
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=3, topics={"t1": 4})
+    yield c
+    c.stop()
+
+
+class RawClient:
+    """Minimal blocking protocol client for oracle tests."""
+
+    def __init__(self, host_port: str):
+        host, port = host_port.split(":")
+        self.sock = socket.create_connection((host, int(port)), timeout=5)
+        self.corrid = 0
+
+    def call(self, api: ApiKey, body: dict) -> dict:
+        self.corrid += 1
+        self.sock.sendall(apis.build_request(api, self.corrid, "raw", body))
+        hdr = self._recvn(4)
+        (n,) = struct.unpack(">i", hdr)
+        payload = self._recvn(n)
+        corrid, resp = apis.parse_response(api, payload)
+        assert corrid == self.corrid
+        return resp
+
+    def _recvn(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("eof")
+            out += chunk
+        return out
+
+    def close(self):
+        self.sock.close()
+
+
+def broker_client(cluster, broker_id) -> RawClient:
+    addr = cluster.bootstrap_servers().split(",")[broker_id - 1]
+    return RawClient(addr)
+
+
+def test_apiversions_and_metadata(cluster):
+    c = broker_client(cluster, 1)
+    try:
+        vers = c.call(ApiKey.ApiVersions, {})
+        assert vers["error_code"] == 0
+        keys = {v["api_key"] for v in vers["api_versions"]}
+        assert int(ApiKey.Produce) in keys and int(ApiKey.Fetch) in keys
+
+        md = c.call(ApiKey.Metadata, {"topics": ["t1"]})
+        assert len(md["brokers"]) == 3
+        t = md["topics"][0]
+        assert t["topic"] == "t1" and len(t["partitions"]) == 4
+    finally:
+        c.close()
+
+
+def produce_fetch_roundtrip(cluster, codec):
+    # find partition 0's leader
+    part = cluster.partition("t1", 0)
+    c = broker_client(cluster, part.leader)
+    try:
+        msgs = [Record(key=b"k%d" % i, value=b"payload-%d-" % i + b"z" * 100,
+                       timestamp=NOW + i) for i in range(17)]
+        w = MsgsetWriterV2(codec=codec)
+        compress = (lambda b: cpu.CODECS[codec][0](b)) if codec else None
+        wire = w.write_batch(msgs, NOW, compress)
+
+        resp = c.call(ApiKey.Produce, {
+            "transactional_id": None, "acks": -1, "timeout": 5000,
+            "topics": [{"topic": "t1", "partitions": [
+                {"partition": 0, "records": wire}]}]})
+        pres = resp["topics"][0]["partitions"][0]
+        assert pres["error_code"] == 0
+        assert pres["base_offset"] == 0
+
+        fresp = c.call(ApiKey.Fetch, {
+            "replica_id": -1, "max_wait_time": 1000, "min_bytes": 1,
+            "max_bytes": 1 << 20, "isolation_level": 1,
+            "topics": [{"topic": "t1", "partitions": [
+                {"partition": 0, "fetch_offset": 0, "max_bytes": 1 << 20}]}]})
+        fpart = fresp["topics"][0]["partitions"][0]
+        assert fpart["error_code"] == 0
+        assert fpart["high_watermark"] == 17
+        # ORACLE: fetched bytes == produced bytes (BaseOffset was 0 already)
+        assert fpart["records"] == wire
+
+        info, payload, full = next(iter_batches(fpart["records"]))
+        assert verify_crc_v2(info, full)
+        if info.codec:
+            payload = cpu.CODECS[info.codec][1](payload, 0)
+        recs = parse_records_v2(info, payload)
+        assert [r.value for r in recs] == [m.value for m in msgs]
+        assert [r.offset for r in recs] == list(range(17))
+    finally:
+        c.close()
+
+
+@pytest.mark.parametrize("codec", [None, "lz4", "snappy", "gzip", "zstd"])
+def test_produce_fetch_wire_oracle(cluster, codec):
+    produce_fetch_roundtrip(cluster, codec)
+
+
+def test_base_offset_patching(cluster):
+    part = cluster.partition("t1", 1)
+    c = broker_client(cluster, part.leader)
+    try:
+        for batch_i in range(3):
+            w = MsgsetWriterV2()
+            wire = w.write_batch([Record(value=b"b%d-%d" % (batch_i, j))
+                                  for j in range(5)], NOW)
+            resp = c.call(ApiKey.Produce, {
+                "transactional_id": None, "acks": -1, "timeout": 5000,
+                "topics": [{"topic": "t1", "partitions": [
+                    {"partition": 1, "records": wire}]}]})
+            assert resp["topics"][0]["partitions"][0]["base_offset"] == batch_i * 5
+
+        fresp = c.call(ApiKey.Fetch, {
+            "replica_id": -1, "max_wait_time": 100, "min_bytes": 1,
+            "max_bytes": 1 << 20, "isolation_level": 1,
+            "topics": [{"topic": "t1", "partitions": [
+                {"partition": 1, "fetch_offset": 5, "max_bytes": 1 << 20}]}]})
+        blob = fresp["topics"][0]["partitions"][0]["records"]
+        offs = []
+        for info, payload, full in iter_batches(blob):
+            assert verify_crc_v2(info, full)  # CRC survives offset patching
+            offs.extend(r.offset for r in parse_records_v2(info, payload))
+        assert offs == list(range(5, 15))
+    finally:
+        c.close()
+
+
+def test_error_injection_and_leader_error(cluster):
+    part = cluster.partition("t1", 0)
+    non_leader = part.leader % 3 + 1
+    c = broker_client(cluster, non_leader)
+    try:
+        wire = MsgsetWriterV2().write_batch([Record(value=b"x")], NOW)
+        resp = c.call(ApiKey.Produce, {
+            "transactional_id": None, "acks": -1, "timeout": 5000,
+            "topics": [{"topic": "t1", "partitions": [
+                {"partition": 0, "records": wire}]}]})
+        assert (resp["topics"][0]["partitions"][0]["error_code"]
+                == Err.NOT_LEADER_FOR_PARTITION.wire)
+
+        cluster.push_request_errors(ApiKey.ListOffsets,
+                                    [Err.REQUEST_TIMED_OUT])
+        r1 = c.call(ApiKey.ListOffsets, {
+            "replica_id": -1, "topics": [{"topic": "t1", "partitions": [
+                {"partition": 0, "timestamp": -1}]}]})
+        assert (r1["topics"][0]["partitions"][0]["error_code"]
+                == Err.REQUEST_TIMED_OUT.wire)
+        r2 = c.call(ApiKey.ListOffsets, {
+            "replica_id": -1, "topics": [{"topic": "t1", "partitions": [
+                {"partition": 0, "timestamp": -1}]}]})
+        assert r2["topics"][0]["partitions"][0]["error_code"] == 0
+    finally:
+        c.close()
+
+
+def test_idempotent_sequence_checks(cluster):
+    part = cluster.partition("t1", 2)
+    c = broker_client(cluster, part.leader)
+    try:
+        pid = c.call(ApiKey.InitProducerId,
+                     {"transactional_id": None,
+                      "transaction_timeout_ms": 60000})
+        assert pid["error_code"] == 0 and pid["producer_id"] >= 1
+
+        def produce(seq):
+            w = MsgsetWriterV2(producer_id=pid["producer_id"],
+                               producer_epoch=pid["producer_epoch"],
+                               base_sequence=seq)
+            wire = w.write_batch([Record(value=b"s%d" % seq)], NOW)
+            r = c.call(ApiKey.Produce, {
+                "transactional_id": None, "acks": -1, "timeout": 5000,
+                "topics": [{"topic": "t1", "partitions": [
+                    {"partition": 2, "records": wire}]}]})
+            return r["topics"][0]["partitions"][0]["error_code"]
+
+        assert produce(0) == 0
+        assert produce(1) == 0
+        assert produce(1) == Err.DUPLICATE_SEQUENCE_NUMBER.wire   # replay
+        assert produce(5) == Err.OUT_OF_ORDER_SEQUENCE_NUMBER.wire  # gap
+        assert produce(2) == 0
+    finally:
+        c.close()
+
+
+def test_group_join_sync_single_member(cluster):
+    coord = cluster.coordinator_for("g1")
+    c = broker_client(cluster, coord)
+    try:
+        fc = c.call(ApiKey.FindCoordinator, {"key": "g1", "key_type": 0})
+        assert fc["error_code"] == 0 and fc["node_id"] == coord
+
+        j = c.call(ApiKey.JoinGroup, {
+            "group_id": "g1", "session_timeout": 10000,
+            "rebalance_timeout": 3000, "member_id": "",
+            "protocol_type": "consumer",
+            "protocols": [{"name": "range", "metadata": b"MD"}]})
+        assert j["error_code"] == 0
+        assert j["leader_id"] == j["member_id"]
+        assert j["members"][0]["metadata"] == b"MD"
+
+        s = c.call(ApiKey.SyncGroup, {
+            "group_id": "g1", "generation_id": j["generation_id"],
+            "member_id": j["member_id"],
+            "assignments": [{"member_id": j["member_id"],
+                             "assignment": b"ASSIGN"}]})
+        assert s["error_code"] == 0 and s["assignment"] == b"ASSIGN"
+
+        h = c.call(ApiKey.Heartbeat, {
+            "group_id": "g1", "generation_id": j["generation_id"],
+            "member_id": j["member_id"]})
+        assert h["error_code"] == 0
+
+        c.call(ApiKey.OffsetCommit, {
+            "group_id": "g1", "generation_id": j["generation_id"],
+            "member_id": j["member_id"], "retention_time": -1,
+            "topics": [{"topic": "t1", "partitions": [
+                {"partition": 0, "offset": 42, "metadata": None}]}]})
+        of = c.call(ApiKey.OffsetFetch, {
+            "group_id": "g1",
+            "topics": [{"topic": "t1", "partitions": [0, 1]}]})
+        parts = {p["partition"]: p["offset"]
+                 for p in of["topics"][0]["partitions"]}
+        assert parts == {0: 42, 1: -1}
+    finally:
+        c.close()
+
+
+def test_admin_ops(cluster):
+    c = broker_client(cluster, 1)
+    try:
+        r = c.call(ApiKey.CreateTopics, {
+            "topics": [{"topic": "newt", "num_partitions": 2,
+                        "replication_factor": 1, "replica_assignment": [],
+                        "configs": []}],
+            "timeout": 1000, "validate_only": False})
+        assert r["topics"][0]["error_code"] == 0
+        r2 = c.call(ApiKey.CreateTopics, {
+            "topics": [{"topic": "newt", "num_partitions": 2,
+                        "replication_factor": 1, "replica_assignment": [],
+                        "configs": []}],
+            "timeout": 1000, "validate_only": False})
+        assert r2["topics"][0]["error_code"] == Err.TOPIC_ALREADY_EXISTS.wire
+
+        r3 = c.call(ApiKey.CreatePartitions, {
+            "topics": [{"topic": "newt", "count": 5, "assignment": None}],
+            "timeout": 1000, "validate_only": False})
+        assert r3["topics"][0]["error_code"] == 0
+        md = c.call(ApiKey.Metadata, {"topics": ["newt"]})
+        assert len(md["topics"][0]["partitions"]) == 5
+
+        r4 = c.call(ApiKey.DeleteTopics, {"topics": ["newt"], "timeout": 100})
+        assert r4["topics"][0]["error_code"] == 0
+    finally:
+        c.close()
