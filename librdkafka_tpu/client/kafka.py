@@ -1,0 +1,657 @@
+"""The client handle (reference: rd_kafka_t, src/rdkafka.c).
+
+Owns configuration, the broker set, topics/toppars, the metadata cache,
+the reply ("rep") queue the app polls, and the main thread
+(rd_kafka_thread_main, rdkafka.c:1834) that drives timers: metadata
+refresh, message timeout scans, stats emission, cgrp serving, and
+unassigned-partition migration.
+"""
+from __future__ import annotations
+
+import json
+import random
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..protocol import apis, proto
+from ..protocol.msgset import (iter_batches, parse_msgset_v01,
+                               parse_records_v2, verify_crc_v2)
+from ..protocol.proto import ApiKey
+from .broker import Broker, Request
+from .conf import Conf, TopicConf
+from .errors import Err, KafkaError, KafkaException
+from .msg import Message, MsgStatus, PARTITION_UA, partitioner_fn
+from .partition import FetchState, Toppar
+from .queue import Op, OpQueue, OpType, Timers
+
+PRODUCER, CONSUMER = "producer", "consumer"
+
+
+class Topic:
+    """rd_kafka_itopic_t analog: per-topic state + UA message parking."""
+
+    def __init__(self, name: str, tconf: TopicConf):
+        self.name = name
+        self.conf = tconf
+        self.partition_cnt = -1
+        self.ua_msgq: deque[Message] = deque()   # parked until metadata
+        self.partitioner = partitioner_fn(tconf.get("partitioner"))
+        self.lock = threading.Lock()
+
+
+class IdempotenceManager:
+    """EOS v1 producer-id state machine (reference:
+    src/rdkafka_idempotence.c — REQ_PID→WAIT_PID→ASSIGNED, drain+epoch-bump
+    recovery at :347-440)."""
+
+    def __init__(self, rk: "Kafka"):
+        self.rk = rk
+        self.state = "INIT"
+        self.pid = -1
+        self.epoch = -1
+        self._lock = threading.Lock()
+
+    def can_produce(self) -> bool:
+        return self.state == "ASSIGNED"
+
+    def serve(self):
+        with self._lock:
+            if self.state in ("INIT", "RETRY"):
+                broker = self.rk.any_up_broker()
+                if broker is None:
+                    return
+                self.state = "WAIT_PID"
+                broker.enqueue_request(Request(
+                    ApiKey.InitProducerId,
+                    {"transactional_id": None,
+                     "transaction_timeout_ms": 60000},
+                    retries_left=3, cb=self._handle_pid))
+
+    def _handle_pid(self, err, resp):
+        with self._lock:
+            if err is not None or resp["error_code"] != 0:
+                self.state = "RETRY"
+                return
+            self.pid = resp["producer_id"]
+            self.epoch = resp["producer_epoch"]
+            self.state = "ASSIGNED"
+            self.rk.dbg("eos", f"assigned PID {self.pid} epoch {self.epoch}")
+
+    def drain_bump(self, tp, msgs):
+        """Sequence gap: drain, acquire a new PID, reset per-toppar seq
+        bases, requeue (reference :374-440)."""
+        with self._lock:
+            self.rk.dbg("eos", f"drain+bump after seq error on {tp}")
+            self.state = "INIT"
+        tp.insert_retry(msgs)
+        with self.rk._toppars_lock:
+            tps = list(self.rk._toppars.values())
+        for t in tps:
+            with t.lock:
+                first = min((m.msgid for m in list(t.xmit_msgq) +
+                             list(t.msgq)), default=t.next_msgid)
+                t.epoch_base_msgid = first - 1
+        self.serve()
+
+
+class Kafka:
+    """Client instance; create via Producer() or Consumer()."""
+
+    def __init__(self, conf: Conf, client_type: str):
+        self.conf = conf
+        self.type = client_type
+        self.is_producer = client_type == PRODUCER
+        self.is_consumer = client_type == CONSUMER
+        self.rep = OpQueue("rk_rep")          # app-facing reply queue
+        self.ops = OpQueue("rk_ops")
+        self.timers = Timers()
+        self.brokers: dict[int, Broker] = {}
+        self._bootstrap: list[Broker] = []
+        self._brokers_lock = threading.Lock()
+        self.topics: dict[str, Topic] = {}
+        self._topics_lock = threading.Lock()
+        self._toppars: dict[tuple[str, int], Toppar] = {}
+        self._toppars_lock = threading.Lock()
+        self.metadata: dict = {"brokers": {}, "topics": {}}
+        self._metadata_lock = threading.Lock()
+        self._metadata_inflight = False
+        self.flushing = False
+        self.terminating = False
+        self.fatal_error: Optional[KafkaError] = None
+        self.msg_cnt = 0                       # queue.buffering.max.messages
+        self._msg_cnt_lock = threading.Lock()
+        self.cgrp = None                       # set by Consumer
+        self.interceptors = conf.get("interceptors") or None
+        self.mock_cluster = None
+        self.stats = None                      # StatsCollector, set below
+        self.debug_contexts = set(conf.get("debug"))
+        self.log_cb = conf.get("log_cb")
+
+        # codec provider selection (compression.backend; SURVEY.md §7 st.5)
+        backend = conf.get("compression.backend")
+        if backend == "tpu":
+            from ..ops.tpu import TpuCodecProvider
+            self.codec_provider = TpuCodecProvider(
+                min_batches=conf.get("tpu.launch.min.batches"))
+        else:
+            from ..ops.cpu import CpuCodecProvider
+            self.codec_provider = CpuCodecProvider()
+
+        self.idemp = (IdempotenceManager(self)
+                      if self.is_producer and conf.get("enable.idempotence")
+                      else None)
+
+        from .stats import StatsCollector
+        self.stats = StatsCollector(self)
+
+        # implicit mock cluster (test.mock.num.brokers)
+        nmock = conf.get("test.mock.num.brokers")
+        bootstrap = conf.get("bootstrap.servers")
+        if nmock > 0 and not bootstrap:
+            from ..mock.cluster import MockCluster
+            self.mock_cluster = MockCluster(num_brokers=nmock)
+            bootstrap = self.mock_cluster.bootstrap_servers()
+        if not bootstrap:
+            raise KafkaException(Err._INVALID_ARG,
+                                 "bootstrap.servers not configured")
+
+        # interceptors on_new
+        if self.interceptors:
+            self.interceptors.on_new(self)
+
+        nodeid = -1
+        for hp in bootstrap.split(","):
+            host, _, port = hp.strip().rpartition(":")
+            b = Broker(self, nodeid, host, int(port),
+                       name=f"{host}:{port}/bootstrap")
+            self._bootstrap.append(b)
+            self.brokers[nodeid] = b
+            nodeid -= 1
+
+        # timers (reference main loop rdkafka.c:1877-1886)
+        refresh = conf.get("topic.metadata.refresh.interval.ms")
+        if refresh > 0:
+            self.timers.add(refresh / 1000.0,
+                            lambda: self.metadata_refresh("periodic"))
+        self.timers.add(1.0, self._scan_msg_timeouts)
+        stats_ival = conf.get("statistics.interval.ms")
+        if stats_ival > 0:
+            self.timers.add(stats_ival / 1000.0, self._emit_stats)
+
+        self._main = threading.Thread(target=self._thread_main,
+                                      name="rdk:main", daemon=True)
+        self._main.start()
+        for b in self._bootstrap:
+            b.start()
+        self.metadata_refresh("bootstrap")
+
+    # ------------------------------------------------------------ logging --
+    def log(self, level: str, msg: str):
+        if self.log_cb:
+            self.log_cb(level, "rdkafka", msg)
+        elif level in ("ERROR", "WARN"):
+            print(f"%{level}|rdkafka| {msg}", file=sys.stderr)
+
+    def dbg(self, ctx: str, msg: str):
+        if ctx in self.debug_contexts or "all" in self.debug_contexts:
+            self.log("DEBUG", f"[{ctx}] {msg}")
+
+    # -------------------------------------------------------- main thread --
+    def _thread_main(self):
+        while not self.terminating:
+            timeout = self.timers.next_timeout(0.1)
+            op = self.ops.pop(timeout)
+            if op is not None:
+                self._op_serve(op)
+            self.timers.run()
+            if self.idemp:
+                self.idemp.serve()
+            if self.cgrp:
+                self.cgrp.serve()
+
+    def _op_serve(self, op: Op):
+        if op.cb:
+            op.cb(op)
+
+    # ----------------------------------------------------------- metadata --
+    def any_up_broker(self) -> Optional[Broker]:
+        with self._brokers_lock:
+            ups = [b for b in self.brokers.values() if b.is_up()]
+        return random.choice(ups) if ups else None
+
+    def metadata_refresh(self, reason: str = ""):
+        if self._metadata_inflight or self.terminating:
+            return
+        b = self.any_up_broker()
+        if b is None:
+            # will be retried when a broker comes up (broker_state_change)
+            return
+        self._metadata_inflight = True
+        sparse = self.conf.get("topic.metadata.refresh.sparse")
+        with self._topics_lock:
+            names = list(self.topics) if sparse else None
+        if names == []:
+            names = None if not self.is_consumer else []
+        self.dbg("metadata", f"refresh ({reason}) via {b.name}")
+        b.enqueue_request(Request(
+            ApiKey.Metadata, {"topics": names}, retries_left=2,
+            cb=self._handle_metadata))
+
+    def _handle_metadata(self, err, resp):
+        self._metadata_inflight = False
+        if err is not None:
+            return
+        with self._metadata_lock:
+            new_brokers = {b["node_id"]: (b["host"], b["port"])
+                           for b in resp["brokers"]}
+            self.metadata["brokers"] = new_brokers
+            for t in resp["topics"]:
+                if Err.from_wire(t["error_code"]) != Err.NO_ERROR:
+                    continue
+                self.metadata["topics"][t["topic"]] = {
+                    p["partition"]: p["leader"] for p in t["partitions"]}
+        # instantiate broker threads for newly discovered nodes
+        with self._brokers_lock:
+            for nid, (host, port) in new_brokers.items():
+                if nid not in self.brokers:
+                    b = Broker(self, nid, host, port)
+                    self.brokers[nid] = b
+                    b.start()
+        # update topic partition counts + migrate UA messages + leaders
+        for t in resp["topics"]:
+            name = t["topic"]
+            topic = self.topics.get(name)
+            if topic is not None:
+                with topic.lock:
+                    topic.partition_cnt = len(t["partitions"])
+            for p in t["partitions"]:
+                if p["leader"] < 0:
+                    continue
+                tp = self.get_toppar(name, p["partition"],
+                                     create=(topic is not None))
+                if tp is not None:
+                    self._assign_toppar_leader(tp, p["leader"])
+        self._migrate_ua_msgs()
+
+    def _assign_toppar_leader(self, tp: Toppar, leader: int):
+        if tp.leader_id == leader:
+            return
+        old = tp.leader_id
+        tp.leader_id = leader
+        with self._brokers_lock:
+            if old in self.brokers:
+                self.brokers[old].remove_toppar(tp)
+            if leader in self.brokers:
+                self.brokers[leader].add_toppar(tp)
+        self.dbg("topic", f"{tp}: leader {old} -> {leader}")
+
+    def _migrate_ua_msgs(self):
+        with self._topics_lock:
+            topics = list(self.topics.values())
+        for topic in topics:
+            with topic.lock:
+                if topic.partition_cnt <= 0 or not topic.ua_msgq:
+                    continue
+                msgs, topic.ua_msgq = topic.ua_msgq, deque()
+            for m in msgs:
+                self._partition_and_enq(topic, m)
+
+    # -------------------------------------------------------------- topics --
+    def get_topic(self, name: str) -> Topic:
+        with self._topics_lock:
+            t = self.topics.get(name)
+            if t is None:
+                t = Topic(name, self.conf.topic_conf())
+                self.topics[name] = t
+                self.metadata_refresh(f"new topic {name}")
+            return t
+
+    def topic_conf_for(self, name: str) -> TopicConf:
+        with self._topics_lock:
+            t = self.topics.get(name)
+        return t.conf if t else self.conf.topic_conf()
+
+    def get_toppar(self, topic: str, partition: int,
+                   create: bool = True) -> Optional[Toppar]:
+        key = (topic, partition)
+        with self._toppars_lock:
+            tp = self._toppars.get(key)
+            if tp is None and create:
+                tp = Toppar(topic, partition)
+                self._toppars[key] = tp
+                with self._metadata_lock:
+                    leader = self.metadata["topics"].get(topic, {}).get(partition)
+                if leader is not None and leader >= 0:
+                    self._assign_toppar_leader(tp, leader)
+            return tp
+
+    # ------------------------------------------------------------ produce --
+    def produce(self, topic: str, value=None, key=None, partition=PARTITION_UA,
+                headers=(), timestamp=0, opaque=None) -> None:
+        if self.fatal_error:
+            raise KafkaException(self.fatal_error)
+        with self._msg_cnt_lock:
+            if self.msg_cnt >= self.conf.get("queue.buffering.max.messages"):
+                raise KafkaException(Err._QUEUE_FULL,
+                                     "producer queue is full")
+            self.msg_cnt += 1
+        m = Message(topic, value=value, key=key, partition=partition,
+                    headers=headers, timestamp=timestamp, opaque=opaque)
+        if self.interceptors:
+            self.interceptors.on_send(m)
+        t = self.get_topic(topic)
+        if partition == PARTITION_UA:
+            with t.lock:
+                if t.partition_cnt <= 0:
+                    t.ua_msgq.append(m)     # park until metadata
+                    return
+            self._partition_and_enq(t, m)
+        else:
+            tp = self.get_toppar(topic, partition)
+            tp.enq_msg(m)
+            self._wake_leader(tp)
+
+    def _partition_and_enq(self, topic: Topic, m: Message):
+        pcb = topic.conf.get("partitioner_cb")
+        if pcb:
+            m.partition = pcb(m.key, topic.partition_cnt)
+        else:
+            m.partition = topic.partitioner(m.key, topic.partition_cnt)
+        tp = self.get_toppar(topic.name, m.partition)
+        tp.enq_msg(m)
+        self._wake_leader(tp)
+
+    def _wake_leader(self, tp: Toppar):
+        with self._brokers_lock:
+            b = self.brokers.get(tp.leader_id)
+        if b is not None:
+            b.ops.push(Op(OpType.BROKER_WAKEUP))
+
+    # ------------------------------------------------------------ DR path --
+    def dr_msgq(self, msgs: list[Message], err: Optional[KafkaError]):
+        """Queue delivery reports (reference: rd_kafka_dr_msgq,
+        rdkafka_broker.c:2432)."""
+        with self._msg_cnt_lock:
+            self.msg_cnt -= len(msgs)
+        for m in msgs:
+            m.error = err
+        if self.interceptors:
+            for m in msgs:
+                self.interceptors.on_acknowledgement(m)
+        only_err = self.conf.get("delivery.report.only.error")
+        out = [m for m in msgs if err or not only_err]
+        if out and (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")):
+            for m in out:
+                self.rep.push(Op(OpType.DR, payload=m))
+
+    def poll(self, timeout: float = 0.0) -> int:
+        """Serve the app reply queue: DRs, errors, stats, logs
+        (reference: rd_kafka_poll, rdkafka.c:3574)."""
+        served = 0
+        t = timeout
+        while True:
+            op = self.rep.pop(t)
+            if op is None:
+                return served
+            t = 0
+            self._serve_rep_op(op)
+            served += 1
+
+    def _serve_rep_op(self, op: Op):
+        if op.type == OpType.DR:
+            cb = self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
+            if cb:
+                cb(op.payload.error, op.payload)
+        elif op.type == OpType.ERR:
+            cb = self.conf.get("error_cb")
+            if cb:
+                cb(op.payload)
+        elif op.type == OpType.STATS:
+            cb = self.conf.get("stats_cb")
+            if cb:
+                cb(op.payload)
+        elif op.type == OpType.LOG:
+            if self.log_cb:
+                self.log_cb(*op.payload)
+        elif op.cb:
+            op.cb(op)
+
+    def op_err(self, err: KafkaError):
+        self.rep.push(Op(OpType.ERR, payload=err))
+
+    def set_fatal_error(self, err: KafkaError):
+        err.fatal = True
+        if self.fatal_error is None:
+            self.fatal_error = err
+            self.op_err(err)
+
+    # -------------------------------------------------------------- flush --
+    def flush(self, timeout: float = 10.0) -> int:
+        """Wait for all outstanding messages; returns count still queued
+        (reference: rd_kafka_flush, rdkafka.c:3905)."""
+        self.flushing = True
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._msg_cnt_lock:
+                    n = self.msg_cnt
+                if n == 0:
+                    return 0
+                self._wake_all_brokers()
+                self.poll(0.01)
+            with self._msg_cnt_lock:
+                return self.msg_cnt
+        finally:
+            self.flushing = False
+
+    def purge(self, in_queue: bool = True, in_flight: bool = False) -> None:
+        """Purge queued messages with DR _PURGE_QUEUE errors."""
+        purged = []
+        with self._toppars_lock:
+            tps = list(self._toppars.values())
+        for tp in tps:
+            with tp.lock:
+                if in_queue:
+                    purged.extend(tp.msgq)
+                    tp.msgq.clear()
+                    tp.msgq_bytes = 0
+        with self._topics_lock:
+            for t in self.topics.values():
+                with t.lock:
+                    if in_queue:
+                        purged.extend(t.ua_msgq)
+                        t.ua_msgq.clear()
+        if purged:
+            self.dr_msgq(purged, KafkaError(Err._PURGE_QUEUE, "purged"))
+
+    def _wake_all_brokers(self):
+        with self._brokers_lock:
+            for b in self.brokers.values():
+                b.ops.push(Op(OpType.BROKER_WAKEUP))
+
+    # ------------------------------------------------- broker transitions --
+    def broker_state_change(self, broker: Broker):
+        if broker.is_up():
+            self.metadata_refresh(f"broker {broker.name} up")
+
+    def broker_down(self, broker: Broker, err: KafkaError):
+        with self._brokers_lock:
+            any_up = any(b.is_up() for b in self.brokers.values())
+        if not any_up and not self.terminating:
+            self.op_err(KafkaError(Err._ALL_BROKERS_DOWN,
+                                   "all brokers are down"))
+
+    # ------------------------------------------------------ msg timeouts --
+    def _scan_msg_timeouts(self):
+        """(reference: rd_kafka_broker_toppar_msgq_scan,
+        rdkafka_broker.c:3093)"""
+        if not self.is_producer:
+            return
+        now = time.monotonic()
+        with self._toppars_lock:
+            tps = list(self._toppars.values())
+        for tp in tps:
+            tmo = self.topic_conf_for(tp.topic).get("message.timeout.ms") / 1000.0
+            if tmo <= 0:
+                continue
+            expired = []
+            with tp.lock:
+                for q in (tp.msgq, tp.xmit_msgq):
+                    while q and now - q[0].enq_time > tmo:
+                        expired.append(q.popleft())
+            if expired:
+                self.dr_msgq(expired,
+                             KafkaError(Err._MSG_TIMED_OUT,
+                                        "message timed out"))
+
+    # --------------------------------------------------------- stats emit --
+    def _emit_stats(self):
+        blob = self.stats.emit_json()
+        self.rep.push(Op(OpType.STATS, payload=blob))
+
+    # ------------------------------------------------- consumer fetch path --
+    def fetch_reply_handle(self, tp: Toppar, pres: dict, broker: Broker):
+        """Parse a fetch response partition into messages
+        (reference: rd_kafka_fetch_reply_handle → rd_kafka_msgset_parse,
+        rdkafka_msgset_reader.c:1410; aborted-txn filtering :1442-1560)."""
+        blob = pres["records"] or b""
+        if not blob:
+            if (self.conf.get("enable.partition.eof")
+                    and tp.fetch_offset >= tp.hi_offset
+                    and tp.eof_reported_at != tp.fetch_offset):
+                tp.eof_reported_at = tp.fetch_offset
+                m = Message(tp.topic, partition=tp.partition)
+                m.offset = tp.fetch_offset
+                m.error = KafkaError(Err._PARTITION_EOF, "partition EOF")
+                tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, tp.version)))
+            return
+        check_crcs = self.conf.get("check.crcs")
+        read_committed = (self.conf.get("isolation.level") == "read_committed")
+        aborted = {a["producer_id"]: sorted(x["first_offset"]
+                   for x in pres["aborted_transactions"]
+                   if x["producer_id"] == a["producer_id"])
+                   for a in (pres["aborted_transactions"] or [])}
+        active_aborts: set[int] = set()
+        msgs: list[Message] = []
+        next_offset = tp.fetch_offset
+        is_v2 = (len(blob) > proto.V2_OF_Magic and blob[proto.V2_OF_Magic] == 2)
+        if is_v2:
+            for info, payload, full in iter_batches(blob):
+                last = info.base_offset + info.last_offset_delta
+                if last < tp.fetch_offset:
+                    next_offset = max(next_offset, last + 1)
+                    continue
+                if check_crcs and not verify_crc_v2(info, full):
+                    self.op_err(KafkaError(Err._BAD_MSG,
+                                           f"{tp}: CRC mismatch at offset "
+                                           f"{info.base_offset}"))
+                    tp.fetch_backoff_until = time.monotonic() + 0.5
+                    return
+                # aborted-txn bookkeeping
+                pid = info.producer_id
+                if read_committed and pid in aborted:
+                    while aborted[pid] and aborted[pid][0] <= info.base_offset:
+                        aborted[pid].pop(0)
+                        active_aborts.add(pid)
+                if info.is_control:
+                    # control record: key = [version i16, type i16]
+                    try:
+                        recs = parse_records_v2(info, payload)
+                        if recs and recs[0].key and len(recs[0].key) >= 4:
+                            ctype = int.from_bytes(recs[0].key[2:4], "big")
+                            if ctype == proto.CTRL_ABORT:
+                                active_aborts.discard(pid)
+                    except Exception:
+                        pass
+                    next_offset = last + 1
+                    continue
+                if (read_committed and info.is_transactional
+                        and pid in active_aborts):
+                    next_offset = last + 1
+                    continue
+                if info.codec:
+                    try:
+                        payload = self.codec_provider.decompress_many(
+                            info.codec, [payload])[0]
+                    except Exception as e:
+                        self.op_err(KafkaError(
+                            Err._BAD_COMPRESSION,
+                            f"{tp}: decompress ({info.codec}): {e!r}"))
+                        tp.fetch_backoff_until = time.monotonic() + 0.5
+                        return
+                for r in parse_records_v2(info, payload):
+                    if r.offset < tp.fetch_offset:
+                        continue
+                    m = Message(tp.topic, value=r.value, key=r.key,
+                                partition=tp.partition,
+                                headers=r.headers, timestamp=r.timestamp)
+                    m.offset = r.offset
+                    m.timestamp_type = r.timestamp_type
+                    msgs.append(m)
+                next_offset = last + 1
+        else:
+            dec = lambda codec, b: self.codec_provider.decompress_many(codec, [b])[0]
+            for r in parse_msgset_v01(blob, dec):
+                if r.offset < tp.fetch_offset:
+                    continue
+                m = Message(tp.topic, value=r.value, key=r.key,
+                            partition=tp.partition, timestamp=r.timestamp)
+                m.offset = r.offset
+                msgs.append(m)
+                next_offset = max(next_offset, r.offset + 1)
+
+        tp.fetch_offset = next_offset
+        tp.eof_reported_at = proto.OFFSET_INVALID
+        for m in msgs:
+            if self.interceptors:
+                self.interceptors.on_consume(m)
+            tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, tp.version)))
+        tp.fetchq_cnt += len(msgs)
+        if self.stats:
+            self.stats.c_rx_msgs += len(msgs)
+
+    def offset_reset(self, tp: Toppar, reason: str):
+        """Apply auto.offset.reset (reference: rdkafka_offset.c
+        RD_KAFKA_OP_OFFSET_RESET path)."""
+        policy = self.topic_conf_for(tp.topic).get("auto.offset.reset")
+        if policy in ("smallest", "earliest", "beginning"):
+            tp.fetch_offset = proto.OFFSET_BEGINNING
+            tp.fetch_state = FetchState.OFFSET_QUERY
+        elif policy in ("largest", "latest", "end"):
+            tp.fetch_offset = proto.OFFSET_END
+            tp.fetch_state = FetchState.OFFSET_QUERY
+        else:
+            m = Message(tp.topic, partition=tp.partition)
+            m.error = KafkaError(Err._NO_OFFSET, reason)
+            tp.fetchq.push(Op(OpType.CONSUMER_ERR, payload=(tp, m, tp.version)))
+            tp.fetch_state = FetchState.STOPPED
+        self.dbg("fetch", f"{tp}: offset reset ({policy}): {reason}")
+
+    # -------------------------------------------------------------- close --
+    def close(self, timeout: float = 5.0):
+        if self.is_producer:
+            self.flush(timeout)
+        self.terminating = True
+        with self._brokers_lock:
+            brokers = list(self.brokers.values())
+        for b in brokers:
+            b.stop()
+        for b in brokers:
+            b.thread.join(timeout=2.0)
+        self._main.join(timeout=2.0)
+        if self.interceptors:
+            self.interceptors.on_destroy(self)
+        if self.mock_cluster:
+            self.mock_cluster.stop()
+
+    # ---------------------------------------------------------- SASL stub --
+    def sasl_required(self) -> bool:
+        return self.conf.get("security.protocol") in ("sasl_plaintext",
+                                                      "sasl_ssl")
+
+    def sasl_start(self, broker: Broker):
+        from .sasl import sasl_client_start
+        sasl_client_start(self, broker)

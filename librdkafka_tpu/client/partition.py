@@ -1,0 +1,98 @@
+"""Topic+partition ("toppar") state (reference: src/rdkafka_partition.c).
+
+Producer side: two queues per toppar — ``msgq`` (app enqueues under lock,
+reference rktp_msgq) and ``xmit_msgq`` (broker thread drains, rktp_xmit_msgq,
+rdkafka_partition.h:105-107) — moved wholesale under the toppar lock at the
+top of the producer serve loop (rdkafka_broker.c:3322-3327).
+
+Consumer side: a fetch state machine (NONE→OFFSET_QUERY→OFFSET_WAIT→ACTIVE,
+rdkafka_partition.h:227-233) and a per-toppar fetch queue that is forwarded
+into the single consumer queue (rd_kafka_q_fwd_set).
+"""
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..protocol import proto
+from .msg import Message
+from .queue import OpQueue
+
+
+class FetchState(enum.Enum):
+    NONE = "none"
+    STOPPING = "stopping"
+    STOPPED = "stopped"
+    OFFSET_QUERY = "offset-query"
+    OFFSET_WAIT = "offset-wait"
+    ACTIVE = "active"
+
+
+class Toppar:
+    def __init__(self, topic: str, partition: int):
+        self.topic = topic
+        self.partition = partition
+        self.lock = threading.Lock()
+
+        # ---- producer ----
+        self.msgq: deque[Message] = deque()        # app → (lock) → broker
+        self.xmit_msgq: deque[Message] = deque()   # broker-thread owned
+        self.msgq_bytes = 0
+        self.next_msgid = 1
+        self.epoch_base_msgid = 0                  # idempotence seq base
+        self.inflight = 0                          # in-flight ProduceRequests
+        self.leader_id: int = -1
+        self.ts_last_xmit = 0.0
+
+        # ---- consumer ----
+        self.fetch_state = FetchState.NONE
+        self.fetchq = OpQueue(f"{topic}[{partition}]-fetchq")
+        self.fetch_offset: int = proto.OFFSET_INVALID
+        self.app_offset: int = proto.OFFSET_INVALID     # next offset app sees
+        self.stored_offset: int = proto.OFFSET_INVALID  # to be committed
+        self.committed_offset: int = proto.OFFSET_INVALID
+        self.hi_offset: int = proto.OFFSET_INVALID      # high watermark
+        self.ls_offset: int = proto.OFFSET_INVALID      # last stable
+        self.paused = False
+        self.fetch_backoff_until = 0.0
+        self.fetchq_cnt = 0              # msgs sitting in fetchq (queued.min)
+        self.fetchq_bytes = 0
+        self.eof_reported_at = proto.OFFSET_INVALID
+        self.aborted_txns: dict[int, list[int]] = {}  # pid -> abort offsets
+        self.version = 1                 # barrier for stale fetch ops
+
+    # ------------------------------------------------------- producer ----
+    def enq_msg(self, msg: Message) -> None:
+        with self.lock:
+            msg.msgid = self.next_msgid
+            self.next_msgid += 1
+            self.msgq.append(msg)
+            self.msgq_bytes += len(msg)
+
+    def xmit_move(self) -> int:
+        """Move msgq → xmit_msgq under lock; returns moved count."""
+        with self.lock:
+            n = len(self.msgq)
+            if n:
+                self.xmit_msgq.extend(self.msgq)
+                self.msgq.clear()
+                self.msgq_bytes = 0
+            return n
+
+    def insert_retry(self, msgs: list[Message]) -> None:
+        """Requeue retried messages preserving msgid (FIFO) order
+        (reference: rd_kafka_msgq_insert_msgq order-preserving merge)."""
+        with self.lock:
+            merged = sorted(list(msgs) + list(self.xmit_msgq),
+                            key=lambda m: m.msgid)
+            self.xmit_msgq = deque(merged)
+
+    def total_queued(self) -> int:
+        with self.lock:
+            return len(self.msgq) + len(self.xmit_msgq)
+
+    def __repr__(self):
+        return f"Toppar({self.topic}[{self.partition}])"

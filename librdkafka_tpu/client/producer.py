@@ -1,0 +1,76 @@
+"""Public Producer API (reference: rd_kafka_producev / rd_kafka_produce,
+src/rdkafka_msg.c:241-478, plus flush/purge from rdkafka.c)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .conf import Conf
+from .kafka import Kafka, PRODUCER
+from .msg import PARTITION_UA
+
+
+class Producer:
+    """
+    >>> p = Producer({"bootstrap.servers": "...", "linger.ms": 5})
+    >>> p.produce("topic", b"value", key=b"k", on_delivery=cb)
+    >>> p.flush()
+    """
+
+    def __init__(self, conf):
+        if isinstance(conf, dict):
+            c = Conf()
+            dr = conf.pop("on_delivery", None)
+            c.update(conf)
+            if dr:
+                c.set("dr_msg_cb", dr)
+            conf = c
+        self._rk = Kafka(conf, PRODUCER)
+
+    def produce(self, topic: str, value: Optional[bytes] = None,
+                key: Optional[bytes] = None, partition: int = PARTITION_UA,
+                on_delivery=None, timestamp: int = 0, headers=(),
+                opaque=None) -> None:
+        if on_delivery is not None and not self._rk.conf.get("dr_msg_cb"):
+            self._rk.conf.set("dr_msg_cb", on_delivery)
+        if isinstance(value, str):
+            value = value.encode()
+        if isinstance(key, str):
+            key = key.encode()
+        self._rk.produce(topic, value=value, key=key, partition=partition,
+                         headers=headers, timestamp=timestamp, opaque=opaque)
+
+    def produce_batch(self, topic: str, msgs: list[dict],
+                      partition: int = PARTITION_UA) -> int:
+        """Batch produce (reference: rd_kafka_produce_batch,
+        rdkafka_msg.c:478). Returns number enqueued."""
+        n = 0
+        for m in msgs:
+            try:
+                self.produce(topic, value=m.get("value"), key=m.get("key"),
+                             partition=m.get("partition", partition),
+                             headers=m.get("headers", ()),
+                             timestamp=m.get("timestamp", 0))
+                n += 1
+            except Exception:
+                pass
+        return n
+
+    def poll(self, timeout: float = 0.0) -> int:
+        return self._rk.poll(timeout)
+
+    def flush(self, timeout: float = 10.0) -> int:
+        return self._rk.flush(timeout)
+
+    def purge(self, in_queue: bool = True, in_flight: bool = False) -> None:
+        self._rk.purge(in_queue, in_flight)
+
+    def __len__(self) -> int:
+        return self._rk.msg_cnt
+
+    def close(self, timeout: float = 5.0):
+        self._rk.close(timeout)
+
+    # escape hatch for tests / advanced use
+    @property
+    def rk(self) -> Kafka:
+        return self._rk

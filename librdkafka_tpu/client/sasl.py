@@ -1,0 +1,177 @@
+"""SASL authentication providers: PLAIN, SCRAM-SHA-256/512, OAUTHBEARER.
+
+The provider-vtable design mirrors struct rd_kafka_sasl_provider
+(src/rdkafka_sasl_int.h:32); the handshake bytes flow over the broker's
+normal request path via SaslHandshake + SaslAuthenticate requests
+(Kafka >= 1.0 framing). GSSAPI/Kerberos is not provided in this build
+(no libsasl2 dependency); selecting it raises _UNSUPPORTED_FEATURE.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import time
+from typing import TYPE_CHECKING, Optional
+
+from ..protocol.apis import APIS
+from ..protocol.proto import ApiKey
+from .errors import Err, KafkaError
+
+if TYPE_CHECKING:
+    from .broker import Broker
+    from .kafka import Kafka
+
+
+def sasl_client_start(rk: "Kafka", broker: "Broker") -> None:
+    mech = rk.conf.get("sasl.mechanisms").upper()
+    if mech == "PLAIN":
+        client = PlainClient(rk)
+    elif mech in ("SCRAM-SHA-256", "SCRAM-SHA-512"):
+        client = ScramClient(rk, mech)
+    elif mech == "OAUTHBEARER":
+        client = OauthBearerClient(rk)
+    else:
+        broker.sasl_done(KafkaError(
+            Err._UNSUPPORTED_FEATURE,
+            f"SASL mechanism {mech} not supported in this build"))
+        return
+    _handshake(rk, broker, mech, client)
+
+
+def _handshake(rk, broker, mech, client):
+    from .broker import Request
+
+    def on_handshake(err, resp):
+        if err is not None:
+            broker.sasl_done(err)
+            return
+        if resp["error_code"] != 0:
+            broker.sasl_done(KafkaError(
+                Err.from_wire(resp["error_code"]),
+                f"SASL {mech} rejected; broker supports "
+                f"{resp['mechanisms']}"))
+            return
+        _auth_step(rk, broker, client, client.first_message())
+
+    broker._xmit(Request(ApiKey.SaslHandshake, {"mechanism": mech},
+                         cb=on_handshake))
+
+
+def _auth_step(rk, broker, client, out_bytes: bytes):
+    from .broker import Request
+
+    def on_auth(err, resp):
+        if err is not None:
+            broker.sasl_done(err)
+            return
+        if resp["error_code"] != 0:
+            broker.sasl_done(KafkaError(
+                Err.from_wire(resp["error_code"]),
+                resp.get("error_message") or "SASL authentication failed"))
+            return
+        nxt = client.step(resp["auth_bytes"] or b"")
+        if nxt is None:
+            broker.sasl_done(None)       # authenticated
+        else:
+            _auth_step(rk, broker, client, nxt)
+
+    broker._xmit(Request(ApiKey.SaslAuthenticate, {"auth_bytes": out_bytes},
+                         cb=on_auth))
+
+
+class PlainClient:
+    """RFC 4616: [authzid] NUL authcid NUL passwd (rdkafka_sasl_plain.c)."""
+
+    def __init__(self, rk):
+        self.user = rk.conf.get("sasl.username")
+        self.passwd = rk.conf.get("sasl.password")
+
+    def first_message(self) -> bytes:
+        return b"\x00" + self.user.encode() + b"\x00" + self.passwd.encode()
+
+    def step(self, data: bytes) -> Optional[bytes]:
+        return None
+
+
+class ScramClient:
+    """RFC 5802 SCRAM (reference: rdkafka_sasl_scram.c, 912 LoC)."""
+
+    def __init__(self, rk, mech: str):
+        self.user = rk.conf.get("sasl.username")
+        self.passwd = rk.conf.get("sasl.password").encode()
+        self.hashname = "sha256" if mech.endswith("256") else "sha512"
+        self.nonce = base64.b64encode(os.urandom(24)).decode()
+        self.client_first_bare = f"n={self._saslname(self.user)},r={self.nonce}"
+        self.server_first = ""
+        self.state = 0
+
+    @staticmethod
+    def _saslname(s: str) -> str:
+        return s.replace("=", "=3D").replace(",", "=2C")
+
+    def first_message(self) -> bytes:
+        return ("n,," + self.client_first_bare).encode()
+
+    def step(self, data: bytes) -> Optional[bytes]:
+        if self.state == 0:
+            self.state = 1
+            self.server_first = data.decode()
+            fields = dict(kv.split("=", 1) for kv in self.server_first.split(","))
+            r, s, i = fields["r"], fields["s"], int(fields["i"])
+            if not r.startswith(self.nonce):
+                raise ValueError("SCRAM server nonce mismatch")
+            salted = hashlib.pbkdf2_hmac(self.hashname, self.passwd,
+                                         base64.b64decode(s), i)
+            client_key = hmac.new(salted, b"Client Key", self.hashname).digest()
+            stored_key = hashlib.new(self.hashname, client_key).digest()
+            cfinal_bare = f"c={base64.b64encode(b'n,,').decode()},r={r}"
+            auth_msg = ",".join([self.client_first_bare, self.server_first,
+                                 cfinal_bare]).encode()
+            sig = hmac.new(stored_key, auth_msg, self.hashname).digest()
+            proof = bytes(a ^ b for a, b in zip(client_key, sig))
+            server_key = hmac.new(salted, b"Server Key", self.hashname).digest()
+            self.server_sig = base64.b64encode(
+                hmac.new(server_key, auth_msg, self.hashname).digest()).decode()
+            return (cfinal_bare + ",p=" +
+                    base64.b64encode(proof).decode()).encode()
+        if self.state == 1:
+            self.state = 2
+            fields = dict(kv.split("=", 1) for kv in data.decode().split(","))
+            if fields.get("v") != self.server_sig:
+                raise ValueError("SCRAM server signature mismatch")
+            return None
+        return None
+
+
+class OauthBearerClient:
+    """OAUTHBEARER with the builtin unsecured-JWS token handler
+    (reference: rdkafka_sasl_oauthbearer.c unsecured JWS builder)."""
+
+    def __init__(self, rk):
+        self.rk = rk
+        cfg = dict(kv.split("=", 1) for kv in
+                   rk.conf.get("sasl.oauthbearer.config").split(",") if "=" in kv)
+        self.principal = cfg.get("principal", rk.conf.get("sasl.username")
+                                 or "user")
+        self.token = self._unsecured_jws(self.principal,
+                                         int(cfg.get("lifeSeconds", "3600")))
+
+    @staticmethod
+    def _b64url(b: bytes) -> str:
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    def _unsecured_jws(self, principal: str, life: int) -> str:
+        import json
+        now = int(time.time())
+        header = self._b64url(json.dumps({"alg": "none"}).encode())
+        claims = self._b64url(json.dumps(
+            {"sub": principal, "iat": now, "exp": now + life}).encode())
+        return f"{header}.{claims}."
+
+    def first_message(self) -> bytes:
+        return (f"n,,\x01auth=Bearer {self.token}\x01\x01").encode()
+
+    def step(self, data: bytes) -> Optional[bytes]:
+        return None

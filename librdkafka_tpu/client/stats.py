@@ -1,0 +1,109 @@
+"""Statistics: windowed averages with percentiles + the JSON stats blob.
+
+Reference: rd_avg_t (src/rdavg.h) over HdrHistogram (rdhdrhistogram.c),
+emitted by rd_kafka_stats_emit_all (rdkafka.c:1473-1700) every
+statistics.interval.ms with the schema documented in STATISTICS.md.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:
+    from .kafka import Kafka
+
+
+class Avg:
+    """Windowed sample set with rollover + percentiles (rd_avg_t analog)."""
+
+    __slots__ = ("_samples", "_lock")
+
+    def __init__(self):
+        self._samples: list[float] = []
+        self._lock = threading.Lock()
+
+    def add(self, v: float):
+        with self._lock:
+            if len(self._samples) < 100000:
+                self._samples.append(v)
+
+    def rollover(self) -> dict:
+        with self._lock:
+            s, self._samples = self._samples, []
+        if not s:
+            return {"min": 0, "max": 0, "avg": 0, "sum": 0, "cnt": 0,
+                    "p50": 0, "p75": 0, "p90": 0, "p95": 0, "p99": 0,
+                    "p99_99": 0}
+        a = np.asarray(s)
+        q = np.percentile(a, [50, 75, 90, 95, 99, 99.99])
+        return {"min": int(a.min()), "max": int(a.max()),
+                "avg": int(a.mean()), "sum": int(a.sum()), "cnt": len(s),
+                "p50": int(q[0]), "p75": int(q[1]), "p90": int(q[2]),
+                "p95": int(q[3]), "p99": int(q[4]), "p99_99": int(q[5])}
+
+
+class StatsCollector:
+    """Aggregates counters from the client and renders the stats JSON."""
+
+    def __init__(self, rk: "Kafka"):
+        self.rk = rk
+        self.ts_start = time.time()
+        self.c_tx_msgs = 0
+        self.c_rx_msgs = 0
+        self.int_latency = Avg()      # produce() -> MessageSet write
+        self.codec_latency = Avg()    # batched codec provider call
+
+    def emit_json(self) -> str:
+        rk = self.rk
+        brokers = {}
+        for b in list(rk.brokers.values()):
+            brokers[b.name] = {
+                "name": b.name, "nodeid": b.nodeid, "state": b.state.value,
+                "tx": b.c_tx, "txbytes": b.c_tx_bytes,
+                "rx": b.c_rx, "rxbytes": b.c_rx_bytes,
+                "req_timeouts": b.c_req_timeouts,
+                "toppars": {f"{tp.topic}-{tp.partition}":
+                            {"topic": tp.topic, "partition": tp.partition}
+                            for tp in list(b.toppars)},
+            }
+        topics = {}
+        for (t, p), tp in list(rk._toppars.items()):
+            topics.setdefault(t, {"topic": t, "partitions": {}})
+            topics[t]["partitions"][str(p)] = {
+                "partition": p, "leader": tp.leader_id,
+                "msgq_cnt": len(tp.msgq), "xmit_msgq_cnt": len(tp.xmit_msgq),
+                "fetchq_cnt": tp.fetchq_cnt,
+                "fetch_state": tp.fetch_state.value,
+                "app_offset": tp.app_offset,
+                "stored_offset": tp.stored_offset,
+                "committed_offset": tp.committed_offset,
+                "hi_offset": tp.hi_offset,
+            }
+        blob = {
+            "name": rk.conf.get("client.id"),
+            "client_id": rk.conf.get("client.id"),
+            "type": rk.type,
+            "ts": int(time.time() * 1e6),
+            "time": int(time.time()),
+            "age": int((time.time() - self.ts_start) * 1e6),
+            "msg_cnt": rk.msg_cnt,
+            "msg_max": rk.conf.get("queue.buffering.max.messages"),
+            "txmsgs": self.c_tx_msgs, "rxmsgs": self.c_rx_msgs,
+            "int_latency": self.int_latency.rollover(),
+            "codec_latency": self.codec_latency.rollover(),
+            "brokers": brokers,
+            "topics": topics,
+        }
+        if rk.cgrp is not None:
+            blob["cgrp"] = {"state": rk.cgrp.join_state,
+                            "rebalance_cnt": rk.cgrp.rebalance_cnt,
+                            "assignment_size": len(rk.cgrp.assignment)}
+        if rk.idemp is not None:
+            blob["eos"] = {"idemp_state": rk.idemp.state,
+                           "producer_id": rk.idemp.pid,
+                           "producer_epoch": rk.idemp.epoch}
+        return json.dumps(blob)
