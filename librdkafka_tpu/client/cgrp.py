@@ -1,0 +1,383 @@
+"""Consumer group coordinator ("cgrp") state machine.
+
+Reference: src/rdkafka_cgrp.c (3547 LoC) — two nested FSMs driven from the
+main thread via serve() (rd_kafka_cgrp_serve, :3231): the coordinator
+query/connect FSM (states rdkafka_cgrp.h:61-79) and the join FSM
+(WAIT_JOIN → WAIT_SYNC → WAIT_ASSIGN_REBALANCE_CB → STARTED,
+rdkafka_cgrp.h:86-111). The elected leader runs the assignor
+(handle_JoinGroup :894 → assignor_run). Heartbeats (:1469) detect
+generation changes; max.poll.interval.ms is enforced here (:2742).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, TYPE_CHECKING
+
+from ..protocol.proto import ApiKey
+from .assignor import (ASSIGNORS, assignment_decode, assignment_encode,
+                       subscription_decode, subscription_encode)
+from .broker import Request
+from .errors import Err, KafkaError
+from .queue import Op, OpType
+
+if TYPE_CHECKING:
+    from .kafka import Kafka
+
+
+class ConsumerGroup:
+    def __init__(self, rk: "Kafka", group_id: str):
+        self.rk = rk
+        self.group_id = group_id
+        self.state = "init"            # coordinator FSM
+        self.join_state = "init"       # join FSM
+        self.coord_id = -1
+        self.member_id = ""
+        self.generation = -1
+        self.protocol = ""
+        self.subscription: list[str] = []
+        self.assignment: dict[str, list[int]] = {}
+        self.rebalance_cnt = 0
+        self.last_heartbeat = 0.0
+        self.last_coord_query = 0.0
+        self.last_poll = time.monotonic()
+        self.max_poll_exceeded = False
+        self._pending = False          # a request is in flight
+        self._wait_rebalance_cb = False
+        self._auto_commit_next = 0.0
+        self.terminated = False
+
+    # ------------------------------------------------------------ public --
+    def subscribe(self, topics: list[str]):
+        self.subscription = list(topics)
+        for t in topics:
+            if not t.startswith("^"):
+                self.rk.get_topic(t)
+        self.rejoin("subscribe")
+
+    def unsubscribe(self):
+        self.subscription = []
+        self._leave()
+
+    def poll_tick(self):
+        self.last_poll = time.monotonic()
+        self.max_poll_exceeded = False
+
+    def rejoin(self, reason: str):
+        self.rk.dbg("cgrp", f"rejoin: {reason}")
+        if self.join_state in ("started", "steady"):
+            self._trigger_rebalance_revoke()
+        self.join_state = "init"
+
+    # ------------------------------------------------------------- serve --
+    def serve(self):
+        """Called from the main thread loop (rd_kafka_cgrp_serve)."""
+        if self.terminated or not self.subscription:
+            return
+        now = time.monotonic()
+        # max.poll.interval.ms enforcement (reference :2742)
+        mpi = self.rk.conf.get("max.poll.interval.ms") / 1000.0
+        if (self.join_state == "steady" and not self.max_poll_exceeded
+                and now - self.last_poll > mpi):
+            self.max_poll_exceeded = True
+            self.rk.op_err(KafkaError(
+                Err._MAX_POLL_EXCEEDED,
+                f"application maximum poll interval ({int(mpi * 1000)}ms) "
+                "exceeded"))
+            self._leave()
+            return
+        if self.state != "up":
+            self._coord_query(now)
+            return
+        if self._pending:
+            return
+        if self.join_state == "init":
+            self._join()
+        elif self.join_state == "steady":
+            hb = self.rk.conf.get("heartbeat.interval.ms") / 1000.0
+            if now - self.last_heartbeat >= hb:
+                self._heartbeat()
+            self._serve_auto_commit(now)
+
+    # ------------------------------------------------- coordinator query --
+    def _coord_query(self, now: float):
+        if self._pending or now - self.last_coord_query < 0.5:
+            return
+        b = self.rk.any_up_broker()
+        if b is None:
+            return
+        self.last_coord_query = now
+        self._pending = True
+        self.state = "query-coord"
+        b.enqueue_request(Request(
+            ApiKey.FindCoordinator, {"key": self.group_id, "key_type": 0},
+            cb=self._handle_coord))
+
+    def _handle_coord(self, err, resp):
+        self._pending = False
+        if err is not None or resp["error_code"] != 0:
+            self.state = "init"
+            return
+        self.coord_id = resp["node_id"]
+        with self.rk._brokers_lock:
+            known = self.coord_id in self.rk.brokers
+        if not known:
+            self.rk.metadata_refresh("coordinator unknown")
+            self.state = "init"
+            return
+        self.state = "up"
+        self.rk.dbg("cgrp", f"coordinator is broker {self.coord_id}")
+
+    def _coord_broker(self):
+        with self.rk._brokers_lock:
+            b = self.rk.brokers.get(self.coord_id)
+        if b is None or not b.is_up():
+            self.state = "init"
+            return None
+        return b
+
+    # --------------------------------------------------------------- join --
+    def _join(self):
+        b = self._coord_broker()
+        if b is None:
+            return
+        self._pending = True
+        self.join_state = "wait-join"
+        names = self.rk.conf.get("partition.assignment.strategy").split(",")
+        meta = subscription_encode(
+            [t for t in self.subscription if not t.startswith("^")])
+        self.rk.dbg("cgrp", f"joining group {self.group_id!r} "
+                            f"member={self.member_id!r}")
+        b.enqueue_request(Request(
+            ApiKey.JoinGroup,
+            {"group_id": self.group_id,
+             "session_timeout": self.rk.conf.get("session.timeout.ms"),
+             "rebalance_timeout": self.rk.conf.get("max.poll.interval.ms"),
+             "member_id": self.member_id,
+             "protocol_type": "consumer",
+             "protocols": [{"name": n.strip(), "metadata": meta}
+                           for n in names if n.strip()]},
+            cb=self._handle_join,
+            abs_timeout=time.monotonic() +
+            self.rk.conf.get("max.poll.interval.ms") / 1000.0 + 5))
+
+    def _handle_join(self, err, resp):
+        self._pending = False
+        if err is not None:
+            self.join_state = "init"
+            return
+        ec = Err.from_wire(resp["error_code"])
+        if ec == Err.MEMBER_ID_REQUIRED:
+            self.member_id = resp["member_id"]
+            self.join_state = "init"
+            return
+        if ec in (Err.UNKNOWN_MEMBER_ID, Err.ILLEGAL_GENERATION):
+            self.member_id = ""
+            self.join_state = "init"
+            return
+        if ec == Err.NOT_COORDINATOR or ec == Err.COORDINATOR_NOT_AVAILABLE:
+            self.state = "init"
+            self.join_state = "init"
+            return
+        if ec != Err.NO_ERROR:
+            self.join_state = "init"
+            return
+        self.member_id = resp["member_id"]
+        self.generation = resp["generation_id"]
+        self.protocol = resp["protocol"]
+        is_leader = resp["leader_id"] == self.member_id
+        self.rk.dbg("cgrp", f"joined gen {self.generation} "
+                            f"{'as leader' if is_leader else ''}")
+        assignments = []
+        if is_leader:
+            assignments = self._run_assignor(resp["members"])
+        self._sync(assignments)
+
+    def _run_assignor(self, members: list[dict]) -> list[dict]:
+        """Leader-side assignment (reference: rd_kafka_assignor_run)."""
+        subs = {m["member_id"]:
+                subscription_decode(m["metadata"])["topics"]
+                for m in members}
+        all_topics = sorted({t for ts in subs.values() for t in ts})
+        # partition counts from metadata (refresh if missing)
+        with self.rk._metadata_lock:
+            parts = {t: len(self.rk.metadata["topics"].get(t, {}))
+                     for t in all_topics}
+        missing = [t for t, n in parts.items() if n == 0]
+        if missing:
+            self.rk.metadata_refresh(f"assignor needs {missing}")
+        fn = ASSIGNORS.get(self.protocol, ASSIGNORS["range"])
+        per_member = fn(subs, parts)
+        return [{"member_id": m,
+                 "assignment": assignment_encode(a)}
+                for m, a in per_member.items()]
+
+    def _sync(self, assignments: list[dict]):
+        b = self._coord_broker()
+        if b is None:
+            self.join_state = "init"
+            return
+        self._pending = True
+        self.join_state = "wait-sync"
+        b.enqueue_request(Request(
+            ApiKey.SyncGroup,
+            {"group_id": self.group_id, "generation_id": self.generation,
+             "member_id": self.member_id, "assignments": assignments},
+            cb=self._handle_sync))
+
+    def _handle_sync(self, err, resp):
+        self._pending = False
+        if err is not None:
+            self.join_state = "init"
+            return
+        ec = Err.from_wire(resp["error_code"])
+        if ec != Err.NO_ERROR:
+            if ec in (Err.UNKNOWN_MEMBER_ID,):
+                self.member_id = ""
+            self.join_state = "init"
+            return
+        new_assignment = assignment_decode(resp["assignment"] or b"")
+        self.rebalance_cnt += 1
+        self.last_heartbeat = time.monotonic()
+        self.rk.dbg("cgrp", f"assignment: {new_assignment}")
+        self._deliver_rebalance(Err._ASSIGN_PARTITIONS, new_assignment)
+
+    def _deliver_rebalance(self, code: Err, assignment: dict):
+        """Rebalance op to the app (or auto-apply)
+        (reference: rd_kafka_cgrp_rebalance → op to app queue)."""
+        consumer = self.rk.consumer
+        if self.rk.conf.get("rebalance_cb"):
+            self.join_state = "wait-assign-rebalance-cb"
+            self._wait_rebalance_cb = True
+            consumer.queue.push(Op(OpType.REBALANCE,
+                                   payload=(code, assignment)))
+        else:
+            if code == Err._ASSIGN_PARTITIONS:
+                consumer.apply_assignment(assignment)
+            else:
+                consumer.apply_assignment({})
+            self.join_state = "steady"
+
+    def rebalance_done(self, assigned: bool):
+        """Called after the app's assign()/unassign() in the rebalance cb."""
+        self._wait_rebalance_cb = False
+        self.join_state = "steady" if assigned else "init"
+
+    def _trigger_rebalance_revoke(self):
+        self._deliver_rebalance(Err._REVOKE_PARTITIONS, self.assignment)
+
+    # ---------------------------------------------------------- heartbeat --
+    def _heartbeat(self):
+        b = self._coord_broker()
+        if b is None:
+            return
+        self.last_heartbeat = time.monotonic()
+        b.enqueue_request(Request(
+            ApiKey.Heartbeat,
+            {"group_id": self.group_id, "generation_id": self.generation,
+             "member_id": self.member_id},
+            cb=self._handle_heartbeat))
+
+    def _handle_heartbeat(self, err, resp):
+        if err is not None:
+            return
+        ec = Err.from_wire(resp["error_code"])
+        if ec == Err.NO_ERROR:
+            return
+        if ec == Err.REBALANCE_IN_PROGRESS:
+            self.rk.dbg("cgrp", "group is rebalancing")
+            self._trigger_rebalance_revoke()
+            if not self._wait_rebalance_cb:
+                self.join_state = "init"
+        elif ec in (Err.UNKNOWN_MEMBER_ID, Err.ILLEGAL_GENERATION,
+                    Err.FENCED_INSTANCE_ID):
+            self.member_id = "" if ec == Err.UNKNOWN_MEMBER_ID else self.member_id
+            self.join_state = "init"
+        elif ec in (Err.NOT_COORDINATOR, Err.COORDINATOR_NOT_AVAILABLE):
+            self.state = "init"
+
+    # -------------------------------------------------------- auto commit --
+    def _serve_auto_commit(self, now: float):
+        if not self.rk.conf.get("enable.auto.commit"):
+            return
+        ival = self.rk.conf.get("auto.commit.interval.ms") / 1000.0
+        if now < self._auto_commit_next:
+            return
+        self._auto_commit_next = now + ival
+        offsets = self.rk.consumer.stored_offsets()
+        if offsets:
+            self.commit_offsets(offsets, None)
+
+    def commit_offsets(self, offsets: dict[tuple[str, int], int],
+                       cb) -> bool:
+        b = self._coord_broker()
+        if b is None:
+            if cb:
+                cb(KafkaError(Err._WAIT_COORD, "no coordinator"), None)
+            return False
+        by_topic: dict[str, list] = {}
+        for (t, p), off in offsets.items():
+            by_topic.setdefault(t, []).append(
+                {"partition": p, "offset": off, "metadata": None})
+
+        def on_commit(err, resp):
+            if err is None and self.rk.interceptors:
+                self.rk.interceptors.on_commit(offsets)
+            if err is None:
+                for tpc in resp["topics"]:
+                    for pres in tpc["partitions"]:
+                        tp = self.rk.get_toppar(tpc["topic"],
+                                                pres["partition"],
+                                                create=False)
+                        if tp is not None and pres["error_code"] == 0:
+                            tp.committed_offset = offsets.get(
+                                (tpc["topic"], pres["partition"]),
+                                tp.committed_offset)
+            if cb:
+                cb(err, resp)
+            occb = self.rk.conf.get("offset_commit_cb")
+            if occb:
+                occb(err, offsets)
+
+        b.enqueue_request(Request(
+            ApiKey.OffsetCommit,
+            {"group_id": self.group_id, "generation_id": self.generation,
+             "member_id": self.member_id, "retention_time": -1,
+             "topics": [{"topic": t, "partitions": ps}
+                        for t, ps in by_topic.items()]},
+            cb=on_commit, retries_left=2))
+        return True
+
+    def fetch_committed(self, tps: list[tuple[str, int]], cb) -> bool:
+        b = self._coord_broker()
+        if b is None:
+            return False
+        by_topic: dict[str, list] = {}
+        for t, p in tps:
+            by_topic.setdefault(t, []).append(p)
+        b.enqueue_request(Request(
+            ApiKey.OffsetFetch,
+            {"group_id": self.group_id,
+             "topics": [{"topic": t, "partitions": ps}
+                        for t, ps in by_topic.items()]},
+            cb=cb, retries_left=2))
+        return True
+
+    # --------------------------------------------------------------- leave --
+    def _leave(self):
+        b = self._coord_broker()
+        if b is not None and self.member_id:
+            b.enqueue_request(Request(
+                ApiKey.LeaveGroup,
+                {"group_id": self.group_id, "member_id": self.member_id},
+                cb=lambda e, r: None))
+        self.join_state = "init"
+        self.generation = -1
+        self.rk.consumer.apply_assignment({})
+
+    def terminate(self):
+        self.terminated = True
+        offsets = self.rk.consumer.stored_offsets()
+        if offsets and self.rk.conf.get("enable.auto.commit"):
+            self.commit_offsets(offsets, None)
+            time.sleep(0.05)  # give the commit a beat to transmit
+        self._leave()

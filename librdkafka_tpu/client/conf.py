@@ -386,8 +386,22 @@ class _ConfBase:
 
 
 class Conf(_ConfBase):
-    """Global client configuration (reference: rd_kafka_conf_t)."""
+    """Global client configuration (reference: rd_kafka_conf_t).
+
+    Topic-scoped properties set here fall through to the default topic
+    config (the reference's conf fallthrough behavior)."""
     _scope = GLOBAL
+
+    def set(self, name: str, value: Any) -> None:
+        prop = _BY_NAME.get(name)
+        if prop is not None and prop.scope == TOPIC:
+            tc = super().get("default_topic_conf")
+            if tc is None:
+                tc = TopicConf()
+                super().set("default_topic_conf", tc)
+            tc.set(name, value)
+            return
+        super().set(name, value)
 
     def topic_conf(self) -> "TopicConf":
         tc = self.get("default_topic_conf")

@@ -1,0 +1,359 @@
+"""Public Consumer API: balanced KafkaConsumer + simple consumer.
+
+Reference: the KafkaConsumer API surface of rdkafka.h (subscribe / poll /
+commit / assign / seek / pause / position / committed) built over the cgrp
+FSM, with all per-partition fetch queues forwarded into one consumer queue
+(rd_kafka_q_fwd_set, rdkafka_queue.c:127) so a single poll serves
+everything.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..protocol import proto
+from ..protocol.proto import ApiKey
+from .broker import Request
+from .conf import Conf
+from .cgrp import ConsumerGroup
+from .errors import Err, KafkaError, KafkaException
+from .kafka import CONSUMER, Kafka
+from .msg import Message
+from .partition import FetchState, Toppar
+from .queue import Op, OpQueue, OpType
+
+
+@dataclass
+class TopicPartition:
+    """Public topic+partition+offset tuple (rd_kafka_topic_partition_t)."""
+    topic: str
+    partition: int
+    offset: int = proto.OFFSET_INVALID
+    error: Optional[KafkaError] = None
+
+    def __hash__(self):
+        return hash((self.topic, self.partition))
+
+
+class Consumer:
+    def __init__(self, conf):
+        if isinstance(conf, dict):
+            c = Conf()
+            c.update(conf)
+            conf = c
+        self._rk = Kafka(conf, CONSUMER)
+        self._rk.consumer = self
+        self.queue = OpQueue("consumer")
+        group_id = conf.get("group.id")
+        self._rk.cgrp = ConsumerGroup(self._rk, group_id) if group_id else None
+        self._assignment: dict[tuple[str, int], Toppar] = {}
+        self._closed = False
+
+    # ---------------------------------------------------------- subscribe --
+    def subscribe(self, topics: list[str], on_assign=None, on_revoke=None):
+        if self._rk.cgrp is None:
+            raise KafkaException(Err._UNKNOWN_GROUP,
+                                 "subscribe requires group.id")
+        if on_assign or on_revoke:
+            self._rk.conf.set("rebalance_cb",
+                              self._make_rebalance_cb(on_assign, on_revoke))
+        self._rk.cgrp.subscribe(topics)
+
+    def _make_rebalance_cb(self, on_assign, on_revoke):
+        def cb(consumer, code, partitions):
+            if code == Err._ASSIGN_PARTITIONS:
+                if on_assign:
+                    on_assign(consumer, partitions)
+                else:
+                    consumer.assign(partitions)
+            else:
+                if on_revoke:
+                    on_revoke(consumer, partitions)
+                else:
+                    consumer.unassign()
+        return cb
+
+    def unsubscribe(self):
+        if self._rk.cgrp:
+            self._rk.cgrp.unsubscribe()
+
+    def subscription(self) -> list[str]:
+        return list(self._rk.cgrp.subscription) if self._rk.cgrp else []
+
+    # ------------------------------------------------------------- assign --
+    def assign(self, partitions: list[TopicPartition]):
+        assignment = {}
+        for tp in partitions:
+            assignment.setdefault(tp.topic, []).append(tp.partition)
+        self.apply_assignment(assignment,
+                              offsets={(tp.topic, tp.partition): tp.offset
+                                       for tp in partitions})
+        if self._rk.cgrp:
+            self._rk.cgrp.rebalance_done(assigned=True)
+
+    def unassign(self):
+        self.apply_assignment({})
+        if self._rk.cgrp:
+            self._rk.cgrp.rebalance_done(assigned=False)
+
+    def assignment(self) -> list[TopicPartition]:
+        return [TopicPartition(t, p, tp.app_offset)
+                for (t, p), tp in self._assignment.items()]
+
+    def apply_assignment(self, assignment: dict[str, list[int]],
+                         offsets: Optional[dict] = None):
+        """Start/stop fetchers to match the assignment (reference:
+        rd_kafka_cgrp_assign → toppar OP_FETCH_START)."""
+        rk = self._rk
+        new_keys = {(t, p) for t, ps in assignment.items() for p in ps}
+        # stop removed partitions
+        for key in list(self._assignment):
+            if key not in new_keys:
+                tp = self._assignment.pop(key)
+                tp.fetch_state = FetchState.STOPPED
+                tp.version += 1
+                tp.fetchq.forward_to(None)
+        if rk.cgrp:
+            rk.cgrp.assignment = assignment
+        if not new_keys:
+            return
+        # gather committed offsets if in a group
+        need = [k for k in new_keys if k not in self._assignment]
+        explicit = offsets or {}
+
+        def start(committed: dict):
+            for key in need:
+                t, p = key
+                tp = rk.get_toppar(t, p)
+                self._assignment[key] = tp
+                tp.fetchq.forward_to(self.queue)
+                off = explicit.get(key, proto.OFFSET_INVALID)
+                if off < 0:
+                    off = committed.get(key, proto.OFFSET_INVALID)
+                if off >= 0:
+                    tp.fetch_offset = off
+                    tp.fetch_state = FetchState.ACTIVE
+                else:
+                    policy = rk.topic_conf_for(t).get("auto.offset.reset")
+                    tp.fetch_offset = (
+                        proto.OFFSET_BEGINNING
+                        if policy in ("smallest", "earliest", "beginning")
+                        else proto.OFFSET_END)
+                    tp.fetch_state = FetchState.OFFSET_QUERY
+                tp.version += 1
+                rk._wake_leader(tp)
+
+        if rk.cgrp and need:
+            done = {}
+
+            def on_fetched(err, resp):
+                committed = {}
+                if err is None:
+                    for tr in resp["topics"]:
+                        for pr in tr["partitions"]:
+                            if pr["error_code"] == 0 and pr["offset"] >= 0:
+                                committed[(tr["topic"], pr["partition"])] = \
+                                    pr["offset"]
+                start(committed)
+
+            if not rk.cgrp.fetch_committed(list(need), on_fetched):
+                start({})
+        else:
+            start({})
+
+    # --------------------------------------------------------------- poll --
+    def poll(self, timeout: float = 1.0) -> Optional[Message]:
+        if self._rk.cgrp:
+            self._rk.cgrp.poll_tick()
+        deadline = time.monotonic() + timeout
+        while True:
+            remain = deadline - time.monotonic()
+            op = self.queue.pop(max(0.0, min(remain, 0.1)))
+            if op is None:
+                if time.monotonic() >= deadline:
+                    return None
+                continue
+            msg = self._serve_op(op)
+            if msg is not None:
+                return msg
+            if time.monotonic() >= deadline:
+                return None
+
+    def consume(self, num_messages: int = 1, timeout: float = 1.0
+                ) -> list[Message]:
+        out = []
+        deadline = time.monotonic() + timeout
+        while len(out) < num_messages:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                break
+            m = self.poll(remain)
+            if m is None:
+                break
+            out.append(m)
+        return out
+
+    def _serve_op(self, op: Op) -> Optional[Message]:
+        rk = self._rk
+        if op.type == OpType.FETCH:
+            tp, msg, version = op.payload
+            if tp.version != version or (tp.topic, tp.partition) not in \
+                    self._assignment and rk.cgrp is not None:
+                return None   # stale: partition seeked/revoked since fetch
+            tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
+            tp.app_offset = msg.offset + 1
+            if rk.conf.get("enable.auto.offset.store"):
+                tp.stored_offset = msg.offset + 1
+            if rk.stats:
+                rk.stats.c_rx_msgs += 0  # counted at fetch parse
+            return msg
+        if op.type == OpType.CONSUMER_ERR:
+            tp, msg, version = op.payload
+            return msg if tp.version == version else None
+        if op.type == OpType.REBALANCE:
+            code, assignment = op.payload
+            cb = rk.conf.get("rebalance_cb")
+            parts = [TopicPartition(t, p) for t, ps in assignment.items()
+                     for p in ps]
+            if cb:
+                cb(self, code, parts)
+            return None
+        return None
+
+    # ------------------------------------------------------------ offsets --
+    def stored_offsets(self) -> dict[tuple[str, int], int]:
+        """Offsets pending commit (stored > committed)."""
+        out = {}
+        for key, tp in self._assignment.items():
+            if tp.stored_offset >= 0 and tp.stored_offset != tp.committed_offset:
+                out[key] = tp.stored_offset
+        return out
+
+    def store_offsets(self, message: Optional[Message] = None,
+                      offsets: Optional[list[TopicPartition]] = None):
+        if message is not None:
+            tp = self._assignment.get((message.topic, message.partition))
+            if tp:
+                tp.stored_offset = message.offset + 1
+        for tpo in offsets or []:
+            tp = self._assignment.get((tpo.topic, tpo.partition))
+            if tp:
+                tp.stored_offset = tpo.offset
+
+    def commit(self, message: Optional[Message] = None,
+               offsets: Optional[list[TopicPartition]] = None,
+               asynchronous: bool = False):
+        if self._rk.cgrp is None:
+            raise KafkaException(Err._UNKNOWN_GROUP, "commit requires group.id")
+        if message is not None:
+            to_commit = {(message.topic, message.partition): message.offset + 1}
+        elif offsets is not None:
+            to_commit = {(o.topic, o.partition): o.offset for o in offsets}
+        else:
+            to_commit = self.stored_offsets()
+        if not to_commit:
+            return None
+        if asynchronous:
+            self._rk.cgrp.commit_offsets(to_commit, None)
+            return None
+        done = []
+
+        def cb(err, resp):
+            done.append(err)
+
+        self._rk.cgrp.commit_offsets(to_commit, cb)
+        deadline = time.monotonic() + 10
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if done and done[0] is not None:
+            raise KafkaException(done[0])
+        return [TopicPartition(t, p, off)
+                for (t, p), off in to_commit.items()]
+
+    def committed(self, partitions: list[TopicPartition],
+                  timeout: float = 10.0) -> list[TopicPartition]:
+        if self._rk.cgrp is None:
+            raise KafkaException(Err._UNKNOWN_GROUP, "requires group.id")
+        result = {}
+        done = []
+
+        def cb(err, resp):
+            if err is None:
+                for tr in resp["topics"]:
+                    for pr in tr["partitions"]:
+                        result[(tr["topic"], pr["partition"])] = pr["offset"]
+            done.append(err)
+
+        self._rk.cgrp.fetch_committed(
+            [(p.topic, p.partition) for p in partitions], cb)
+        deadline = time.monotonic() + timeout
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return [TopicPartition(p.topic, p.partition,
+                               result.get((p.topic, p.partition),
+                                          proto.OFFSET_INVALID))
+                for p in partitions]
+
+    # ------------------------------------------------------ seek & pause --
+    def seek(self, partition: TopicPartition):
+        tp = self._assignment.get((partition.topic, partition.partition))
+        if tp is None:
+            raise KafkaException(Err._STATE, "partition not assigned")
+        tp.version += 1
+        tp.fetchq.pop_all()
+        tp.fetchq_cnt = 0
+        if partition.offset in (proto.OFFSET_BEGINNING, proto.OFFSET_END):
+            tp.fetch_offset = partition.offset
+            tp.fetch_state = FetchState.OFFSET_QUERY
+        else:
+            tp.fetch_offset = partition.offset
+            tp.fetch_state = FetchState.ACTIVE
+        self._rk._wake_leader(tp)
+
+    def pause(self, partitions: list[TopicPartition]):
+        for p in partitions:
+            tp = self._assignment.get((p.topic, p.partition))
+            if tp:
+                tp.paused = True
+
+    def resume(self, partitions: list[TopicPartition]):
+        for p in partitions:
+            tp = self._assignment.get((p.topic, p.partition))
+            if tp:
+                tp.paused = False
+                self._rk._wake_leader(tp)
+
+    def position(self, partitions: list[TopicPartition]
+                 ) -> list[TopicPartition]:
+        out = []
+        for p in partitions:
+            tp = self._assignment.get((p.topic, p.partition))
+            out.append(TopicPartition(p.topic, p.partition,
+                                      tp.app_offset if tp else
+                                      proto.OFFSET_INVALID))
+        return out
+
+    def get_watermark_offsets(self, partition: TopicPartition,
+                              timeout: float = 10.0) -> tuple[int, int]:
+        tp = self._rk.get_toppar(partition.topic, partition.partition)
+        deadline = time.monotonic() + timeout
+        while tp.hi_offset < 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return (0, tp.hi_offset)
+
+    def poll_kafka(self, timeout: float = 0.0) -> int:
+        return self._rk.poll(timeout)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._rk.cgrp:
+            self._rk.cgrp.terminate()
+        self.apply_assignment({})
+        self._rk.close()
+
+    @property
+    def rk(self) -> Kafka:
+        return self._rk

@@ -124,6 +124,7 @@ class Kafka:
         self.msg_cnt = 0                       # queue.buffering.max.messages
         self._msg_cnt_lock = threading.Lock()
         self.cgrp = None                       # set by Consumer
+        self.consumer = None                   # back-ref set by Consumer
         self.interceptors = conf.get("interceptors") or None
         self.mock_cluster = None
         self.stats = None                      # StatsCollector, set below
