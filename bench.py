@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Driver benchmark — the BASELINE.json headline config: producer msgs/sec
+at 1KB messages with lz4 compression (rdkafka_performance -P equivalent,
+reference examples/rdkafka_performance.c:555-644), full client pipeline
+against the in-process mock cluster.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <tpu msgs/sec>, "unit": "msgs/s",
+   "vs_baseline": <tpu_rate / cpu_rate>}
+
+vs_baseline is the speedup of the compression.backend=tpu pipeline over
+the same pipeline with the CPU codec provider (the reference-architecture
+path: per-batch sequential compress+CRC on the broker thread).
+Env knobs: BENCH_MSGS (default 40000), BENCH_MSG_SIZE (1024),
+BENCH_TOPPARS (16 partitions — the batch-offload axis).
+"""
+import json
+import os
+import sys
+import time
+
+
+def _payloads(n: int, size: int) -> list[bytes]:
+    # semi-compressible 1KB payloads (json-ish), like real event streams
+    out = []
+    base = (b'{"seq": %07d, "user": "u%05d", "event": "click", '
+            b'"props": "abcdefghijklmnopqrstuvwxyz0123456789"}')
+    for i in range(n):
+        b = base % (i, i % 1000)
+        out.append((b * (size // len(b) + 1))[:size])
+    return out
+
+
+def run(backend: str, n_msgs: int, size: int, toppars: int) -> float:
+    from librdkafka_tpu import Producer
+
+    p = Producer({
+        "bootstrap.servers": "", "test.mock.num.brokers": 1,
+        "compression.backend": backend,
+        "compression.codec": "lz4",
+        "batch.num.messages": 10000,
+        "linger.ms": 50,
+        "queue.buffering.max.messages": 2_000_000,
+        "tpu.launch.min.batches": 2,
+    })
+    vals = _payloads(n_msgs, size)
+    # warmup: trigger jit compiles for the padded sizes + socket path
+    for i in range(2000):
+        p.produce("bench", value=vals[i % len(vals)], partition=i % toppars)
+    if p.flush(600.0) != 0:
+        raise RuntimeError("warmup flush did not drain")
+
+    t0 = time.perf_counter()
+    for i, v in enumerate(vals):
+        p.produce("bench", value=v, partition=i % toppars)
+    if p.flush(600.0) != 0:
+        raise RuntimeError("bench flush did not drain")
+    dt = time.perf_counter() - t0
+    p.close()
+    return n_msgs / dt
+
+
+def main():
+    n_msgs = int(os.environ.get("BENCH_MSGS", 40000))
+    size = int(os.environ.get("BENCH_MSG_SIZE", 1024))
+    toppars = int(os.environ.get("BENCH_TOPPARS", 16))
+    cpu_rate = run("cpu", n_msgs, size, toppars)
+    tpu_rate = run("tpu", n_msgs, size, toppars)
+    print(json.dumps({
+        "metric": "producer throughput, 1KB msgs, lz4, %d toppars "
+                  "(tpu codec offload vs cpu provider)" % toppars,
+        "value": round(tpu_rate, 1),
+        "unit": "msgs/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,3 +25,5 @@ REFERENCE_VERSION = "1.3.0"
 
 from .client.errors import KafkaError, KafkaException  # noqa: F401
 from .client.conf import Conf, TopicConf  # noqa: F401
+from .client.producer import Producer  # noqa: F401
+from .client.consumer import Consumer  # noqa: F401

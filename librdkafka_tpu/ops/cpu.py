@@ -99,12 +99,16 @@ def lz4_compress(data: bytes) -> bytes:
 
 def lz4_decompress(data: bytes, size_hint: int = 0) -> bytes:
     data = bytes(data)
+    # hard ceiling: LZ4 cannot expand beyond ~255x input, so corruption
+    # that masquerades as a capacity shortfall (-4) fails after one grow
+    # instead of ballooning toward a fixed 1GB cap
+    limit = 255 * len(data) + (1 << 16)
     cap = max(size_hint, 4 * len(data) + (1 << 16))
     while True:
         buf, p = _outbuf(cap)
         r = lib().tk_lz4f_decompress(data, len(data), p, cap)
-        if r == -4 and cap < (1 << 30):  # output too small: grow and retry
-            cap *= 4
+        if r == -4 and cap < limit:      # output too small: grow and retry
+            cap = min(cap * 4, limit)
             continue
         if r < 0:
             raise ValueError(f"lz4 frame decompress failed ({r})")

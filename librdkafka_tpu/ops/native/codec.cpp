@@ -187,7 +187,8 @@ static int64_t lz4_block_decompress_hist(const uint8_t *src, int64_t n,
             uint8_t b;
             do { if (i >= n) return -1; b = src[i++]; lit += b; } while (b == 255);
         }
-        if (i + lit > n || o + lit > cap) return -1;
+        if (i + lit > n) return -1;
+        if (o + lit > cap) return -4;
         memcpy(dst + o, src + i, lit); i += lit; o += lit;
         if (i == n) break;            // last sequence: literals only
         if (i + 2 > n) return -1;
@@ -198,7 +199,7 @@ static int64_t lz4_block_decompress_hist(const uint8_t *src, int64_t n,
             uint8_t b;
             do { if (i >= n) return -1; b = src[i++]; mlen += b; } while (b == 255);
         }
-        if (o + mlen > cap) return -1;
+        if (o + mlen > cap) return -4;
         const uint8_t *m = dst + o - off;
         for (int64_t k = 0; k < mlen; k++) dst[o + k] = m[k];  // overlap-safe
         o += mlen;
@@ -288,7 +289,7 @@ EXPORT int64_t tk_lz4f_decompress(const uint8_t *src, int64_t n,
         } else {
             int64_t dsz = lz4_block_decompress_hist(src + i, bsz, dst + o,
                                                     cap - o, o);
-            if (dsz < 0) return -5;
+            if (dsz < 0) return dsz == -4 ? -4 : -5;
             o += dsz;
         }
         i += bsz;

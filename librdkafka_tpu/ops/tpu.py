@@ -1,0 +1,101 @@
+"""TPU codec provider — the north-star offload (SURVEY.md §7 stage 5).
+
+Replaces the broker-thread compression + CRC hot loops of the reference
+(rdkafka_msgset_writer.c:1129 writer_compress, crc32c.c:39) with batched
+device launches:
+
+  * lz4: every ≤64KB frame block of every partition batch is compressed in
+    ONE vmapped launch (ops/lz4_jax.py); frames are assembled host-side
+    byte-identically to the CPU provider (ops/native/codec.cpp
+    tk_lz4f_compress — magic | FLG 0x60 | BD 0x40 | HC | blocks | EndMark,
+    incompressible blocks stored raw with the high bit set).
+  * crc32c: chunk-parallel + GF(2) combine (ops/crc32c_jax.py).
+  * gzip/zstd entropy coding and snappy stay on the CPU provider behind the
+    same interface for now (SURVEY.md §7 risk list: entropy stages last).
+
+Wire bytes are bit-identical to the CPU provider by construction; the
+equivalence suite is tests/test_0018_tpu_codec.py.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from . import cpu as _cpu
+from .crc32c_jax import crc32c_many as _crc32c_many_jax
+from .lz4_jax import lz4_block_compress_many
+
+LZ4F_MAGIC = 0x184D2204
+LZ4F_BLOCKSIZE = 65536
+
+_HC = None
+
+
+def _frame_hc() -> int:
+    """Header-checksum byte: (xxh32(FLG|BD) >> 8) & 0xFF — a constant."""
+    global _HC
+    if _HC is None:
+        _HC = (_cpu.xxh32(b"\x60\x40", 0) >> 8) & 0xFF
+    return _HC
+
+
+class TpuCodecProvider:
+    """MsgsetCodecProvider with device-offloaded lz4 + crc32c."""
+
+    name = "tpu"
+
+    def __init__(self, min_batches: int = 4):
+        # below this many independent buffers a launch isn't worth it;
+        # fall back to the CPU provider (identical bytes either way).
+        self.min_batches = max(1, int(min_batches))
+        self._cpu = _cpu.CpuCodecProvider()
+
+    # -------------------------------------------------------------- lz4 --
+
+    def _lz4f_compress_many(self, bufs: list[bytes]) -> list[bytes]:
+        # flatten: every 64KB block of every buffer is one device-batch item
+        blocks: list[bytes] = []
+        spans: list[tuple[int, int]] = []      # (first_block, nblocks) per buf
+        for b in bufs:
+            b = bytes(b)
+            first = len(blocks)
+            for pos in range(0, len(b), LZ4F_BLOCKSIZE):
+                blocks.append(b[pos:pos + LZ4F_BLOCKSIZE])
+            spans.append((first, len(blocks) - first))
+
+        cblocks = lz4_block_compress_many(blocks)
+
+        out = []
+        hdr = struct.pack("<IBBB", LZ4F_MAGIC, 0x60, 0x40, _frame_hc())
+        for first, nb in spans:
+            parts = [hdr]
+            for k in range(nb):
+                raw = blocks[first + k]
+                comp = cblocks[first + k]
+                if len(comp) < len(raw):
+                    parts.append(struct.pack("<I", len(comp)))
+                    parts.append(comp)
+                else:                      # incompressible: store raw
+                    parts.append(struct.pack("<I", len(raw) | 0x80000000))
+                    parts.append(raw)
+            parts.append(b"\x00\x00\x00\x00")  # EndMark
+            out.append(b"".join(parts))
+        return out
+
+    # -------------------------------------------------------- interface --
+
+    def compress_many(self, codec: str, bufs: list[bytes], level: int = -1
+                      ) -> list[bytes]:
+        if codec == "lz4" and len(bufs) >= self.min_batches:
+            return self._lz4f_compress_many(bufs)
+        return self._cpu.compress_many(codec, bufs, level)
+
+    def decompress_many(self, codec: str, bufs: list[bytes],
+                        size_hints: list[int] | None = None) -> list[bytes]:
+        return self._cpu.decompress_many(codec, bufs, size_hints)
+
+    def crc32c_many(self, bufs: list[bytes]) -> list[int]:
+        if len(bufs) >= self.min_batches:
+            return [int(x) for x in _crc32c_many_jax(bufs)]
+        return self._cpu.crc32c_many(bufs)

@@ -1,11 +1,23 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip hardware is not available in CI; sharding tests run on
-xla_force_host_platform_device_count=8 per the driver contract.
+Multi-chip hardware is not available in CI; sharding tests run on 8
+virtual CPU devices per the driver contract.  NOTE: under the axon TPU
+tunnel the JAX_PLATFORMS / XLA_FLAGS *environment variables are ignored*
+— only the jax.config API takes effect, and only before the backend
+initializes (so this must run before any test imports jax).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass  # backend already initialized (e.g. single-test re-entry)

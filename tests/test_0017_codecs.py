@@ -196,3 +196,12 @@ def test_xxh32_known_vectors():
     # public xxHash reference vectors
     assert cpu.xxh32(b"", 0) == 0x02CC5D05
     assert cpu.xxh32(b"Hello World", 0) == 0xB1FD16EE
+
+
+def test_lz4_decompress_growth_no_hint():
+    """Regression: frames decoding to >4x+64KB must grow-and-retry (the
+    native decoder returns -4, not a corruption error, on capacity
+    shortfall mid-block) — found driving a 200KB all-'x' record e2e."""
+    data = b"x" * 200_000
+    comp = cpu.lz4_compress(data)
+    assert cpu.lz4_decompress(comp) == data
