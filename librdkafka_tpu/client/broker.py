@@ -428,14 +428,22 @@ class Broker:
             if tp.leader_id != self.nodeid:
                 continue
             tp.xmit_move()
-            if not tp.xmit_msgq:
-                continue
             # idempotence / backpressure gates
             max_inflight = (IDEMP_MAX_INFLIGHT if rk.idemp else
                             rk.conf.get("max.in.flight.requests.per.connection"))
-            if tp.inflight >= max_inflight:
-                continue
             if rk.idemp and not rk.idemp.can_produce():
+                continue
+            # frozen retry batches resend first, membership intact, and
+            # block new batch formation until drained (ordering)
+            planned = 0
+            while tp.retry_batches and tp.inflight + planned < max_inflight:
+                with tp.lock:
+                    msgs = list(tp.retry_batches.popleft())
+                ready.append((tp, msgs, self._make_writer(tp, msgs, codec)))
+                planned += 1
+            if tp.retry_batches or tp.inflight + planned >= max_inflight:
+                continue
+            if not tp.xmit_msgq:
                 continue
             # linger gate (rdkafka_broker.c:3453-3470)
             oldest = tp.xmit_msgq[0]
@@ -498,6 +506,8 @@ class Broker:
         tconf = rk.topic_conf_for(tp.topic)
         acks = tconf.get("request.required.acks")
         tp.inflight += 1
+        with tp.lock:
+            tp.inflight_msgids.add(msgs[0].msgid)
         for m in msgs:
             m.status = MsgStatus.POSSIBLY_PERSISTED
             m.latency_us = int((now - m.enq_time) * 1e6)
@@ -513,10 +523,11 @@ class Broker:
         self._xmit(req)
         if acks == 0:
             tp.inflight -= 1
+            with tp.lock:
+                tp.inflight_msgids.discard(msgs[0].msgid)
             for m in msgs:
                 m.offset = -1
-                rk.dr_msgq(msgs, None)
-                break
+            rk.dr_msgq(msgs, None)
 
     def _handle_produce(self, tp, msgs: list[Message], err, resp):
         """Produce response → DR / retry / idempotence reconciliation
@@ -524,6 +535,8 @@ class Broker:
         error path :2415)."""
         rk = self.rk
         tp.inflight -= 1
+        with tp.lock:
+            tp.inflight_msgids.discard(msgs[0].msgid)
         if err is None:
             pres = resp["topics"][0]["partitions"][0]
             ec = Err.from_wire(pres["error_code"])
@@ -546,6 +559,23 @@ class Broker:
             rk.dr_msgq(msgs, None)
             return
         if rk.idemp and kerr.code == Err.OUT_OF_ORDER_SEQUENCE_NUMBER:
+            # If an EARLIER batch of this partition failed retriably, the
+            # broker rejects every in-flight successor with OUT_OF_ORDER —
+            # a consequent error: requeue in msgid order and let the head
+            # batch retry first.  Only a gap at the head of the line is a
+            # true unexplained sequence break needing drain + epoch bump
+            # (reference: rd_kafka_handle_Produce_error, rdkafka_request.c
+            # :2415 — "successor batch" reconciliation vs fatal gap).
+            with tp.lock:
+                pending_earlier = (
+                    any(m.msgid < msgs[0].msgid for m in tp.xmit_msgq)
+                    or any(b[0].msgid < msgs[0].msgid
+                           for b in tp.retry_batches)
+                    or any(mid < msgs[0].msgid
+                           for mid in tp.inflight_msgids))
+            if pending_earlier:
+                tp.enqueue_retry_batch(msgs)
+                return
             rk.idemp.drain_bump(tp, msgs)
             return
         retriable = kerr.retriable
@@ -555,6 +585,17 @@ class Broker:
                              Err.LEADER_NOT_AVAILABLE,
                              Err.UNKNOWN_TOPIC_OR_PART):
                 rk.metadata_refresh(reason=f"produce error {kerr.code.name}")
+            if rk.idemp:
+                # keep the batch frozen: membership must survive the retry
+                # for (BaseSequence, count) dup detection; budget is judged
+                # on the batch head
+                if msgs[0].retries < max_retries:
+                    for m in msgs:
+                        m.retries += 1
+                    tp.enqueue_retry_batch(msgs)
+                else:
+                    rk.dr_msgq(msgs, kerr)
+                return
             retry = [m for m in msgs if m.retries < max_retries]
             fail = [m for m in msgs if m.retries >= max_retries]
             for m in retry:

@@ -59,6 +59,24 @@ class IdempotenceManager:
 
     def serve(self):
         with self._lock:
+            if self.state == "DRAIN":
+                # wait for every in-flight ProduceRequest to resolve, then
+                # rebase each toppar's sequence origin to its oldest
+                # unacked message and fetch a fresh PID (reference
+                # DRAIN_BUMP → REQ_PID, rdkafka_idempotence.c:374-440)
+                with self.rk._toppars_lock:
+                    tps = list(self.rk._toppars.values())
+                if any(t.inflight > 0 for t in tps):
+                    return
+                for t in tps:
+                    with t.lock:
+                        pending = [m.msgid
+                                   for b in t.retry_batches for m in b]
+                        pending += [m.msgid for m in t.xmit_msgq]
+                        pending += [m.msgid for m in t.msgq]
+                    t.epoch_base_msgid = (min(pending, default=t.next_msgid)
+                                          - 1)
+                self.state = "INIT"
             if self.state in ("INIT", "RETRY"):
                 broker = self.rk.any_up_broker()
                 if broker is None:
@@ -81,19 +99,14 @@ class IdempotenceManager:
             self.rk.dbg("eos", f"assigned PID {self.pid} epoch {self.epoch}")
 
     def drain_bump(self, tp, msgs):
-        """Sequence gap: drain, acquire a new PID, reset per-toppar seq
-        bases, requeue (reference :374-440)."""
+        """True sequence gap: stop producing, requeue the failed batch
+        frozen, and enter DRAIN — serve() acquires a new PID and rebases
+        sequence origins once every in-flight request has resolved
+        (reference :374-440)."""
         with self._lock:
             self.rk.dbg("eos", f"drain+bump after seq error on {tp}")
-            self.state = "INIT"
-        tp.insert_retry(msgs)
-        with self.rk._toppars_lock:
-            tps = list(self.rk._toppars.values())
-        for t in tps:
-            with t.lock:
-                first = min((m.msgid for m in list(t.xmit_msgq) +
-                             list(t.msgq)), default=t.next_msgid)
-                t.epoch_base_msgid = first - 1
+            self.state = "DRAIN"
+        tp.enqueue_retry_batch(msgs)
         self.serve()
 
 

@@ -44,6 +44,8 @@ class Toppar:
         self.next_msgid = 1
         self.epoch_base_msgid = 0                  # idempotence seq base
         self.inflight = 0                          # in-flight ProduceRequests
+        self.inflight_msgids: set[int] = set()     # first msgid per in-flight batch
+        self.retry_batches: deque[list[Message]] = deque()  # frozen retries
         self.leader_id: int = -1
         self.ts_last_xmit = 0.0
 
@@ -89,6 +91,18 @@ class Toppar:
             merged = sorted(list(msgs) + list(self.xmit_msgq),
                             key=lambda m: m.msgid)
             self.xmit_msgq = deque(merged)
+
+    def enqueue_retry_batch(self, msgs: list[Message]) -> None:
+        """Requeue a failed produce batch FROZEN — original membership and
+        order — so a resend carries the same (BaseSequence, record_count)
+        and broker-side idempotent dup detection stays sound.  The
+        reference likewise never re-slices a retried batch (the msgset is
+        rebuilt from the same message run, rdkafka_msgset_writer.c)."""
+        with self.lock:
+            self.retry_batches.append(list(msgs))
+            if len(self.retry_batches) > 1:
+                self.retry_batches = deque(
+                    sorted(self.retry_batches, key=lambda b: b[0].msgid))
 
     def total_queued(self) -> int:
         with self.lock:

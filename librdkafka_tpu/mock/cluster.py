@@ -413,7 +413,11 @@ class MockCluster:
                     err = Err.NO_ERROR
                     base = -1
                     part = None
-                    if inject:
+                    # REQUEST_TIMED_OUT injection emulates "broker committed
+                    # but the response was lost": append, THEN error — the
+                    # scenario behind idempotent dup-seq handling (reference
+                    # test 0094-idempotence_msg_timeout)
+                    if inject and inject != Err.REQUEST_TIMED_OUT:
                         err = inject
                     elif t["topic"] not in self.topics or \
                             p["partition"] >= len(self.topics[t["topic"]]):
@@ -426,6 +430,8 @@ class MockCluster:
                     if part is not None:
                         blob = p["records"]
                         err, base = self._produce_to(part, blob)
+                        if inject:
+                            err, base = inject, -1
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
                          "base_offset": base, "log_append_time": -1})

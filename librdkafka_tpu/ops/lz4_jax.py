@@ -133,7 +133,6 @@ def _lz4_block_one(data, n, N: int):
     total_out = total_seq + 1 + efl + final_lit
 
     # --- compact sequences into dense tables (+ pseudo-seq for final run)
-    BIG = I32(C + 1)
     di = jnp.where(match_here, jnp.cumsum(match_here.astype(I32)) - 1, D - 1)
 
     def dense(vals, junk, pseudo=None):

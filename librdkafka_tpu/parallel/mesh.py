@@ -27,6 +27,9 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), ("batch",))
 
 
+_STEP_CACHE: dict = {}
+
+
 def sharded_codec_step(mesh: Mesh, N: int):
     """Build the jitted multi-chip codec step for (B, N) blocks.
 
@@ -36,6 +39,10 @@ def sharded_codec_step(mesh: Mesh, N: int):
        total_out_bytes scalar — psum of valid rows across the mesh).
     B must be a multiple of the mesh size.
     """
+    key = (tuple(d.id for d in mesh.devices.flat), N)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
     K, L = _pick_kl(N)
     shift_tab = _shift_tables(L)
 
@@ -58,7 +65,9 @@ def sharded_codec_step(mesh: Mesh, N: int):
         in_specs=(P("batch", None), P("batch"), P("batch")),
         out_specs=(P("batch", None), P("batch"), P("batch"), P()),
         check_vma=False)
-    return jax.jit(shard)
+    fn = jax.jit(shard)
+    _STEP_CACHE[key] = fn
+    return fn
 
 
 def shard_compress(mesh: Mesh, blocks: list[bytes]):

@@ -1,0 +1,167 @@
+"""Idempotent producer (EOS v1) integration tests — analogs of the
+reference's 0090-idempotence.c and 0094-idempotence_msg_timeout.c:
+retriable produce errors and lost responses must yield exactly-once,
+in-order logs (PID/epoch/BaseSequence dedup at the broker,
+reference src/rdkafka_idempotence.c + rdkafka_msgset_writer.c:397,1288).
+"""
+import time
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.client.errors import Err
+from librdkafka_tpu.ops import cpu
+from librdkafka_tpu.protocol.msgset import iter_batches, parse_records_v2
+
+
+def _log_values(cluster, topic, part):
+    out = []
+    last_seq = None
+    for _base, blob in cluster.partition(topic, part).log:
+        for info, payload, _full in iter_batches(bytes(blob)):
+            if info.codec:
+                payload = cpu.lz4_decompress(payload)
+            assert info.producer_id >= 1, "idempotent batch must carry PID"
+            assert info.base_sequence >= 0
+            if last_seq is not None:
+                assert info.base_sequence == last_seq, (
+                    f"sequence gap: {info.base_sequence} != {last_seq}")
+            last_seq = info.base_sequence + info.record_count
+            out.extend(r.value for r in parse_records_v2(info, payload))
+    return out
+
+
+def _make_producer(**extra):
+    conf = {"bootstrap.servers": "", "test.mock.num.brokers": 1,
+            "enable.idempotence": True, "linger.ms": 2,
+            "batch.num.messages": 50}
+    conf.update(extra)
+    return Producer(conf)
+
+
+def test_idempotent_basic_exactly_once_in_order():
+    p = _make_producer()
+    n = 1000
+    for i in range(n):
+        p.produce("eos", value=b"m%05d" % i, partition=0)
+    assert p.flush(30.0) == 0
+    vals = _log_values(p._rk.mock_cluster, "eos", 0)
+    assert vals == [b"m%05d" % i for i in range(n)]
+    p.close()
+
+
+def test_idempotent_retries_no_dup_no_gap():
+    """Errors rejected before append: client retries with the SAME
+    sequence; log must have no gaps or duplicates and preserve order."""
+    p = _make_producer()
+    cluster = p._rk.mock_cluster
+    from librdkafka_tpu.protocol.proto import ApiKey
+    p.produce("eos", value=b"warm", partition=0)
+    assert p.flush(30.0) == 0
+    # two consecutive rejects (no append), then success
+    cluster.push_request_errors(
+        ApiKey.Produce, [Err.NOT_LEADER_FOR_PARTITION,
+                         Err.LEADER_NOT_AVAILABLE])
+    n = 500
+    for i in range(n):
+        p.produce("eos", value=b"r%05d" % i, partition=0)
+    assert p.flush(60.0) == 0
+    vals = _log_values(cluster, "eos", 0)
+    assert vals == [b"warm"] + [b"r%05d" % i for i in range(n)]
+    p.close()
+
+
+def test_idempotent_lost_response_dedup():
+    """Commit-then-lost-response: the retry carries the same BaseSequence,
+    the broker answers DUPLICATE_SEQUENCE_NUMBER, and the producer treats
+    it as benign success — exactly one copy in the log, DR success."""
+    p = _make_producer()
+    cluster = p._rk.mock_cluster
+    from librdkafka_tpu.protocol.proto import ApiKey
+    drs = []
+    p._rk.conf.set("dr_msg_cb", lambda err, msg: drs.append(err))
+    p.produce("eos", value=b"warm", partition=0)
+    assert p.flush(30.0) == 0
+    cluster.push_request_errors(ApiKey.Produce, [Err.REQUEST_TIMED_OUT])
+    n = 200
+    for i in range(n):
+        p.produce("eos", value=b"d%05d" % i, partition=0)
+    assert p.flush(60.0) == 0
+    assert all(e is None for e in drs), [e for e in drs if e][:3]
+    vals = _log_values(cluster, "eos", 0)
+    assert vals == [b"warm"] + [b"d%05d" % i for i in range(n)]
+    p.close()
+
+
+def test_idempotent_multi_partition_sequences_independent():
+    p = _make_producer()
+    n = 300
+    for i in range(n):
+        p.produce("eos", value=b"p%05d" % i, partition=i % 4)
+    assert p.flush(30.0) == 0
+    cluster = p._rk.mock_cluster
+    got = []
+    for part in range(4):
+        vals = _log_values(cluster, "eos", part)
+        assert vals == [b"p%05d" % i for i in range(n) if i % 4 == part]
+        got.extend(vals)
+    assert len(got) == n
+    p.close()
+
+
+def test_idempotent_true_gap_drains_and_bumps_pid():
+    """A head-of-line sequence gap (no earlier pending batch) is a real
+    break: the producer drains, acquires a fresh PID, rebases sequences,
+    and delivers everything exactly once under the new PID (reference
+    drain/epoch-bump recovery, rdkafka_idempotence.c:347-440)."""
+    p = _make_producer()
+    cluster = p._rk.mock_cluster
+    p.produce("eos", value=b"warm", partition=0)
+    assert p.flush(30.0) == 0
+    part = cluster.partition("eos", 0)
+    with cluster._lock:
+        # roll broker-side expected seq BACKWARD: the next head batch sends
+        # base_seq above expected → OUT_OF_ORDER with nothing pending → gap
+        for key in list(part.pid_seqs):
+            part.pid_seqs[key] = 0
+    n = 100
+    for i in range(n):
+        p.produce("eos", value=b"g%05d" % i, partition=0)
+    assert p.flush(60.0) == 0
+    vals = []
+    pids = set()
+    for _base, blob in part.log:
+        for info, payload, _full in iter_batches(bytes(blob)):
+            pids.add(info.producer_id)
+            vals.extend(r.value for r in parse_records_v2(info, payload))
+    assert vals == [b"warm"] + [b"g%05d" % i for i in range(n)]
+    assert len(pids) == 2, f"expected a PID bump, saw {pids}"
+    p.close()
+
+
+def test_idempotent_partial_batch_lost_response_membership_frozen():
+    """Regression (review finding): a linger-expired PARTIAL batch whose
+    response is lost must be retried with its original membership — if the
+    retry were re-sliced to include newer queued messages, the broker's
+    DUPLICATE_SEQUENCE answer would mark never-appended messages as
+    delivered and silently lose them."""
+    import time as _t
+    p = _make_producer(**{"linger.ms": 30, "batch.num.messages": 50})
+    cluster = p._rk.mock_cluster
+    from librdkafka_tpu.protocol.proto import ApiKey
+    drs = []
+    p._rk.conf.set("dr_msg_cb", lambda err, msg: drs.append(err))
+    p.produce("eos", value=b"warm", partition=0)
+    assert p.flush(30.0) == 0
+    cluster.push_request_errors(ApiKey.Produce, [Err.REQUEST_TIMED_OUT])
+    # 30 msgs -> linger fires a partial batch whose response is "lost"
+    for i in range(30):
+        p.produce("eos", value=b"a%05d" % i, partition=0)
+    _t.sleep(0.12)
+    # more messages arrive while the retry is pending
+    for i in range(40):
+        p.produce("eos", value=b"b%05d" % i, partition=0)
+    assert p.flush(60.0) == 0
+    assert all(e is None for e in drs)
+    vals = _log_values(cluster, "eos", 0)
+    assert vals == ([b"warm"] + [b"a%05d" % i for i in range(30)]
+                    + [b"b%05d" % i for i in range(40)])
+    p.close()
