@@ -40,6 +40,11 @@ def lib() -> ctypes.CDLL:
             fn = getattr(L, name)
             fn.restype = i64
             fn.argtypes = [ctypes.c_char_p, i64, u8p, i64]
+        for name in ("tk_lz4f_compress_many", "tk_snappy_compress_many"):
+            fn = getattr(L, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_char_p, i64p, i64p, ctypes.c_int,
+                           u8p, i64p, i64p, ctypes.c_int]
         for name in ("tk_lz4f_bound", "tk_snappy_bound", "tk_lz4_block_bound",
                      "tk_snappy_uncompressed_length"):
             fn = getattr(L, name)
@@ -198,6 +203,44 @@ def crc32c_many(buffers: list[bytes]) -> np.ndarray:
                          lens.ctypes.data_as(i64p),
                          out.ctypes.data_as(u32p), len(buffers))
     return out
+
+
+def _compress_many_parallel(fn_name: str, bound_name: str,
+                            bufs: list[bytes]) -> list[bytes]:
+    """One native call compressing all buffers across a thread pool —
+    the batch axis the reference's per-broker-thread design serializes."""
+    L = lib()
+    base = b"".join(bytes(b) for b in bufs)
+    lens = np.array([len(b) for b in bufs], dtype=np.int64)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    bound = getattr(L, bound_name)
+    caps = np.array([bound(int(n)) for n in lens], dtype=np.int64)
+    out_offs = np.concatenate([[0], np.cumsum(caps)[:-1]]).astype(np.int64)
+    out = ctypes.create_string_buffer(int(caps.sum()))
+    out_lens = np.zeros(len(bufs), dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    getattr(L, fn_name)(
+        base, offs.ctypes.data_as(i64p), lens.ctypes.data_as(i64p),
+        len(bufs), ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+        out_offs.ctypes.data_as(i64p), out_lens.ctypes.data_as(i64p), 0)
+    res = []
+    for i in range(len(bufs)):
+        r = int(out_lens[i])
+        if r < 0:
+            raise ValueError(f"{fn_name} item {i} failed ({r})")
+        o = int(out_offs[i])
+        res.append(out.raw[o:o + r])
+    return res
+
+
+def lz4f_compress_many(bufs: list[bytes]) -> list[bytes]:
+    return _compress_many_parallel("tk_lz4f_compress_many", "tk_lz4f_bound",
+                                   bufs)
+
+
+def snappy_compress_many(bufs: list[bytes]) -> list[bytes]:
+    return _compress_many_parallel("tk_snappy_compress_many",
+                                   "tk_snappy_bound", bufs)
 
 
 # codec registry: name -> (compress(data, level), decompress(data, size_hint))

@@ -166,3 +166,113 @@ def crc32c_many(buffers: list[bytes]) -> np.ndarray:
     N = next_pow2(max(len(b) for b in buffers))
     data, lens = pad_left(buffers, N)
     return np.asarray(_jit_for(N)(data, lens)).astype(np.uint32)
+
+
+# ===================================================================== MXU ==
+# CRC32C as GF(2) matrix algebra on the systolic array.
+#
+# The register fold f(0, data) is GF(2)-linear in the data bits, so the
+# whole checksum is a matrix-vector product over GF(2).  Decompose per
+# 256-byte chunk:  c_k = P · bits_k   (P is a constant 2048x32 bit-matrix:
+# column (p*8+k) is the fold of bit k of byte p through the chunk tail),
+# then combine      raw = Σ_k S^(K-1-k) · c_k   (S = shift by one chunk).
+# Both stages are int8 matmuls with int32 accumulation reduced mod 2 —
+# MXU work instead of the byte-table gathers the scan kernel (and every
+# CPU implementation, crc32c.c:39) is built from.  Bit-exact by the same
+# linearity argument as the scan path (leading zeros under a zero
+# register are a no-op; the length term f(~0,0^n) is applied per buffer).
+
+_CHUNK = 256  # bytes per MXU chunk
+
+
+def _apply_host(cols: np.ndarray, v: int) -> int:
+    acc = 0
+    i = 0
+    v = int(v)
+    while v:
+        if v & 1:
+            acc ^= int(cols[i])
+        v >>= 1
+        i += 1
+    return acc
+
+
+@lru_cache(maxsize=1)
+def _p_matrix() -> np.ndarray:
+    """(2048, 32) int8: bit contributions of a 256-byte chunk to its raw CRC."""
+    T = TABLE_CRC32C[0]
+    P = np.zeros((_CHUNK * 8, 32), dtype=np.int8)
+    for p in range(_CHUNK):
+        cols = _mat_cols_pow(_CHUNK - 1 - p)
+        for k in range(8):
+            contrib = _apply_host(cols, int(T[1 << k]))
+            P[p * 8 + k] = (contrib >> np.arange(32)) & 1
+    return P
+
+
+@lru_cache(maxsize=16)
+def _w_matrix(K: int) -> np.ndarray:
+    """(K*32, 32) int8: combine matrices S^(K-1-j) stacked over chunks j."""
+    S = _mat_cols_pow(_CHUNK)
+    cur = np.array([1 << i for i in range(32)], dtype=np.uint64)  # identity
+    mats = []
+    for _ in range(K):                      # mats[i] = S^i (column form)
+        mats.append(cur.copy())
+        cur = np.array([_apply_host(S, int(cur[i])) for i in range(32)],
+                       dtype=np.uint64)
+    W = np.zeros((K, 32, 32), dtype=np.int8)
+    for j in range(K):
+        cols = mats[K - 1 - j]
+        W[j] = ((cols[:, None] >> np.arange(32, dtype=np.uint64)[None, :])
+                & np.uint64(1)).astype(np.int8)
+    return W.reshape(K * 32, 32)
+
+
+def _crc_kernel_mxu(data, lengths, P, W):
+    """data (B, N) uint8 left-padded, N = K*256 → crc32c (B,) uint32."""
+    B, N = data.shape
+    K = N // _CHUNK
+    bits = ((data[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1)
+    bits = bits.reshape(B * K, _CHUNK * 8).astype(jnp.int8)
+    counts = jax.lax.dot_general(
+        bits, P, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)            # (B*K, 32)
+    c = (counts & 1).astype(jnp.int8).reshape(B, K * 32)
+    total = jax.lax.dot_general(
+        c, W, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)            # (B, 32)
+    raw_bits = (total & 1).astype(_U32)
+    raw = jax.lax.reduce(
+        raw_bits << jnp.arange(32, dtype=_U32)[None, :], np.uint32(0),
+        lambda a, b: jax.lax.bitwise_xor(a, b), (1,))
+
+    # per-length affine term f(~0, 0^n), as in the scan kernel
+    zop = jnp.asarray(_ZOP)
+    n = lengths.astype(_U32)
+    v = jnp.full((B,), 0xFFFFFFFF, _U32)
+
+    def bit_step(j, v):
+        return jnp.where((n >> j) & 1, _apply_cols(zop[j], v), v)
+
+    v = jax.lax.fori_loop(0, 31, bit_step, v)
+    return ~(raw ^ v)
+
+
+@lru_cache(maxsize=16)
+def _jit_mxu(N: int):
+    P = jnp.asarray(_p_matrix())
+    W = jnp.asarray(_w_matrix(N // _CHUNK))
+
+    def fn(data, lengths):
+        return _crc_kernel_mxu(data, lengths, P, W)
+
+    return jax.jit(fn)
+
+
+def crc32c_many_mxu(buffers: list[bytes]) -> np.ndarray:
+    """CRC32C of each buffer via GF(2) matmuls on the MXU."""
+    if not buffers:
+        return np.zeros((0,), dtype=np.uint32)
+    N = max(next_pow2(max(len(b) for b in buffers)), _CHUNK)
+    data, lens = pad_left(buffers, N)
+    return np.asarray(_jit_mxu(N)(data, lens)).astype(np.uint32)

@@ -18,7 +18,7 @@ def build(force: bool = False) -> str:
                 and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
             return SO
         tmp = SO + ".tmp"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
                "-fvisibility=hidden", "-o", tmp, SRC]
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, SO)
