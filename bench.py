@@ -36,6 +36,7 @@ def run(backend: str, n_msgs: int, size: int, toppars: int) -> float:
 
     p = Producer({
         "bootstrap.servers": "", "test.mock.num.brokers": 1,
+        "test.mock.default.partitions": toppars,
         "compression.backend": backend,
         "compression.codec": "lz4",
         "batch.num.messages": 10000,

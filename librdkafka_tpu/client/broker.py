@@ -434,16 +434,20 @@ class Broker:
             if rk.idemp and not rk.idemp.can_produce():
                 continue
             # frozen retry batches resend first, membership intact, and
-            # block new batch formation until drained (ordering)
-            planned = 0
-            while tp.retry_batches and tp.inflight + planned < max_inflight:
-                with tp.lock:
-                    msgs = list(tp.retry_batches.popleft())
-                ready.append((tp, msgs, self._make_writer(tp, msgs, codec)))
-                planned += 1
-            if tp.retry_batches or tp.inflight + planned >= max_inflight:
+            # block new batch formation until drained (ordering); popped
+            # batches are accounted in-flight IMMEDIATELY so the DRAIN
+            # rebase on the main thread never runs past messages held in
+            # this serve pass's `ready` list
+            if now >= tp.retry_backoff_until:
+                while tp.retry_batches and tp.inflight < max_inflight:
+                    with tp.lock:
+                        msgs = list(tp.retry_batches.popleft())
+                        tp.inflight_msgids.add(msgs[0].msgid)
+                    tp.inflight += 1
+                    ready.append((tp, msgs, self._make_writer(tp, msgs, codec)))
+            if tp.retry_batches or tp.inflight >= max_inflight:
                 continue
-            if not tp.xmit_msgq:
+            if not tp.xmit_msgq or now < tp.retry_backoff_until:
                 continue
             # linger gate (rdkafka_broker.c:3453-3470)
             oldest = tp.xmit_msgq[0]
@@ -463,6 +467,9 @@ class Broker:
                 sz += len(m)
             if not msgs:
                 continue
+            with tp.lock:
+                tp.inflight_msgids.add(msgs[0].msgid)
+            tp.inflight += 1
             writer = self._make_writer(tp, msgs, codec)
             ready.append((tp, msgs, writer))
 
@@ -470,20 +477,40 @@ class Broker:
             return
 
         # ---- phase 2: ONE batched compress+CRC call across partitions ----
-        if codec != "none" and ready:
-            provider = rk.codec_provider
-            blobs = provider.compress_many(
-                codec, [w.records_bytes for _, _, w in ready],
-                rk.topic_conf_for(ready[0][0].topic).get("compression.level"))
-        else:
-            blobs = [None] * len(ready)
+        # batches in `ready` are already accounted in-flight; any failure
+        # from here on must release the accounting and error-DR the batch
+        # or tp.inflight leaks (flush() would hang, DRAIN never resolves)
+        try:
+            if codec != "none" and ready:
+                provider = rk.codec_provider
+                blobs = provider.compress_many(
+                    codec, [w.records_bytes for _, _, w in ready],
+                    rk.topic_conf_for(ready[0][0].topic).get("compression.level"))
+            else:
+                blobs = [None] * len(ready)
+        except Exception as e:
+            for tp, msgs, _w in ready:
+                self._release_unsent(tp, msgs, e)
+            return
 
         for (tp, msgs, writer), blob in zip(ready, blobs):
-            if blob is not None and len(blob) >= len(writer.records_bytes):
-                blob = None       # incompressible: send plain
-                writer.codec = None
-            wire = writer.finalize(blob)
+            try:
+                if blob is not None and len(blob) >= len(writer.records_bytes):
+                    blob = None       # incompressible: send plain
+                    writer.codec = None
+                wire = writer.finalize(blob)
+            except Exception as e:
+                self._release_unsent(tp, msgs, e)
+                continue
             self._send_produce(tp, msgs, wire, now)
+
+    def _release_unsent(self, tp, msgs: list[Message], exc: Exception):
+        tp.inflight -= 1
+        with tp.lock:
+            tp.inflight_msgids.discard(msgs[0].msgid)
+        self.rk.log("ERROR", f"{self.name}: batch codec failed: {exc!r}")
+        self.rk.dr_msgq(msgs, KafkaError(Err._FAIL,
+                                         f"batch codec failed: {exc!r}"))
 
     def _make_writer(self, tp, msgs: list[Message], codec: str) -> MsgsetWriterV2:
         rk = self.rk
@@ -505,9 +532,8 @@ class Broker:
         rk = self.rk
         tconf = rk.topic_conf_for(tp.topic)
         acks = tconf.get("request.required.acks")
-        tp.inflight += 1
-        with tp.lock:
-            tp.inflight_msgids.add(msgs[0].msgid)
+        # NOTE: tp.inflight / inflight_msgids were accounted at batch
+        # formation time in _producer_serve (DRAIN-rebase atomicity)
         for m in msgs:
             m.status = MsgStatus.POSSIBLY_PERSISTED
             m.latency_us = int((now - m.enq_time) * 1e6)
@@ -532,11 +558,19 @@ class Broker:
     def _handle_produce(self, tp, msgs: list[Message], err, resp):
         """Produce response → DR / retry / idempotence reconciliation
         (reference: rd_kafka_handle_Produce, rdkafka_request.c:2887,
-        error path :2415)."""
+        error path :2415).  The in-flight accounting is released only
+        AFTER the requeue-or-DR decision so the main thread's DRAIN
+        rebase can never observe inflight==0 while this batch is still
+        unresolved."""
+        try:
+            self._handle_produce0(tp, msgs, err, resp)
+        finally:
+            tp.inflight -= 1
+            with tp.lock:
+                tp.inflight_msgids.discard(msgs[0].msgid)
+
+    def _handle_produce0(self, tp, msgs: list[Message], err, resp):
         rk = self.rk
-        tp.inflight -= 1
-        with tp.lock:
-            tp.inflight_msgids.discard(msgs[0].msgid)
         if err is None:
             pres = resp["topics"][0]["partitions"][0]
             ec = Err.from_wire(pres["error_code"])
@@ -562,10 +596,11 @@ class Broker:
             # If an EARLIER batch of this partition failed retriably, the
             # broker rejects every in-flight successor with OUT_OF_ORDER —
             # a consequent error: requeue in msgid order and let the head
-            # batch retry first.  Only a gap at the head of the line is a
-            # true unexplained sequence break needing drain + epoch bump
-            # (reference: rd_kafka_handle_Produce_error, rdkafka_request.c
-            # :2415 — "successor batch" reconciliation vs fatal gap).
+            # batch retry first.  A gap at the head of the line, however,
+            # is a true sequence desynchronization: the batch is
+            # POSSIBLY_PERSISTED and resending under a fresh PID would
+            # bypass broker dedup, so it is FATAL (reference:
+            # rd_kafka_handle_Produce_error, rdkafka_request.c:2173 r==0).
             with tp.lock:
                 pending_earlier = (
                     any(m.msgid < msgs[0].msgid for m in tp.xmit_msgq)
@@ -575,8 +610,16 @@ class Broker:
                            for mid in tp.inflight_msgids))
             if pending_earlier:
                 tp.enqueue_retry_batch(msgs)
+                tp.retry_backoff_until = time.monotonic() + \
+                    rk.conf.get("retry.backoff.ms") / 1000.0
                 return
-            rk.idemp.drain_bump(tp, msgs)
+            fatal = KafkaError(
+                Err.OUT_OF_ORDER_SEQUENCE_NUMBER,
+                f"{tp}: sequence desynchronization: head-of-line batch "
+                f"rejected with OUT_OF_ORDER_SEQUENCE_NUMBER "
+                f"(possibly persisted; resend would bypass broker dedup)")
+            rk.set_fatal_error(fatal)
+            rk.dr_msgq(msgs, fatal)
             return
         retriable = kerr.retriable
         max_retries = rk.conf.get("message.send.max.retries")
@@ -593,6 +636,8 @@ class Broker:
                     for m in msgs:
                         m.retries += 1
                     tp.enqueue_retry_batch(msgs)
+                    tp.retry_backoff_until = time.monotonic() + \
+                        rk.conf.get("retry.backoff.ms") / 1000.0
                 else:
                     rk.dr_msgq(msgs, kerr)
                 return
@@ -602,6 +647,8 @@ class Broker:
                 m.retries += 1
             if retry:
                 tp.insert_retry(retry)
+                tp.retry_backoff_until = time.monotonic() + \
+                    rk.conf.get("retry.backoff.ms") / 1000.0
             if fail:
                 rk.dr_msgq(fail, kerr)
         else:

@@ -90,6 +90,8 @@ class IdempotenceManager:
 
     def _handle_pid(self, err, resp):
         with self._lock:
+            if self.state != "WAIT_PID":
+                return          # a drain was requested while in flight
             if err is not None or resp["error_code"] != 0:
                 self.state = "RETRY"
                 return
@@ -98,16 +100,17 @@ class IdempotenceManager:
             self.state = "ASSIGNED"
             self.rk.dbg("eos", f"assigned PID {self.pid} epoch {self.epoch}")
 
-    def drain_bump(self, tp, msgs):
-        """True sequence gap: stop producing, requeue the failed batch
-        frozen, and enter DRAIN — serve() acquires a new PID and rebases
-        sequence origins once every in-flight request has resolved
-        (reference :374-440)."""
+    def drain_epoch_bump(self, reason: str):
+        """Enter DRAIN: stop producing; serve() acquires a new PID and
+        rebases sequence origins once every in-flight request has
+        resolved (reference DRAIN_BUMP, rdkafka_idempotence.c:374-440).
+        Used for recoverable gaps the broker never saw (e.g. messages
+        timing out locally, rdkafka_broker.c:3291-3309) — NOT for
+        head-of-line sequence desync, which is fatal."""
         with self._lock:
-            self.rk.dbg("eos", f"drain+bump after seq error on {tp}")
-            self.state = "DRAIN"
-        tp.enqueue_retry_batch(msgs)
-        self.serve()
+            if self.state in ("ASSIGNED", "WAIT_PID"):
+                self.rk.dbg("eos", f"drain+epoch bump: {reason}")
+                self.state = "DRAIN"
 
 
 class Kafka:
@@ -166,7 +169,9 @@ class Kafka:
         bootstrap = conf.get("bootstrap.servers")
         if nmock > 0 and not bootstrap:
             from ..mock.cluster import MockCluster
-            self.mock_cluster = MockCluster(num_brokers=nmock)
+            self.mock_cluster = MockCluster(
+                num_brokers=nmock,
+                default_partitions=conf.get("test.mock.default.partitions"))
             bootstrap = self.mock_cluster.bootstrap_servers()
         if not bootstrap:
             raise KafkaException(Err._INVALID_ARG,
@@ -281,6 +286,8 @@ class Kafka:
             if topic is not None:
                 with topic.lock:
                     topic.partition_cnt = len(t["partitions"])
+                if self.is_producer:
+                    self._fail_unknown_partitions(name, len(t["partitions"]))
             for p in t["partitions"]:
                 if p["leader"] < 0:
                     continue
@@ -301,6 +308,29 @@ class Kafka:
             if leader in self.brokers:
                 self.brokers[leader].add_toppar(tp)
         self.dbg("topic", f"{tp}: leader {old} -> {leader}")
+
+    def _fail_unknown_partitions(self, topic: str, cnt: int):
+        """Error-DR messages parked on partitions beyond the topic's real
+        partition count (reference: rd_kafka_topic_partition_cnt_update →
+        UNKNOWN_PARTITION delivery failures, rdkafka_topic.c)."""
+        with self._toppars_lock:
+            tps = [tp for (t, p), tp in self._toppars.items()
+                   if t == topic and p >= cnt]
+        for tp in tps:
+            failed: list[Message] = []
+            with tp.lock:
+                failed.extend(tp.msgq)
+                tp.msgq.clear()
+                tp.msgq_bytes = 0
+                failed.extend(tp.xmit_msgq)
+                tp.xmit_msgq.clear()
+                for b in tp.retry_batches:
+                    failed.extend(b)
+                tp.retry_batches.clear()
+            if failed:
+                self.dr_msgq(failed, KafkaError(
+                    Err._UNKNOWN_PARTITION,
+                    f"{tp}: partition does not exist"))
 
     def _migrate_ua_msgs(self):
         with self._topics_lock:
@@ -364,6 +394,16 @@ class Kafka:
                     return
             self._partition_and_enq(t, m)
         else:
+            with t.lock:
+                cnt = t.partition_cnt
+            if 0 < cnt <= partition:
+                # known-invalid partition fails at produce() time
+                # (reference: rd_kafka_msg_partitioner → UNKNOWN_PARTITION)
+                with self._msg_cnt_lock:
+                    self.msg_cnt -= 1
+                raise KafkaException(
+                    Err._UNKNOWN_PARTITION,
+                    f"{topic}[{partition}]: partition does not exist")
             tp = self.get_toppar(topic, partition)
             tp.enq_msg(m)
             self._wake_leader(tp)
@@ -507,6 +547,7 @@ class Kafka:
         now = time.monotonic()
         with self._toppars_lock:
             tps = list(self._toppars.values())
+        any_possibly_persisted = False
         for tp in tps:
             tmo = self.topic_conf_for(tp.topic).get("message.timeout.ms") / 1000.0
             if tmo <= 0:
@@ -516,10 +557,24 @@ class Kafka:
                 for q in (tp.msgq, tp.xmit_msgq):
                     while q and now - q[0].enq_time > tmo:
                         expired.append(q.popleft())
+                # frozen retry batches expire whole (membership must stay
+                # intact); a batch expires when its head message has
+                # (reference scans all queues, rdkafka_broker.c:3093)
+                while (tp.retry_batches
+                       and now - tp.retry_batches[0][0].enq_time > tmo):
+                    expired.extend(tp.retry_batches.popleft())
             if expired:
+                if any(m.status == MsgStatus.POSSIBLY_PERSISTED
+                       for m in expired):
+                    any_possibly_persisted = True
                 self.dr_msgq(expired,
                              KafkaError(Err._MSG_TIMED_OUT,
                                         "message timed out"))
+        if any_possibly_persisted and self.idemp:
+            # timing out possibly-persisted messages leaves a sequence gap
+            # the broker will reject; recover via drain + epoch bump
+            # (reference: rdkafka_broker.c:3291-3309)
+            self.idemp.drain_epoch_bump("message(s) timed out")
 
     # --------------------------------------------------------- stats emit --
     def _emit_stats(self):

@@ -46,6 +46,7 @@ class Toppar:
         self.inflight = 0                          # in-flight ProduceRequests
         self.inflight_msgids: set[int] = set()     # first msgid per in-flight batch
         self.retry_batches: deque[list[Message]] = deque()  # frozen retries
+        self.retry_backoff_until = 0.0   # retry.backoff.ms gate on re-pops
         self.leader_id: int = -1
         self.ts_last_xmit = 0.0
 

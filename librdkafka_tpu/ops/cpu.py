@@ -45,6 +45,11 @@ def lib() -> ctypes.CDLL:
             fn.restype = None
             fn.argtypes = [ctypes.c_char_p, i64p, i64p, ctypes.c_int,
                            u8p, i64p, i64p, ctypes.c_int]
+        for name in ("tk_lz4f_decompress_many", "tk_snappy_decompress_many"):
+            fn = getattr(L, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_char_p, i64p, i64p, ctypes.c_int,
+                           u8p, i64p, i64p, i64p, ctypes.c_int]
         for name in ("tk_lz4f_bound", "tk_snappy_bound", "tk_lz4_block_bound",
                      "tk_snappy_uncompressed_length"):
             fn = getattr(L, name)
@@ -209,6 +214,8 @@ def _compress_many_parallel(fn_name: str, bound_name: str,
                             bufs: list[bytes]) -> list[bytes]:
     """One native call compressing all buffers across a thread pool —
     the batch axis the reference's per-broker-thread design serializes."""
+    if not bufs:
+        return []
     L = lib()
     base = b"".join(bytes(b) for b in bufs)
     lens = np.array([len(b) for b in bufs], dtype=np.int64)
@@ -243,12 +250,69 @@ def snappy_compress_many(bufs: list[bytes]) -> list[bytes]:
                                    "tk_snappy_bound", bufs)
 
 
+def _decompress_many_parallel(fn_name: str, bufs: list[bytes],
+                              caps: list[int]) -> list[bytes | None]:
+    """Batched native decompress; items that fail come back as None so
+    the caller can fall back to the grow-and-retry single path."""
+    if not bufs:
+        return []
+    L = lib()
+    base = b"".join(bytes(b) for b in bufs)
+    lens = np.array([len(b) for b in bufs], dtype=np.int64)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    caps_a = np.array([max(int(c), 1) for c in caps], dtype=np.int64)
+    out_offs = np.concatenate([[0], np.cumsum(caps_a)[:-1]]).astype(np.int64)
+    out = ctypes.create_string_buffer(max(int(caps_a.sum()), 1))
+    out_lens = np.zeros(len(bufs), dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    getattr(L, fn_name)(
+        base, offs.ctypes.data_as(i64p), lens.ctypes.data_as(i64p),
+        len(bufs), ctypes.cast(out, ctypes.POINTER(ctypes.c_uint8)),
+        out_offs.ctypes.data_as(i64p), caps_a.ctypes.data_as(i64p),
+        out_lens.ctypes.data_as(i64p), 0)
+    res: list[bytes | None] = []
+    for i in range(len(bufs)):
+        r = int(out_lens[i])
+        if r < 0:
+            res.append(None)
+        else:
+            o = int(out_offs[i])
+            res.append(out.raw[o:o + r])
+    return res
+
+
+def lz4f_decompress_many(bufs: list[bytes],
+                         size_hints: list[int] | None = None) -> list[bytes]:
+    hints = size_hints or [0] * len(bufs)
+    # trust a provided size hint (no 64KiB floor — thousands of small
+    # batches would transiently allocate GBs); an undersized hint just
+    # drops that item to the grow-and-retry single path below
+    caps = [h if h > 0 else 4 * len(b) + (1 << 16)
+            for b, h in zip(bufs, hints)]
+    out = _decompress_many_parallel("tk_lz4f_decompress_many", bufs, caps)
+    return [o if o is not None else lz4_decompress(b, h)
+            for o, b, h in zip(out, bufs, hints)]
+
+
+def snappy_decompress_many(bufs: list[bytes]) -> list[bytes]:
+    if not bufs:
+        return []
+    L = lib()
+    caps = [L.tk_snappy_uncompressed_length(bytes(b), len(b)) for b in bufs]
+    if any(c < 0 for c in caps):
+        raise ValueError("bad snappy preamble")
+    out = _decompress_many_parallel("tk_snappy_decompress_many", bufs, caps)
+    if any(o is None for o in out):
+        raise ValueError("snappy decompress failed")
+    return out  # type: ignore[return-value]
+
+
 # codec registry: name -> (compress(data, level), decompress(data, size_hint))
 CODECS = {
     "gzip": (lambda d, lvl=-1: gzip_compress(d, lvl),
              lambda d, hint=0: gzip_decompress(d)),
     "snappy": (lambda d, lvl=-1: snappy_compress(d),
-               lambda d, hint=0: snappy_decompress(d)),
+               lambda d, hint=0: snappy_java_decompress(d)),
     "lz4": (lambda d, lvl=-1: lz4_compress(d),
             lambda d, hint=0: lz4_decompress(d, hint)),
     "zstd": (lambda d, lvl=-1: zstd_compress(d, lvl),
@@ -268,11 +332,27 @@ class CpuCodecProvider:
 
     def compress_many(self, codec: str, bufs: list[bytes], level: int = -1
                       ) -> list[bytes]:
+        if not bufs:
+            return []
+        # lz4/snappy: ONE native call, batch parallelized across cores
+        # (the per-toppar batch axis the reference serializes on its
+        # broker threads, rdkafka_msgset_writer.c:1129)
+        if codec == "lz4":
+            return lz4f_compress_many(bufs)
+        if codec == "snappy":
+            return snappy_compress_many(bufs)
         comp = CODECS[codec][0]
         return [comp(b, level) for b in bufs]
 
     def decompress_many(self, codec: str, bufs: list[bytes],
                         size_hints: list[int] | None = None) -> list[bytes]:
+        if not bufs:
+            return []
+        if codec == "lz4":
+            return lz4f_decompress_many(bufs, size_hints)
+        if codec == "snappy" and not any(
+                bytes(b).startswith(SNAPPY_JAVA_MAGIC) for b in bufs):
+            return snappy_decompress_many(bufs)
         dec = CODECS[codec][1]
         hints = size_hints or [0] * len(bufs)
         return [dec(b, h) for b, h in zip(bufs, hints)]

@@ -431,46 +431,6 @@ EXPORT int64_t tk_snappy_decompress(const uint8_t *src, int64_t n,
     return o;
 }
 
-// ----------------------------------------------------- batched frontends --
-// One call handles N independent buffers (the per-toppar batch axis):
-// offs/lens index into a single base; outputs packed into dst with
-// out_offs/out_lens reporting where each result landed.
-
-typedef int64_t (*codec_fn)(const uint8_t *, int64_t, uint8_t *, int64_t);
-
-static int64_t many(codec_fn fn, const uint8_t *base, const int64_t *offs,
-                    const int64_t *lens, int count, uint8_t *dst,
-                    int64_t dcap, int64_t *out_offs, int64_t *out_lens) {
-    int64_t o = 0;
-    for (int i = 0; i < count; i++) {
-        int64_t r = fn(base + offs[i], lens[i], dst + o, dcap - o);
-        if (r < 0) return -(int64_t)(i + 1);
-        out_offs[i] = o; out_lens[i] = r; o += r;
-    }
-    return o;
-}
-
-EXPORT int64_t tk_lz4f_compress_many(const uint8_t *b, const int64_t *of,
-                                     const int64_t *ln, int c, uint8_t *d,
-                                     int64_t dc, int64_t *oo, int64_t *ol) {
-    return many(tk_lz4f_compress, b, of, ln, c, d, dc, oo, ol);
-}
-EXPORT int64_t tk_lz4f_decompress_many(const uint8_t *b, const int64_t *of,
-                                       const int64_t *ln, int c, uint8_t *d,
-                                       int64_t dc, int64_t *oo, int64_t *ol) {
-    return many(tk_lz4f_decompress, b, of, ln, c, d, dc, oo, ol);
-}
-EXPORT int64_t tk_snappy_compress_many(const uint8_t *b, const int64_t *of,
-                                       const int64_t *ln, int c, uint8_t *d,
-                                       int64_t dc, int64_t *oo, int64_t *ol) {
-    return many(tk_snappy_compress, b, of, ln, c, d, dc, oo, ol);
-}
-EXPORT int64_t tk_snappy_decompress_many(const uint8_t *b, const int64_t *of,
-                                         const int64_t *ln, int c, uint8_t *d,
-                                         int64_t dc, int64_t *oo, int64_t *ol) {
-    return many(tk_snappy_decompress, b, of, ln, c, d, dc, oo, ol);
-}
-
 // ------------------------------------------------------ batched parallel --
 //
 // The provider seam (SURVEY.md §3.2) hands MANY independent per-partition
@@ -546,6 +506,31 @@ EXPORT void tk_lz4f_decompress_many(const uint8_t *base, const int64_t *offs,
             out_lens[i] = tk_lz4f_decompress(base + offs[i], lens[i],
                                              outbase + out_offs[i],
                                              out_caps[i]);
+        }
+    };
+    if (nt == 1) { work(); return; }
+    std::vector<std::thread> ts;
+    for (int t = 0; t < nt; t++) ts.emplace_back(work);
+    for (auto &t : ts) t.join();
+}
+
+EXPORT void tk_snappy_decompress_many(const uint8_t *base, const int64_t *offs,
+                                      const int64_t *lens, int n,
+                                      uint8_t *outbase,
+                                      const int64_t *out_offs,
+                                      const int64_t *out_caps,
+                                      int64_t *out_lens, int nthreads) {
+    if (n <= 0) return;
+    unsigned hw = std::thread::hardware_concurrency();
+    int nt = nthreads > 0 ? nthreads : (hw ? (int)hw : 4);
+    if (nt > n) nt = n;
+    std::atomic<int> next(0);
+    auto work = [&]() {
+        int i;
+        while ((i = next.fetch_add(1)) < n) {
+            out_lens[i] = tk_snappy_decompress(base + offs[i], lens[i],
+                                               outbase + out_offs[i],
+                                               out_caps[i]);
         }
     };
     if (nt == 1) { work(); return; }

@@ -107,13 +107,17 @@ def test_idempotent_multi_partition_sequences_independent():
     p.close()
 
 
-def test_idempotent_true_gap_drains_and_bumps_pid():
-    """A head-of-line sequence gap (no earlier pending batch) is a real
-    break: the producer drains, acquires a fresh PID, rebases sequences,
-    and delivers everything exactly once under the new PID (reference
-    drain/epoch-bump recovery, rdkafka_idempotence.c:347-440)."""
+def test_idempotent_head_of_line_gap_is_fatal():
+    """A head-of-line sequence gap (no earlier pending batch) is a true
+    sequence desynchronization: the rejected batch is POSSIBLY_PERSISTED,
+    and resending it under a fresh PID would bypass broker dedup and can
+    silently duplicate — so it must be FATAL, not drain+bump (reference:
+    rd_kafka_handle_Produce_error, rdkafka_request.c:2173 r==0 branch)."""
+    from librdkafka_tpu.client.errors import KafkaException
     p = _make_producer()
     cluster = p._rk.mock_cluster
+    dr_errs = []
+    p._rk.conf.set("dr_msg_cb", lambda err, msg: dr_errs.append(err))
     p.produce("eos", value=b"warm", partition=0)
     assert p.flush(30.0) == 0
     part = cluster.partition("eos", 0)
@@ -125,15 +129,23 @@ def test_idempotent_true_gap_drains_and_bumps_pid():
     n = 100
     for i in range(n):
         p.produce("eos", value=b"g%05d" % i, partition=0)
-    assert p.flush(60.0) == 0
+    assert p.flush(60.0) == 0          # everything resolved (via error DRs)
+    errs = [e for e in dr_errs if e is not None]
+    assert errs, "expected fatal error DRs for the gapped batch"
+    assert all(e.code == Err.OUT_OF_ORDER_SEQUENCE_NUMBER for e in errs)
+    assert p._rk.fatal_error is not None
+    # no duplicates in the broker log: only the warm message + nothing else
     vals = []
-    pids = set()
     for _base, blob in part.log:
         for info, payload, _full in iter_batches(bytes(blob)):
-            pids.add(info.producer_id)
             vals.extend(r.value for r in parse_records_v2(info, payload))
-    assert vals == [b"warm"] + [b"g%05d" % i for i in range(n)]
-    assert len(pids) == 2, f"expected a PID bump, saw {pids}"
+    assert vals == [b"warm"]
+    # the producer is dead: further produce() raises the fatal error
+    try:
+        p.produce("eos", value=b"after-fatal", partition=0)
+        assert False, "produce after fatal error should raise"
+    except KafkaException:
+        pass
     p.close()
 
 

@@ -1,0 +1,103 @@
+"""Message timeout + unknown partition delivery-failure tests — analogs
+of the reference's 0094-idempotence_msg_timeout.c and the
+rd_kafka_broker_toppar_msgq_scan path (rdkafka_broker.c:3093): every
+queue — msgq, xmit_msgq AND frozen retry batches — must expire within
+message.timeout.ms, flush() must return, and producing to a partition
+that does not exist must fail fast (UNKNOWN_PARTITION,
+rdkafka_msg.c partitioning path) instead of parking forever.
+"""
+import time
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.client.errors import Err, KafkaException
+from librdkafka_tpu.protocol.proto import ApiKey
+
+
+def _producer(**extra):
+    conf = {"bootstrap.servers": "", "test.mock.num.brokers": 1,
+            "linger.ms": 2, "batch.num.messages": 50}
+    conf.update(extra)
+    return Producer(conf)
+
+
+def test_unknown_partition_fails_parked_messages():
+    """Messages produced to out-of-range partitions before metadata
+    arrives get _UNKNOWN_PARTITION error DRs once the real partition
+    count is known — they must not park until message.timeout.ms."""
+    drs = []
+    p = _producer()
+    p._rk.conf.set("dr_msg_cb", lambda err, msg: drs.append((err, msg)))
+    # partition 99 >> mock default of 4; produced before metadata arrives
+    p.produce("nopart", value=b"x", partition=99)
+    assert p.flush(10.0) == 0, "flush must drain via the error DR"
+    errs = [e for e, _ in drs if e is not None]
+    assert len(errs) == 1 and errs[0].code == Err._UNKNOWN_PARTITION
+    p.close()
+
+
+def test_unknown_partition_fails_fast_when_count_known():
+    p = _producer()
+    p.produce("t", value=b"ok", partition=0)
+    assert p.flush(10.0) == 0
+    with pytest.raises(KafkaException) as ei:
+        p.produce("t", value=b"x", partition=99)
+    assert ei.value.error.code == Err._UNKNOWN_PARTITION
+    # the failed produce must not leak queue accounting
+    assert p._rk.msg_cnt == 0
+    p.close()
+
+
+def test_msg_timeout_expires_retry_batches_broker_down():
+    """Kill the mock broker mid-produce with retries pending: ALL
+    messages — including frozen retry batches — get _MSG_TIMED_OUT DRs
+    within message.timeout.ms and flush() returns (reference scans all
+    queues, rdkafka_broker.c:3093)."""
+    drs = []
+    p = _producer(**{"message.timeout.ms": 2500,
+                     "enable.idempotence": True,
+                     "message.send.max.retries": 10000,
+                     # long backoff: the frozen retry batch is still
+                     # parked in tp.retry_batches when the broker dies
+                     "retry.backoff.ms": 1000})
+    p._rk.conf.set("dr_msg_cb", lambda err, msg: drs.append(err))
+    cluster = p._rk.mock_cluster
+    p.produce("tmo", value=b"warm", partition=0)
+    assert p.flush(10.0) == 0
+    # force a retriable produce error, then take the broker down so the
+    # frozen retry batch can never resend
+    cluster.push_request_errors(ApiKey.Produce, [Err.REQUEST_TIMED_OUT])
+    for i in range(20):
+        p.produce("tmo", value=b"m%d" % i, partition=0)
+    time.sleep(0.2)             # let the first send + error happen
+    cluster.set_broker_down(1)
+    t0 = time.monotonic()
+    assert p.flush(30.0) == 0, "flush must return once messages expire"
+    took = time.monotonic() - t0
+    assert took < 15.0, f"flush took {took:.1f}s; retry batches not scanned?"
+    errs = [e for e in drs if e is not None]
+    assert len(errs) == 20
+    assert all(e.code == Err._MSG_TIMED_OUT for e in errs)
+    cluster.set_broker_down(1, False)
+    p.close()
+
+
+def test_retry_backoff_is_honored():
+    """A failed batch must not burn retries instantly: with
+    retry.backoff.ms=200 and 3 consecutive injected errors, delivery
+    takes >= ~3 backoffs (ADVICE: enqueue_retry_batch previously resent
+    on the very next serve tick)."""
+    p = _producer(**{"retry.backoff.ms": 200,
+                     "message.send.max.retries": 10})
+    cluster = p._rk.mock_cluster
+    p.produce("bk", value=b"warm", partition=0)
+    assert p.flush(10.0) == 0
+    cluster.push_request_errors(
+        ApiKey.Produce, [Err.REQUEST_TIMED_OUT] * 3)
+    t0 = time.monotonic()
+    p.produce("bk", value=b"retry-me", partition=0)
+    assert p.flush(15.0) == 0
+    took = time.monotonic() - t0
+    assert took >= 0.55, f"delivered in {took*1000:.0f}ms — backoff ignored"
+    p.close()
