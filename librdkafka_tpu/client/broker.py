@@ -450,21 +450,29 @@ class Broker:
             if not tp.xmit_msgq or now < tp.retry_backoff_until:
                 continue
             # linger gate (rdkafka_broker.c:3453-3470)
-            oldest = tp.xmit_msgq[0]
+            try:
+                oldest = tp.xmit_msgq[0]
+            except IndexError:      # raced with the msg-timeout scan
+                continue
             full = len(tp.xmit_msgq) >= batch_max
             lingered = (now - oldest.enq_time) >= linger
             if not (full or lingered or rk.flushing):
                 continue
+            size_max = rk.conf.get("message.max.bytes")
+            q = tp.xmit_msgq
             msgs = []
             sz = 0
-            size_max = rk.conf.get("message.max.bytes")
-            while tp.xmit_msgq and len(msgs) < batch_max:
-                m = tp.xmit_msgq[0]
-                if msgs and sz + len(m) > size_max:
-                    break
-                tp.xmit_msgq.popleft()
-                msgs.append(m)
-                sz += len(m)
+            # under tp.lock: the main thread's msg-timeout scan pops
+            # expired messages from this same deque
+            with tp.lock:
+                n_take = min(len(q), batch_max)
+                for _ in range(n_take):
+                    m = q[0]
+                    if msgs and sz + m.size > size_max:
+                        break
+                    q.popleft()
+                    msgs.append(m)
+                    sz += m.size
             if not msgs:
                 continue
             with tp.lock:
@@ -476,13 +484,16 @@ class Broker:
         if not ready:
             return
 
-        # ---- phase 2: ONE batched compress+CRC call across partitions ----
-        # batches in `ready` are already accounted in-flight; any failure
-        # from here on must release the accounting and error-DR the batch
-        # or tp.inflight leaks (flush() would hang, DRAIN never resolves)
+        # ---- phase 2: ONE batched compress + ONE batched CRC call across
+        # partitions (both ride the same provider/offload axis; reference
+        # does each per batch on the broker thread,
+        # rdkafka_msgset_writer.c:1129 + :1230).  Batches in `ready` are
+        # already accounted in-flight; any failure from here on must
+        # release the accounting and error-DR the batch or tp.inflight
+        # leaks (flush() would hang, DRAIN never resolves)
+        provider = rk.codec_provider
         try:
             if codec != "none" and ready:
-                provider = rk.codec_provider
                 blobs = provider.compress_many(
                     codec, [w.records_bytes for _, _, w in ready],
                     rk.topic_conf_for(ready[0][0].topic).get("compression.level"))
@@ -493,16 +504,27 @@ class Broker:
                 self._release_unsent(tp, msgs, e)
             return
 
+        assembled = []                # (tp, msgs, writer) with wire built
+        regions = []                  # CRC region per batch
         for (tp, msgs, writer), blob in zip(ready, blobs):
             try:
                 if blob is not None and len(blob) >= len(writer.records_bytes):
                     blob = None       # incompressible: send plain
                     writer.codec = None
-                wire = writer.finalize(blob)
+                regions.append(writer.assemble(blob))
+                assembled.append((tp, msgs, writer))
             except Exception as e:
                 self._release_unsent(tp, msgs, e)
-                continue
-            self._send_produce(tp, msgs, wire, now)
+        if not assembled:
+            return
+        try:
+            crcs = provider.crc32c_many(regions)
+        except Exception as e:
+            for tp, msgs, _w in assembled:
+                self._release_unsent(tp, msgs, e)
+            return
+        for (tp, msgs, writer), crc in zip(assembled, crcs):
+            self._send_produce(tp, msgs, writer.patch_crc(int(crc)), now)
 
     def _release_unsent(self, tp, msgs: list[Message], exc: Exception):
         tp.inflight -= 1
@@ -522,10 +544,9 @@ class Broker:
         w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
                            base_sequence=base_seq,
                            codec=None if codec == "none" else codec)
-        from ..protocol.msgset import Record
-        w.build([Record(key=m.key, value=m.value, headers=m.headers,
-                        timestamp=m.timestamp) for m in msgs],
-                int(time.time() * 1000))
+        # Message duck-types Record (key/value/headers/timestamp) — no
+        # per-message conversion on the hot path
+        w.build(msgs, int(time.time() * 1000))
         return w
 
     def _send_produce(self, tp, msgs: list[Message], wire: bytes, now: float):
@@ -576,9 +597,11 @@ class Broker:
             ec = Err.from_wire(pres["error_code"])
             if ec == Err.NO_ERROR:
                 base = pres["base_offset"]
-                for i, m in enumerate(msgs):
-                    m.offset = base + i if base >= 0 else -1
-                    m.status = MsgStatus.PERSISTED
+                if (rk.interceptors or rk.conf.get("dr_msg_cb")
+                        or rk.conf.get("dr_cb")):
+                    for i, m in enumerate(msgs):
+                        m.offset = base + i if base >= 0 else -1
+                        m.status = MsgStatus.PERSISTED
                 rk.dr_msgq(msgs, None)
                 return
             kerr = KafkaError(ec)

@@ -139,6 +139,7 @@ class Kafka:
         self.fatal_error: Optional[KafkaError] = None
         self.msg_cnt = 0                       # queue.buffering.max.messages
         self._msg_cnt_lock = threading.Lock()
+        self._max_msgs = conf.get("queue.buffering.max.messages")
         self.cgrp = None                       # set by Consumer
         self.consumer = None                   # back-ref set by Consumer
         self.interceptors = conf.get("interceptors") or None
@@ -374,11 +375,19 @@ class Kafka:
 
     # ------------------------------------------------------------ produce --
     def produce(self, topic: str, value=None, key=None, partition=PARTITION_UA,
-                headers=(), timestamp=0, opaque=None) -> None:
+                on_delivery=None, timestamp=0, headers=(), opaque=None) -> None:
+        # positional order matches the confluent-style public API
+        # (topic, value, key, partition, on_delivery, timestamp, headers)
+        if on_delivery is not None and not self.conf.get("dr_msg_cb"):
+            self.conf.set("dr_msg_cb", on_delivery)
+        if isinstance(value, str):
+            value = value.encode()
+        if isinstance(key, str):
+            key = key.encode()
         if self.fatal_error:
             raise KafkaException(self.fatal_error)
         with self._msg_cnt_lock:
-            if self.msg_cnt >= self.conf.get("queue.buffering.max.messages"):
+            if self.msg_cnt >= self._max_msgs:
                 raise KafkaException(Err._QUEUE_FULL,
                                      "producer queue is full")
             self.msg_cnt += 1
@@ -386,7 +395,11 @@ class Kafka:
                     headers=headers, timestamp=timestamp, opaque=opaque)
         if self.interceptors:
             self.interceptors.on_send(m)
-        t = self.get_topic(topic)
+        # lock-free fast path: dict reads are atomic under the GIL; fall
+        # back to the locked creation path on first sight of a topic
+        t = self.topics.get(topic)
+        if t is None:
+            t = self.get_topic(topic)
         if partition == PARTITION_UA:
             with t.lock:
                 if t.partition_cnt <= 0:
@@ -394,8 +407,7 @@ class Kafka:
                     return
             self._partition_and_enq(t, m)
         else:
-            with t.lock:
-                cnt = t.partition_cnt
+            cnt = t.partition_cnt       # int read: GIL-atomic, no lock
             if 0 < cnt <= partition:
                 # known-invalid partition fails at produce() time
                 # (reference: rd_kafka_msg_partitioner → UNKNOWN_PARTITION)
@@ -404,9 +416,11 @@ class Kafka:
                 raise KafkaException(
                     Err._UNKNOWN_PARTITION,
                     f"{topic}[{partition}]: partition does not exist")
-            tp = self.get_toppar(topic, partition)
-            tp.enq_msg(m)
-            self._wake_leader(tp)
+            tp = self._toppars.get((topic, partition))
+            if tp is None:
+                tp = self.get_toppar(topic, partition)
+            if tp.enq_msg(m):
+                self._wake_leader(tp)
 
     def _partition_and_enq(self, topic: Topic, m: Message):
         pcb = topic.conf.get("partitioner_cb")
@@ -414,9 +428,11 @@ class Kafka:
             m.partition = pcb(m.key, topic.partition_cnt)
         else:
             m.partition = topic.partitioner(m.key, topic.partition_cnt)
-        tp = self.get_toppar(topic.name, m.partition)
-        tp.enq_msg(m)
-        self._wake_leader(tp)
+        tp = self._toppars.get((topic.name, m.partition))
+        if tp is None:
+            tp = self.get_toppar(topic.name, m.partition)
+        if tp.enq_msg(m):
+            self._wake_leader(tp)
 
     def _wake_leader(self, tp: Toppar):
         with self._brokers_lock:
@@ -430,16 +446,19 @@ class Kafka:
         rdkafka_broker.c:2432)."""
         with self._msg_cnt_lock:
             self.msg_cnt -= len(msgs)
-        for m in msgs:
-            m.error = err
+        if err is not None:
+            for m in msgs:
+                m.error = err
         if self.interceptors:
             for m in msgs:
                 self.interceptors.on_acknowledgement(m)
-        only_err = self.conf.get("delivery.report.only.error")
-        out = [m for m in msgs if err or not only_err]
-        if out and (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")):
-            for m in out:
-                self.rep.push(Op(OpType.DR, payload=m))
+        if self.conf.get("dr_msg_cb") or self.conf.get("dr_cb"):
+            only_err = self.conf.get("delivery.report.only.error")
+            out = msgs if (err or not only_err) else \
+                [m for m in msgs if m.error]
+            if out:
+                # one DR op per batch, not per message (queue-push overhead)
+                self.rep.push(Op(OpType.DR, payload=out))
 
     def poll(self, timeout: float = 0.0) -> int:
         """Serve the app reply queue: DRs, errors, stats, logs
@@ -458,7 +477,8 @@ class Kafka:
         if op.type == OpType.DR:
             cb = self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
             if cb:
-                cb(op.payload.error, op.payload)
+                for m in op.payload:
+                    cb(m.error, m)
         elif op.type == OpType.ERR:
             cb = self.conf.get("error_cb")
             if cb:

@@ -31,7 +31,8 @@ class MsgStatus(enum.Enum):
 class Message:
     __slots__ = ("topic", "partition", "key", "value", "headers", "offset",
                  "timestamp", "timestamp_type", "error", "opaque", "msgid",
-                 "retries", "status", "enq_time", "ts_backoff", "latency_us")
+                 "retries", "status", "enq_time", "ts_backoff", "latency_us",
+                 "size")
 
     def __init__(self, topic: str, value: Optional[bytes] = None,
                  key: Optional[bytes] = None,
@@ -42,7 +43,7 @@ class Message:
         self.partition = partition
         self.key = key
         self.value = value
-        self.headers = list(headers)
+        self.headers = list(headers) if headers else []
         self.offset = proto.OFFSET_INVALID
         self.timestamp = timestamp or int(time.time() * 1000)
         self.timestamp_type = proto.TSTYPE_CREATE_TIME
@@ -54,10 +55,10 @@ class Message:
         self.enq_time = time.monotonic()
         self.ts_backoff = 0.0
         self.latency_us = 0
+        self.size = (len(value) if value else 0) + (len(key) if key else 0)
 
     def __len__(self) -> int:
-        return (len(self.value) if self.value else 0) + \
-               (len(self.key) if self.key else 0)
+        return self.size
 
     def __repr__(self):
         return (f"Message({self.topic}[{self.partition}]@{self.offset}"

@@ -25,19 +25,9 @@ class Producer:
                 c.set("dr_msg_cb", dr)
             conf = c
         self._rk = Kafka(conf, PRODUCER)
-
-    def produce(self, topic: str, value: Optional[bytes] = None,
-                key: Optional[bytes] = None, partition: int = PARTITION_UA,
-                on_delivery=None, timestamp: int = 0, headers=(),
-                opaque=None) -> None:
-        if on_delivery is not None and not self._rk.conf.get("dr_msg_cb"):
-            self._rk.conf.set("dr_msg_cb", on_delivery)
-        if isinstance(value, str):
-            value = value.encode()
-        if isinstance(key, str):
-            key = key.encode()
-        self._rk.produce(topic, value=value, key=key, partition=partition,
-                         headers=headers, timestamp=timestamp, opaque=opaque)
+        # bound-method alias: produce() goes straight to the client hot
+        # path (str encoding + on_delivery handled there)
+        self.produce = self._rk.produce
 
     def produce_batch(self, topic: str, msgs: list[dict],
                       partition: int = PARTITION_UA) -> int:

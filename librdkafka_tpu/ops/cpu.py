@@ -50,6 +50,12 @@ def lib() -> ctypes.CDLL:
             fn.restype = None
             fn.argtypes = [ctypes.c_char_p, i64p, i64p, ctypes.c_int,
                            u8p, i64p, i64p, i64p, ctypes.c_int]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        L.tk_frame_v2_bound.restype = i64
+        L.tk_frame_v2_bound.argtypes = [i64, ctypes.c_int]
+        L.tk_frame_v2.restype = i64
+        L.tk_frame_v2.argtypes = [ctypes.c_char_p, i32p, i32p, i64p,
+                                  ctypes.c_int, u8p, i64]
         for name in ("tk_lz4f_bound", "tk_snappy_bound", "tk_lz4_block_bound",
                      "tk_snappy_uncompressed_length"):
             fn = getattr(L, name)
@@ -168,6 +174,30 @@ def snappy_java_decompress(data: bytes) -> bytes:
         out.write(snappy_decompress(data[i:i + chunk_len]))
         i += chunk_len
     return out.getvalue()
+
+
+# -------------------------------------------------------- record framing ---
+
+def frame_v2(base: bytes, klens: list[int], vlens: list[int],
+             ts_deltas: list[int]) -> bytes:
+    """Frame a batch of records into MessageSet v2 record wire layout in
+    one native call (GIL released — framing overlaps the app thread).
+    base = concatenated key||value bytes; klen/vlen -1 = null."""
+    L = lib()
+    count = len(klens)
+    ka = np.array(klens, dtype=np.int32)
+    va = np.array(vlens, dtype=np.int32)
+    ta = np.array(ts_deltas, dtype=np.int64)
+    cap = L.tk_frame_v2_bound(len(base), count)
+    buf, p = _outbuf(cap)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    r = L.tk_frame_v2(base, ka.ctypes.data_as(i32p),
+                      va.ctypes.data_as(i32p), ta.ctypes.data_as(i64p),
+                      count, p, cap)
+    if r < 0:
+        raise ValueError("tk_frame_v2 capacity shortfall")
+    return buf.raw[:r]
 
 
 # ------------------------------------------------------------- gzip/zstd ---

@@ -431,6 +431,66 @@ EXPORT int64_t tk_snappy_decompress(const uint8_t *src, int64_t n,
     return o;
 }
 
+// ---------------------------------------------------- v2 record framing --
+//
+// Frame a run of messages into the MessageSet v2 records wire layout
+// (reference hot loop: rd_kafka_msgset_writer_write_msg_v2,
+// rdkafka_msgset_writer.c:653 — per-record varint framing).  One call per
+// batch; the GIL is released for the duration, so framing overlaps the
+// app thread's produce() loop.  Headers are framed by the Python fallback.
+//
+// Layout per record: [len vi][attr=0][ts_delta vi][offset_delta vi]
+//                    [klen vi][key][vlen vi][value][header_cnt vi = 0]
+
+static inline int vi_size(int64_t v) {
+    uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);  // zigzag
+    int n = 1;
+    while (u >= 0x80) { u >>= 7; n++; }
+    return n;
+}
+
+static inline uint8_t *vi_put(uint8_t *p, int64_t v) {
+    uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    while (u >= 0x80) { *p++ = (uint8_t)(u | 0x80); u >>= 7; }
+    *p++ = (uint8_t)u;
+    return p;
+}
+
+// bytes needed in the worst case for `count` records over `payload_bytes`
+EXPORT int64_t tk_frame_v2_bound(int64_t payload_bytes, int count) {
+    return payload_bytes + (int64_t)count * 40 + 64;
+}
+
+// base: concatenated key||value bytes per message, in order
+// klens/vlens: -1 = null
+// ts_deltas: timestamp - first_timestamp per message
+// Returns bytes written, or -1 on capacity shortfall.
+EXPORT int64_t tk_frame_v2(const uint8_t *base, const int32_t *klens,
+                           const int32_t *vlens, const int64_t *ts_deltas,
+                           int count, uint8_t *out, int64_t cap) {
+    uint8_t *p = out;
+    const uint8_t *end = out + cap;
+    const uint8_t *src = base;
+    for (int i = 0; i < count; i++) {
+        int64_t kl = klens[i], vl = vlens[i];
+        int64_t body = 1 + vi_size(ts_deltas[i]) + vi_size(i)
+                     + vi_size(kl) + (kl > 0 ? kl : 0)
+                     + vi_size(vl) + (vl > 0 ? vl : 0)
+                     + 1;                       // header count varint(0)
+        if (p + vi_size(body) + body > end) return -1;
+        p = vi_put(p, body);
+        *p++ = 0;                               // record attributes
+        p = vi_put(p, ts_deltas[i]);
+        p = vi_put(p, i);                       // offset delta
+        p = vi_put(p, kl);
+        if (kl > 0) { memcpy(p, src, kl); p += kl; src += kl; }
+        p = vi_put(p, vl);
+        if (vl > 0) { memcpy(p, src, vl); p += vl; src += vl; }
+        *p++ = 0;                               // varint(0) headers
+    }
+    return p - out;
+}
+
 // ------------------------------------------------------ batched parallel --
 //
 // The provider seam (SURVEY.md §3.2) hands MANY independent per-partition
