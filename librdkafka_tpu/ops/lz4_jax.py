@@ -67,9 +67,16 @@ def _lz4_block_one(data, n, N: int):
     val = at(0) | (at(1) << 8) | (at(2) << 16) | (at(3) << 24)
     h = (val * U32(2654435761)) >> U32(32 - HASH_BITS)
 
-    # --- candidate[p]: predecessor with equal hash via stable argsort ----
-    order = jnp.argsort(h, stable=True).astype(I32)
-    h_sorted = h[order]
+    # --- candidate[p]: predecessor with equal hash --------------------
+    # one single-array sort of unique composite keys (hash<<17 | pos)
+    # reproduces the stable (hash, pos) order at a fraction of the
+    # argsort/pair-sort compile cost (the 64K sort dominated the 35 s
+    # XLA compile of the original formulation)
+    assert N <= (1 << 17)
+    key = (h.astype(I32) << 17) | pos
+    skey = jax.lax.sort(key)
+    order = skey & ((1 << 17) - 1)
+    h_sorted = skey >> 17
     prev_pos = jnp.concatenate([jnp.full((1,), -1, I32), order[:-1]])
     same = jnp.concatenate([jnp.zeros((1,), bool), h_sorted[1:] == h_sorted[:-1]])
     cand_sorted = jnp.where(same, prev_pos, -1)
@@ -103,14 +110,21 @@ def _lz4_block_one(data, n, N: int):
                                  (mlen0, valid & (mlen0 < mmax)))
 
     # --- greedy parse via pointer doubling -------------------------------
+    # fori_loop keeps the graph one-round-sized (the unrolled version
+    # cost ~35 s of XLA compile for N=64K)
     sink = I32(N + 1)
     nxt = jnp.where(valid, pos + mlen, pos + 1)
     jump = jnp.where(pos + 12 <= n, jnp.minimum(nxt, sink), sink)
-    J = jnp.concatenate([jump, jnp.full((2,), sink, I32)])     # (N+2,)
-    on = jnp.zeros((N + 2,), bool).at[0].set(True)
-    for _ in range(int(np.ceil(np.log2(N + 2))) + 1):
+    J0 = jnp.concatenate([jump, jnp.full((2,), sink, I32)])    # (N+2,)
+    on0 = jnp.zeros((N + 2,), bool).at[0].set(True)
+
+    def pd_round(_, st):
+        on, J = st
         on = on.at[jnp.where(on, J, sink)].set(True)
-        J = J[J]
+        return on, J[J]
+
+    rounds = int(np.ceil(np.log2(N + 2))) + 1
+    on, _ = jax.lax.fori_loop(0, rounds, pd_round, (on0, J0))
     match_here = on[:N] & valid
 
     # --- anchors (end of previous match) and literal runs ----------------
@@ -133,36 +147,32 @@ def _lz4_block_one(data, n, N: int):
     total_out = total_seq + 1 + efl + final_lit
 
     # --- compact sequences into dense tables (+ pseudo-seq for final run)
+    # one fused scatter builds all five tables (separate scatters were a
+    # large share of the XLA compile budget)
     di = jnp.where(match_here, jnp.cumsum(match_here.astype(I32)) - 1, D - 1)
-
-    def dense(vals, junk, pseudo=None):
-        d = jnp.full((D,), junk, I32).at[di].set(vals)
-        d = d.at[D - 1].set(junk)
-        if pseudo is not None:
-            d = d.at[S].set(pseudo)
-        return d
-
-    OOF = dense(out_off, int(C + 1), total_seq)
-    LITD = dense(lit, 0, final_lit)
-    ANCH = dense(anchor, 0, final_anchor)
-    MLEND = dense(mlen, MINMATCH)       # pseudo slot unused (masked by HASM)
-    OFFV = dense(pos - cand, 0)
+    junks = jnp.array([[int(C + 1)], [0], [0], [MINMATCH], [0]], I32)
+    vals = jnp.stack([out_off, lit, anchor, mlen, pos - cand])     # (5, N)
+    TBL = jnp.broadcast_to(junks, (5, D)).at[:, di].set(vals)
+    TBL = TBL.at[:, D - 1].set(junks[:, 0])
+    TBL = TBL.at[:3, S].set(jnp.stack([total_seq, final_lit, final_anchor]))
     # searchsorted needs OOF non-decreasing: real entries strictly increase,
     # pseudo = total_seq, padding = C+1.
+    OOF = TBL[0]
 
     # --- materialize every output byte in parallel -----------------------
     j = jnp.arange(C, dtype=I32)
     i = jnp.searchsorted(OOF, j, side="right").astype(I32) - 1
     i = jnp.clip(i, 0, D - 1)
-    r = j - OOF[i]
-    L = LITD[i]
+    G = TBL[:, i]                                                  # (5, C)
+    r = j - G[0]
+    L = G[1]
     elq = _extlen(L)
-    A = ANCH[i]
-    M = MLEND[i] - MINMATCH
+    A = G[2]
+    M = G[3] - MINMATCH
     emq = _extlen(M)
     hasm = i < S
     token = (jnp.minimum(L, 15) << 4) | jnp.where(hasm, jnp.minimum(M, 15), 0)
-    off = OFFV[i]
+    off = G[4]
     lit_start = 1 + elq
     lit_end = lit_start + L
     litb = data[jnp.clip(A + r - lit_start, 0, N - 1)].astype(I32)
