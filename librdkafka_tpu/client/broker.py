@@ -21,6 +21,7 @@ import errno
 import random
 import select
 import socket
+import ssl as _ssl
 import struct
 import threading
 import time
@@ -32,7 +33,7 @@ from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..protocol.msgset import MsgsetWriterV2
 from ..protocol.proto import ApiKey
-from .errors import Err, KafkaError
+from .errors import Err, KafkaError, KafkaException
 from .msg import Message, MsgStatus
 from .queue import Op, OpQueue, OpType
 
@@ -96,6 +97,7 @@ class Broker:
         self._next_connect = 0.0
         self.terminate = False
         self.fetch_inflight = False
+        self._tls_handshaking = False
         self.toppars: set = set()           # toppars led by this broker
         self._lock = threading.Lock()
         self.ts_connected = 0.0
@@ -154,6 +156,9 @@ class Broker:
                 self._serve_ops(min(0.05, self._next_connect - now))
                 return
         self._serve_ops(0)
+        if self._tls_handshaking:
+            self._tls_handshake_serve()
+            return
         self._serve_retries(now)
         if self.state == BrokerState.UP:
             if self.rk.is_producer:
@@ -198,9 +203,9 @@ class Broker:
     def _try_connect(self):
         self._set_state(BrokerState.TRY_CONNECT)
         try:
-            self.sock = socket.create_connection((self.host, self.port),
-                                                 timeout=self.rk.conf.get(
-                                                     "socket.timeout.ms") / 1000.0)
+            self.sock = self.rk.connect_cb(self.host, self.port,
+                                           self.rk.conf.get(
+                                               "socket.timeout.ms") / 1000.0)
             self.sock.setblocking(False)
             if self.rk.conf.get("socket.nagle.disable"):
                 self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -208,7 +213,58 @@ class Broker:
             self.sock = None
             self._connect_failed(f"connect failed: {e}")
             return
+        except KafkaException as e:
+            self.sock = None
+            self._connect_failed(e.error.reason)
+            return
         self.ts_connected = time.monotonic()
+        # TLS: wrap the socket and drive the non-blocking handshake from
+        # the serve loop (reference: rdkafka_transport.c:612-719 drives
+        # rd_kafka_transport_ssl_handshake from CONNECT state)
+        ctx = self.rk.ssl_ctx()
+        if ctx is not None:
+            try:
+                self.sock = ctx.wrap_socket(self.sock, server_hostname=self.host,
+                                            do_handshake_on_connect=False)
+            except (OSError, ValueError) as e:
+                self._disconnect(KafkaError(Err._SSL, f"TLS wrap: {e}"))
+                return
+            self._tls_handshaking = True
+            self._set_state(BrokerState.CONNECT)
+            return
+        self._connected()
+
+    def _tls_handshake_serve(self):
+        """Advance the TLS handshake; non-blocking with a short select
+        so the broker thread keeps serving ops during slow handshakes.
+        Bounded by socket.timeout.ms like every other setup stage."""
+        if (time.monotonic() - self.ts_connected >
+                self.rk.conf.get("socket.timeout.ms") / 1000.0):
+            self._disconnect(KafkaError(Err._SSL, "TLS handshake timed out"))
+            return
+        try:
+            self.sock.do_handshake()
+        except _ssl.SSLWantReadError:
+            select.select([self.sock], [], [], 0.05)
+            return
+        except _ssl.SSLWantWriteError:
+            select.select([], [self.sock], [], 0.05)
+            return
+        except (OSError, _ssl.SSLError) as e:
+            self._disconnect(KafkaError(Err._SSL, f"TLS handshake: {e}"))
+            return
+        self._tls_handshaking = False
+        cert = None
+        try:
+            cert = self.sock.getpeercert()
+        except (ValueError, OSError):
+            pass
+        self.rk.dbg("security",
+                    f"{self.name}: TLS established "
+                    f"({self.sock.version()}, peer={'verified' if cert else 'unverified'})")
+        self._connected()
+
+    def _connected(self):
         self._set_state(BrokerState.APIVERSION_QUERY)
         # ApiVersions negotiation (reference: rdkafka_request.c:1809)
         if self.rk.conf.get("api.version.request"):
@@ -265,6 +321,7 @@ class Broker:
         self._rbuf.clear()
         self._wbuf.clear()
         self.fetch_inflight = False
+        self._tls_handshaking = False
         # fail all in-flight + queued requests (callers decide on retry)
         for req in list(self.waitresp.values()):
             self._req_fail(req, err)
@@ -316,6 +373,8 @@ class Broker:
             while self._wbuf:
                 n = self.sock.send(self._wbuf)
                 del self._wbuf[:n]
+        except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+            return
         except (BlockingIOError, InterruptedError):
             return
         except OSError as e:
@@ -327,6 +386,13 @@ class Broker:
         rlist = [self._wakeup_r]
         wlist = []
         if self.sock:
+            # decrypted TLS bytes may already be buffered in the SSL
+            # layer where select() cannot see them
+            if isinstance(self.sock, _ssl.SSLSocket) and self.sock.pending():
+                self._recv()
+                timeout = 0
+            if self.sock is None:    # _recv may have disconnected
+                return
             rlist.append(self.sock)
             if self._wbuf:
                 wlist.append(self.sock)
@@ -346,19 +412,32 @@ class Broker:
             self._recv()
 
     def _recv(self):
-        try:
-            data = self.sock.recv(1 << 20)
-        except (BlockingIOError, InterruptedError):
+        # Loop until the socket would block: a TLS record may decrypt to
+        # more bytes than one recv() surfaces, and SSLSocket buffers
+        # decrypted data invisible to select() (hence the pending() check
+        # in _io_serve).
+        got = 0
+        while True:
+            try:
+                data = self.sock.recv(1 << 20)
+            except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError,
+                    BlockingIOError, InterruptedError):
+                break
+            except OSError as e:
+                self._disconnect(KafkaError(Err._TRANSPORT,
+                                            f"recv failed: {e}"))
+                return
+            if not data:
+                self._disconnect(KafkaError(Err._TRANSPORT,
+                                            "connection closed by peer"))
+                return
+            self._rbuf += data
+            got += len(data)
+            if len(data) < (1 << 20):
+                break
+        if not got:
             return
-        except OSError as e:
-            self._disconnect(KafkaError(Err._TRANSPORT, f"recv failed: {e}"))
-            return
-        if not data:
-            self._disconnect(KafkaError(Err._TRANSPORT,
-                                        "connection closed by peer"))
-            return
-        self._rbuf += data
-        self.c_rx_bytes += len(data)
+        self.c_rx_bytes += got
         while len(self._rbuf) >= 4:
             (n,) = struct.unpack(">i", self._rbuf[:4])
             if n < 0 or n > self.rk.conf.get("receive.message.max.bytes"):

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import random
+import socket
 import sys
 import threading
 import time
@@ -161,6 +162,18 @@ class Kafka:
         self.idemp = (IdempotenceManager(self)
                       if self.is_producer and conf.get("enable.idempotence")
                       else None)
+
+        # TLS context — one per instance, shared by all broker threads
+        # (reference: rd_kafka_ssl_ctx_init, rdkafka_ssl.c)
+        from . import tls as _tls
+        self._ssl_ctx = _tls.make_client_ctx(conf)
+
+        # SASL mechanism validation happens at client creation so a
+        # misconfigured mechanism fails fast (reference: rd_kafka_new
+        # sasl checks, rdkafka.c:~2000)
+        if self.sasl_required():
+            from .sasl import validate_mechanism
+            validate_mechanism(conf)
 
         from .stats import StatsCollector
         self.stats = StatsCollector(self)
@@ -736,7 +749,56 @@ class Kafka:
         if self.mock_cluster:
             self.mock_cluster.stop()
 
-    # ---------------------------------------------------------- SASL stub --
+    # ----------------------------------------------------------- security --
+    def ssl_ctx(self):
+        """The per-instance TLS context, or None for plaintext
+        (reference: rk_conf.ssl.ctx built at rd_kafka_ssl_ctx_init)."""
+        return self._ssl_ctx
+
+    def connect_cb(self, host: str, port: int, timeout: float):
+        """Create the TCP connection for a broker. Honors the app's
+        ``connect_cb``/``socket_cb`` conf hooks — the seam the reference
+        exposes for sockem-style network shaping (rdkafka_conf.c
+        socket_cb/connect_cb; tests/sockem.c interposes here). Also
+        applies socket.* buffer/keepalive knobs and
+        broker.address.family resolution."""
+        cb = self.conf.get("connect_cb")
+        if cb is not None:
+            return cb(host, port, timeout)
+        fam_conf = self.conf.get("broker.address.family")
+        family = {"v4": socket.AF_INET, "v6": socket.AF_INET6}.get(
+            fam_conf, socket.AF_UNSPEC)
+        sock_cb = self.conf.get("socket_cb")
+        last_err = None
+        for af, stype, sproto, _, addr in socket.getaddrinfo(
+                host, port, family, socket.SOCK_STREAM):
+            try:
+                s = (sock_cb(af, stype, sproto) if sock_cb is not None
+                     else socket.socket(af, stype, sproto))
+            except OSError as e:
+                last_err = e
+                continue
+            try:
+                sndbuf = self.conf.get("socket.send.buffer.bytes")
+                if sndbuf:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+                rcvbuf = self.conf.get("socket.receive.buffer.bytes")
+                if rcvbuf:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+                if self.conf.get("socket.keepalive.enable"):
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                s.settimeout(timeout)
+                s.connect(addr)
+                return s
+            except OSError as e:
+                last_err = e
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        raise last_err or OSError(f"cannot resolve {host}:{port}")
+
+    # ---------------------------------------------------------------- SASL --
     def sasl_required(self) -> bool:
         return self.conf.get("security.protocol") in ("sasl_plaintext",
                                                       "sasl_ssl")

@@ -17,11 +17,32 @@ from typing import TYPE_CHECKING, Optional
 
 from ..protocol.apis import APIS
 from ..protocol.proto import ApiKey
-from .errors import Err, KafkaError
+from .errors import Err, KafkaError, KafkaException
 
 if TYPE_CHECKING:
     from .broker import Broker
     from .kafka import Kafka
+
+
+SUPPORTED_MECHANISMS = ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512",
+                        "OAUTHBEARER")
+
+
+def validate_mechanism(conf) -> None:
+    """Fail fast at client creation for unsupported mechanisms
+    (reference: rd_kafka_sasl_select_provider, rdkafka_sasl.c:~350 —
+    GSSAPI requires libsasl2/cyrus which this build does not link)."""
+    mech = conf.get("sasl.mechanisms").upper()
+    if mech in ("GSSAPI", "KERBEROS"):
+        raise KafkaException(
+            Err._UNSUPPORTED_FEATURE,
+            "SASL mechanism GSSAPI (Kerberos) is not supported in this "
+            "build; supported: " + ", ".join(SUPPORTED_MECHANISMS))
+    if mech not in SUPPORTED_MECHANISMS:
+        raise KafkaException(
+            Err._UNSUPPORTED_FEATURE,
+            f"Unsupported sasl.mechanisms {mech!r}; supported: "
+            + ", ".join(SUPPORTED_MECHANISMS))
 
 
 def sasl_client_start(rk: "Kafka", broker: "Broker") -> None:

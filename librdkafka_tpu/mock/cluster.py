@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import selectors
 import socket
+import ssl as _ssl
 import struct
 import threading
 import time
@@ -123,14 +124,38 @@ class _Conn:
         self.rbuf = bytearray()
         self.wbuf = bytearray()
         self.closed = False
+        self.handshaking = False    # TLS handshake in progress
+        self.sasl_mech = ""         # mechanism from SaslHandshake
+        self.scram = None           # server-side SCRAM exchange state
 
 
 class MockCluster:
     """In-process fake Kafka cluster over real localhost TCP sockets."""
 
     def __init__(self, num_brokers: int = 3, topics: Optional[dict] = None,
-                 auto_create_topics: bool = True, default_partitions: int = 4):
+                 auto_create_topics: bool = True, default_partitions: int = 4,
+                 tls: Optional[dict] = None,
+                 sasl_users: Optional[dict] = None):
+        """``tls``: enable the TLS listener mode —
+        ``{"certfile": ..., "keyfile": ..., "cafile": ...,
+        "require_client_cert": bool}``. All mock brokers then speak TLS
+        (like a real cluster with an SSL listener); clients must set
+        ``security.protocol=ssl``/``sasl_ssl``.
+
+        ``sasl_users``: ``{username: password}`` credential table. When
+        set, PLAIN checks credentials and SCRAM runs the full RFC 5802
+        server-side exchange (salted PBKDF2 verifier, client-proof
+        verification, server signature); when None, PLAIN accepts any
+        non-empty credentials and SCRAM is rejected (the server needs a
+        real password to derive keys)."""
         self.num_brokers = num_brokers
+        self.sasl_users = sasl_users
+        self._tls_ctx = None
+        if tls:
+            from ..client.tls import make_server_ctx
+            self._tls_ctx = make_server_ctx(
+                tls["certfile"], tls["keyfile"], tls.get("cafile"),
+                tls.get("require_client_cert", False))
         self.auto_create_topics = auto_create_topics
         self.default_partitions = default_partitions
         self.topics: dict[str, list[MockPartition]] = {}
@@ -250,8 +275,18 @@ class MockCluster:
                         continue
                     s.setblocking(False)
                     conn = _Conn(s, broker_id)
+                    if self._tls_ctx is not None:
+                        try:
+                            conn.sock = self._tls_ctx.wrap_socket(
+                                s, server_side=True,
+                                do_handshake_on_connect=False)
+                            conn.handshaking = True
+                        except (OSError, ValueError):
+                            s.close()
+                            continue
                     self._conns.append(conn)
-                    self._sel.register(s, selectors.EVENT_READ, ("conn", conn))
+                    self._sel.register(conn.sock, selectors.EVENT_READ,
+                                       ("conn", conn))
                 else:
                     conn = key.data[1]
                     if mask & selectors.EVENT_READ:
@@ -267,10 +302,37 @@ class MockCluster:
             self._serve_parked_fetches(now)
             self._serve_group_timers(now)
 
+    def _hs_serve(self, conn: _Conn) -> bool:
+        """Advance a server-side TLS handshake; True once established."""
+        try:
+            conn.sock.do_handshake()
+        except _ssl.SSLWantReadError:
+            return False
+        except _ssl.SSLWantWriteError:
+            try:
+                self._sel.modify(conn.sock,
+                                 selectors.EVENT_READ | selectors.EVENT_WRITE,
+                                 ("conn", conn))
+            except (KeyError, ValueError):
+                pass
+            return False
+        except (OSError, _ssl.SSLError):
+            self._close(conn)
+            return False
+        conn.handshaking = False
+        try:
+            self._sel.modify(conn.sock, selectors.EVENT_READ, ("conn", conn))
+        except (KeyError, ValueError):
+            pass
+        return True
+
     def _read(self, conn: _Conn):
+        if conn.handshaking:
+            self._hs_serve(conn)
+            return
         try:
             data = conn.sock.recv(262144)
-        except BlockingIOError:
+        except (BlockingIOError, _ssl.SSLWantReadError, _ssl.SSLWantWriteError):
             return
         except OSError:
             self._close(conn)
@@ -279,6 +341,17 @@ class MockCluster:
             self._close(conn)
             return
         conn.rbuf += data
+        # drain SSL-layer buffered records invisible to the selector
+        while self._tls_ctx is not None:
+            try:
+                if not conn.sock.pending():
+                    break
+                more = conn.sock.recv(262144)
+            except (OSError, ValueError):
+                break
+            if not more:
+                break
+            conn.rbuf += more
         while len(conn.rbuf) >= 4:
             (n,) = struct.unpack(">i", conn.rbuf[:4])
             if len(conn.rbuf) < 4 + n:
@@ -311,11 +384,14 @@ class MockCluster:
     def _flush(self, conn: _Conn):
         if conn.closed:
             return
+        if conn.handshaking:
+            self._hs_serve(conn)
+            return
         try:
             while conn.wbuf:
                 sent = conn.sock.send(conn.wbuf)
                 del conn.wbuf[:sent]
-        except BlockingIOError:
+        except (BlockingIOError, _ssl.SSLWantReadError, _ssl.SSLWantWriteError):
             try:
                 self._sel.modify(conn.sock,
                                  selectors.EVENT_READ | selectors.EVENT_WRITE,
@@ -875,14 +951,95 @@ class MockCluster:
         err = 0
         if body["mechanism"] not in mechs:
             err = Err.UNSUPPORTED_SASL_MECHANISM.wire
+        conn.sasl_mech = body["mechanism"]
+        conn.scram = None
         return {"error_code": err, "mechanisms": mechs}
 
+    @staticmethod
+    def _sasl_fail(msg="authentication failed"):
+        return {"error_code": Err.SASL_AUTHENTICATION_FAILED.wire,
+                "error_message": msg, "auth_bytes": b""}
+
     def _h_SaslAuthenticate(self, conn, corrid, hdr, body, inject):
-        # PLAIN: [authzid] \0 authcid \0 passwd — accept any non-empty creds
-        parts = (body["auth_bytes"] or b"").split(b"\x00")
-        ok = len(parts) == 3 and parts[1] and parts[2]
-        if inject or not ok:
-            return {"error_code": Err.SASL_AUTHENTICATION_FAILED.wire,
-                    "error_message": "authentication failed",
-                    "auth_bytes": b""}
+        data = body["auth_bytes"] or b""
+        if inject:
+            return self._sasl_fail()
+        if conn.sasl_mech.startswith("SCRAM") or conn.scram is not None:
+            return self._scram_auth(conn, data)
+        if conn.sasl_mech == "OAUTHBEARER":
+            # "n,a=...,\x01auth=Bearer <jws>\x01\x01" — accept any
+            # well-formed unsecured JWS (the reference's builtin handler
+            # produces exactly this shape)
+            ok = data.startswith(b"n,") and b"\x01auth=Bearer " in data
+            return ({"error_code": 0, "error_message": None,
+                     "auth_bytes": b""} if ok else self._sasl_fail())
+        # PLAIN: [authzid] \0 authcid \0 passwd
+        parts = data.split(b"\x00")
+        if len(parts) != 3 or not parts[1] or not parts[2]:
+            return self._sasl_fail()
+        if self.sasl_users is not None:
+            user, pw = parts[1].decode(), parts[2].decode()
+            if self.sasl_users.get(user) != pw:
+                return self._sasl_fail()
         return {"error_code": 0, "error_message": None, "auth_bytes": b""}
+
+    def _scram_auth(self, conn, data: bytes):
+        """Server half of RFC 5802 (the peer of the client exchange in
+        client/sasl.py ScramClient; reference server behavior is the real
+        broker's — rdkafka_sasl_scram.c only implements the client)."""
+        import base64
+        import hashlib
+        import hmac
+        import os
+        hashname = ("sha256" if conn.sasl_mech == "SCRAM-SHA-256"
+                    else "sha512")
+        if conn.scram is None:
+            if self.sasl_users is None:
+                return self._sasl_fail("SCRAM requires mock sasl_users")
+            try:
+                txt = data.decode()
+                if not txt.startswith("n,,"):
+                    return self._sasl_fail("bad GS2 header")
+                bare = txt[3:]
+                fields = dict(kv.split("=", 1) for kv in bare.split(","))
+                user = fields["n"].replace("=2C", ",").replace("=3D", "=")
+                cnonce = fields["r"]
+            except (ValueError, KeyError, UnicodeDecodeError):
+                return self._sasl_fail("malformed client-first")
+            pw = self.sasl_users.get(user)
+            if pw is None:
+                return self._sasl_fail("unknown user")
+            salt = os.urandom(16)
+            iters = 4096
+            snonce = base64.b64encode(os.urandom(18)).decode()
+            server_first = (f"r={cnonce}{snonce},"
+                            f"s={base64.b64encode(salt).decode()},i={iters}")
+            salted = hashlib.pbkdf2_hmac(hashname, pw.encode(), salt, iters)
+            conn.scram = (bare, server_first, salted)
+            return {"error_code": 0, "error_message": None,
+                    "auth_bytes": server_first.encode()}
+        bare, server_first, salted = conn.scram
+        conn.scram = None
+        try:
+            txt = data.decode()
+            without_proof, _, proof_b64 = txt.rpartition(",p=")
+            fields = dict(kv.split("=", 1) for kv in without_proof.split(","))
+            proof = base64.b64decode(proof_b64)
+        except (ValueError, UnicodeDecodeError):
+            return self._sasl_fail("malformed client-final")
+        expect_nonce = dict(kv.split("=", 1)
+                            for kv in server_first.split(","))["r"]
+        if fields.get("r") != expect_nonce:
+            return self._sasl_fail("nonce mismatch")
+        auth_msg = ",".join([bare, server_first, without_proof]).encode()
+        client_key = hmac.new(salted, b"Client Key", hashname).digest()
+        stored_key = hashlib.new(hashname, client_key).digest()
+        sig = hmac.new(stored_key, auth_msg, hashname).digest()
+        recovered = bytes(a ^ b for a, b in zip(proof, sig))
+        if hashlib.new(hashname, recovered).digest() != stored_key:
+            return self._sasl_fail("proof verification failed")
+        server_key = hmac.new(salted, b"Server Key", hashname).digest()
+        v = base64.b64encode(
+            hmac.new(server_key, auth_msg, hashname).digest()).decode()
+        return {"error_code": 0, "error_message": None,
+                "auth_bytes": f"v={v}".encode()}

@@ -237,7 +237,7 @@ def _term_host(n: int) -> int:
     return v
 
 
-@lru_cache(maxsize=8)
+@lru_cache(maxsize=16)
 def _jit_mxu(B: int, N: int = _MXU_BLOCK):
     Q = jnp.asarray(_q_matrix(N))
     pow2 = jnp.asarray((1 << np.arange(32)).astype(np.int64)).astype(_U32)
@@ -256,7 +256,7 @@ def _jit_mxu(B: int, N: int = _MXU_BLOCK):
     return jax.jit(fn)
 
 
-@lru_cache(maxsize=8)
+@lru_cache(maxsize=16)
 def _jit_mxu_pallas(B: int, N: int = _MXU_BLOCK, CB: int = 2048):
     """Pallas variant: bit-plane expansion fused with the matmul in VMEM
     (rows of Q reordered to (chunk, bit-plane, position))."""

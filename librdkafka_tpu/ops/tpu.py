@@ -57,9 +57,14 @@ class TpuCodecProvider:
             import threading
 
             def _warm():
+                # shapes must match real traffic: the lz4 kernel caches
+                # per next_pow2(block len) — 64KB is the production
+                # block size — and the CRC matmul caches per pow2 batch
+                # bucket, so warm the full-chunk bucket too
                 try:
-                    lz4_block_compress_many([b"warmup" * 16])
-                    _crc32c_many_mxu([b"warmup" * 16])
+                    blk = b"\x00" * LZ4F_BLOCKSIZE
+                    lz4_block_compress_many([blk])
+                    _crc32c_many_mxu([blk] * self.min_batches)
                 except Exception:
                     pass
 

@@ -1,0 +1,90 @@
+"""Test-certificate factory for TLS tests (the role the reference's
+tests/fixtures + trivup SSL setup play). Generates a throwaway CA, a
+server cert for 127.0.0.1/localhost, and a client cert, all PEM, plus a
+PKCS#12 keystore bundling the client pair."""
+import datetime
+import os
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import rsa
+from cryptography.hazmat.primitives.serialization import pkcs12
+from cryptography.x509.oid import NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _key():
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def _name(cn):
+    return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+
+def _cert(subject_cn, issuer_name, issuer_key, pubkey, *, is_ca=False,
+          san=None):
+    now = datetime.datetime.now(datetime.timezone.utc)
+    b = (x509.CertificateBuilder()
+         .subject_name(_name(subject_cn))
+         .issuer_name(issuer_name)
+         .public_key(pubkey)
+         .serial_number(x509.random_serial_number())
+         .not_valid_before(now - _ONE_DAY)
+         .not_valid_after(now + 30 * _ONE_DAY)
+         .add_extension(x509.BasicConstraints(ca=is_ca, path_length=None),
+                        critical=True))
+    if san:
+        b = b.add_extension(x509.SubjectAlternativeName(san), critical=False)
+    return b.sign(issuer_key, hashes.SHA256())
+
+
+def make_certs(tmpdir: str) -> dict:
+    """Returns paths: ca, server_cert, server_key, client_cert,
+    client_key, client_p12 (password 'kstore')."""
+    import ipaddress
+    ca_key = _key()
+    ca_cert = _cert("mock-ca", _name("mock-ca"), ca_key,
+                    ca_key.public_key(), is_ca=True)
+
+    srv_key = _key()
+    srv_cert = _cert("localhost", ca_cert.subject, ca_key,
+                     srv_key.public_key(),
+                     san=[x509.DNSName("localhost"),
+                          x509.IPAddress(ipaddress.ip_address("127.0.0.1"))])
+
+    cli_key = _key()
+    cli_cert = _cert("mock-client", ca_cert.subject, ca_key,
+                     cli_key.public_key())
+
+    paths = {}
+
+    def w(name, data):
+        p = os.path.join(tmpdir, name)
+        with open(p, "wb") as f:
+            f.write(data)
+        paths[name] = p
+        return p
+
+    pem_priv = lambda k: k.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    pem_cert = lambda c: c.public_bytes(serialization.Encoding.PEM)
+
+    w("ca.pem", pem_cert(ca_cert))
+    w("server.pem", pem_cert(srv_cert))
+    w("server.key", pem_priv(srv_key))
+    w("client.pem", pem_cert(cli_cert))
+    w("client.key", pem_priv(cli_key))
+    w("client.p12", pkcs12.serialize_key_and_certificates(
+        b"client", cli_key, cli_cert, [ca_cert],
+        serialization.BestAvailableEncryption(b"kstore")))
+    return {
+        "ca": paths["ca.pem"],
+        "server_cert": paths["server.pem"],
+        "server_key": paths["server.key"],
+        "client_cert": paths["client.pem"],
+        "client_key": paths["client.key"],
+        "client_p12": paths["client.p12"],
+    }
