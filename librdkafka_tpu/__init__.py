@@ -27,3 +27,5 @@ from .client.errors import KafkaError, KafkaException  # noqa: F401
 from .client.conf import Conf, TopicConf  # noqa: F401
 from .client.producer import Producer  # noqa: F401
 from .client.consumer import Consumer  # noqa: F401
+from .client.admin import (AdminClient, ConfigEntry, ConfigResource,  # noqa: F401
+                           NewPartitions, NewTopic)

@@ -91,6 +91,9 @@ class Broker:
         self._wbuf = bytearray()
         self._wakeup_r, self._wakeup_w = socket.socketpair()
         self._wakeup_r.setblocking(False)
+        # non-blocking: a full pipe must drop the wakeup byte (reader is
+        # already pending), never block the op-pushing thread
+        self._wakeup_w.setblocking(False)
         self.ops.set_wakeup_cb(self._wakeup)
         self.api_versions: dict[int, int] = {}
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
@@ -433,7 +436,10 @@ class Broker:
                 return
             self._rbuf += data
             got += len(data)
-            if len(data) < (1 << 20):
+            # SSLSocket.recv returns one decrypted record (~16KB) per
+            # call, so only a would-block exception ends the loop; cap
+            # the drain so a firehose peer can't starve the serve loop
+            if got >= (8 << 20):
                 break
         if not got:
             return

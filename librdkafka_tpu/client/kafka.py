@@ -269,11 +269,12 @@ class Kafka:
         if names == []:
             names = None if not self.is_consumer else []
         self.dbg("metadata", f"refresh ({reason}) via {b.name}")
+        full = not names        # None or [] → broker enumerates all topics
         b.enqueue_request(Request(
             ApiKey.Metadata, {"topics": names}, retries_left=2,
-            cb=self._handle_metadata))
+            cb=lambda e, r: self._handle_metadata(e, r, full=full)))
 
-    def _handle_metadata(self, err, resp):
+    def _handle_metadata(self, err, resp, full: bool = False):
         self._metadata_inflight = False
         if err is not None:
             return
@@ -281,11 +282,25 @@ class Kafka:
             new_brokers = {b["node_id"]: (b["host"], b["port"])
                            for b in resp["brokers"]}
             self.metadata["brokers"] = new_brokers
+            self.metadata["controller_id"] = resp.get("controller_id", -1)
+            seen = set()
             for t in resp["topics"]:
-                if Err.from_wire(t["error_code"]) != Err.NO_ERROR:
+                terr = Err.from_wire(t["error_code"])
+                if terr == Err.UNKNOWN_TOPIC_OR_PART:
+                    # topic deleted: drop it from the cache
+                    self.metadata["topics"].pop(t["topic"], None)
                     continue
+                if terr != Err.NO_ERROR:
+                    continue
+                seen.add(t["topic"])
                 self.metadata["topics"][t["topic"]] = {
                     p["partition"]: p["leader"] for p in t["partitions"]}
+            if full:
+                # a full metadata response enumerates every topic: prune
+                # cache entries that vanished (deleted topics)
+                for name in list(self.metadata["topics"]):
+                    if name not in seen:
+                        del self.metadata["topics"][name]
         # instantiate broker threads for newly discovered nodes
         with self._brokers_lock:
             for nid, (host, port) in new_brokers.items():
