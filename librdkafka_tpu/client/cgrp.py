@@ -10,6 +10,7 @@ generation changes; max.poll.interval.ms is enforced here (:2742).
 """
 from __future__ import annotations
 
+import re
 import time
 from typing import Optional, TYPE_CHECKING
 
@@ -35,6 +36,12 @@ class ConsumerGroup:
         self.generation = -1
         self.protocol = ""
         self.subscription: list[str] = []
+        self.patterns: list = []            # compiled ^regex subscriptions
+        self._matched: set[str] = set()     # topics currently matching
+        # bumped by rejoin(); a JoinGroup begun under an older version is
+        # abandoned on response instead of syncing a stale subscription
+        self.sub_version = 0
+        self._join_version = 0
         self.assignment: dict[str, list[int]] = {}
         self.rebalance_cnt = 0
         self.last_heartbeat = 0.0
@@ -48,14 +55,59 @@ class ConsumerGroup:
 
     # ------------------------------------------------------------ public --
     def subscribe(self, topics: list[str]):
+        """Topics starting with ``^`` are regex patterns matched against
+        the full cluster topic list (reference: rdkafka_pattern.c topic
+        pattern lists; the ``^`` prefix is part of the regex, matched
+        with search semantics like the reference's regexec).
+
+        All patterns are validated before any state changes (like the
+        reference, a bad pattern fails the whole subscribe atomically)."""
+        pats = []
+        for t in topics:
+            if t.startswith("^"):
+                try:
+                    pats.append(re.compile(t))
+                except re.error as e:
+                    from .errors import KafkaException
+                    raise KafkaException(Err._INVALID_ARG,
+                                         f"bad subscription regex {t!r}: {e}")
         self.subscription = list(topics)
+        self.patterns = pats
+        self._matched = set()
+        # literals after patterns are installed: their metadata_refresh
+        # must request the FULL topic list for pattern discovery
         for t in topics:
             if not t.startswith("^"):
                 self.rk.get_topic(t)
+        if self.patterns:
+            self.rk.metadata_refresh("regex subscription")
         self.rejoin("subscribe")
+
+    def effective_subscription(self) -> list[str]:
+        """Literal topics + current regex matches."""
+        lits = [t for t in self.subscription if not t.startswith("^")]
+        return sorted(set(lits) | self._matched)
+
+    def metadata_update(self, topic_names) -> None:
+        """Re-evaluate regex patterns against a fresh full topic list
+        (reference: rd_kafka_cgrp_metadata_update_check); rejoin when the
+        matched set changes so the group rebalances onto new topics."""
+        if not self.patterns:
+            return
+        matched = {t for t in topic_names
+                   if any(p.search(t) for p in self.patterns)}
+        if matched == self._matched:
+            return
+        added = matched - self._matched
+        self._matched = matched
+        for t in added:
+            self.rk.get_topic(t)
+        self.rejoin(f"regex match changed (+{sorted(added)})")
 
     def unsubscribe(self):
         self.subscription = []
+        self.patterns = []
+        self._matched = set()
         self._leave()
 
     def poll_tick(self):
@@ -64,6 +116,7 @@ class ConsumerGroup:
 
     def rejoin(self, reason: str):
         self.rk.dbg("cgrp", f"rejoin: {reason}")
+        self.sub_version += 1
         if self.join_state in ("started", "steady"):
             self._trigger_rebalance_revoke()
         self.join_state = "init"
@@ -142,9 +195,9 @@ class ConsumerGroup:
             return
         self._pending = True
         self.join_state = "wait-join"
+        self._join_version = self.sub_version
         names = self.rk.conf.get("partition.assignment.strategy").split(",")
-        meta = subscription_encode(
-            [t for t in self.subscription if not t.startswith("^")])
+        meta = subscription_encode(self.effective_subscription())
         self.rk.dbg("cgrp", f"joining group {self.group_id!r} "
                             f"member={self.member_id!r}")
         b.enqueue_request(Request(
@@ -162,6 +215,12 @@ class ConsumerGroup:
 
     def _handle_join(self, err, resp):
         self._pending = False
+        if self.sub_version != self._join_version:
+            # subscription changed while the JoinGroup was in flight
+            # (e.g. a regex matched new topics): abandon and rejoin with
+            # the fresh effective subscription
+            self.join_state = "init"
+            return
         if err is not None:
             self.join_state = "init"
             return

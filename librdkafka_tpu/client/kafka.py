@@ -135,6 +135,7 @@ class Kafka:
         self.metadata: dict = {"brokers": {}, "topics": {}}
         self._metadata_lock = threading.Lock()
         self._metadata_inflight = False
+        self._metadata_refresh_queued = False
         self.flushing = False
         self.terminating = False
         self.fatal_error: Optional[KafkaError] = None
@@ -256,7 +257,14 @@ class Kafka:
         return random.choice(ups) if ups else None
 
     def metadata_refresh(self, reason: str = ""):
-        if self._metadata_inflight or self.terminating:
+        if self.terminating:
+            return
+        if self._metadata_inflight:
+            # queue one follow-up so a refresh requested mid-flight (e.g.
+            # regex discovery racing a sparse refresh) is not lost until
+            # the periodic timer (reference: rd_kafka_metadata_refresh
+            # coalescing)
+            self._metadata_refresh_queued = True
             return
         b = self.any_up_broker()
         if b is None:
@@ -268,6 +276,9 @@ class Kafka:
             names = list(self.topics) if sparse else None
         if names == []:
             names = None if not self.is_consumer else []
+        if self.cgrp is not None and self.cgrp.patterns:
+            # regex subscriptions need the full cluster topic list
+            names = None
         self.dbg("metadata", f"refresh ({reason}) via {b.name}")
         full = not names        # None or [] → broker enumerates all topics
         b.enqueue_request(Request(
@@ -276,6 +287,10 @@ class Kafka:
 
     def _handle_metadata(self, err, resp, full: bool = False):
         self._metadata_inflight = False
+        if self._metadata_refresh_queued:
+            self._metadata_refresh_queued = False
+            self.timers.add(0.05, lambda: self.metadata_refresh("queued"),
+                            once=True)
         if err is not None:
             return
         with self._metadata_lock:
@@ -291,6 +306,10 @@ class Kafka:
                     self.metadata["topics"].pop(t["topic"], None)
                     continue
                 if terr != Err.NO_ERROR:
+                    # transient (e.g. LEADER_NOT_AVAILABLE during
+                    # election): the topic still exists — keep it in
+                    # `seen` so prune/regex don't treat it as deleted
+                    seen.add(t["topic"])
                     continue
                 seen.add(t["topic"])
                 self.metadata["topics"][t["topic"]] = {
@@ -301,6 +320,9 @@ class Kafka:
                 for name in list(self.metadata["topics"]):
                     if name not in seen:
                         del self.metadata["topics"][name]
+        if full and self.cgrp is not None:
+            # regex subscription re-evaluation (rdkafka_pattern.c)
+            self.cgrp.metadata_update(seen)
         # instantiate broker threads for newly discovered nodes
         with self._brokers_lock:
             for nid, (host, port) in new_brokers.items():
@@ -374,13 +396,17 @@ class Kafka:
 
     # -------------------------------------------------------------- topics --
     def get_topic(self, name: str) -> Topic:
+        created = False
         with self._topics_lock:
             t = self.topics.get(name)
             if t is None:
                 t = Topic(name, self.conf.topic_conf())
                 self.topics[name] = t
-                self.metadata_refresh(f"new topic {name}")
-            return t
+                created = True
+        if created:
+            # outside _topics_lock: metadata_refresh re-acquires it
+            self.metadata_refresh(f"new topic {name}")
+        return t
 
     def topic_conf_for(self, name: str) -> TopicConf:
         with self._topics_lock:

@@ -1,0 +1,126 @@
+"""Network-shaping fault tests via the sockem shim (reference:
+tests/sockem.c interposed through socket_cb/connect_cb; test patterns
+from 0075-retry.c and 0088-produce_metadata_timeout.c): latency and
+bandwidth shaping on live connections, and — the critical one —
+killing a connection mid-ProduceRequest and proving the idempotent
+producer retries without duplication."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.mock.sockem import Sockem
+from librdkafka_tpu.protocol.msgset import iter_batches, parse_records_v2
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"net": 1})
+    yield c
+    c.stop()
+
+
+def _log_values(cluster, topic="net", part=0):
+    vals = []
+    for base, blob in cluster.partition(topic, part).log:
+        for info, payload, full in iter_batches(blob):
+            vals += [r.value for r in parse_records_v2(info, payload)]
+    return vals
+
+
+def test_latency_injection_slows_but_delivers(cluster):
+    em = Sockem(delay_ms=0)
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "connect_cb": em.connect_cb, "linger.ms": 2})
+    p.produce("net", value=b"fast", partition=0)
+    assert p.flush(10.0) == 0
+    assert em.connect_count >= 1
+
+    em.set(delay_ms=400)
+    t0 = time.monotonic()
+    p.produce("net", value=b"slow", partition=0)
+    assert p.flush(15.0) == 0
+    took = time.monotonic() - t0
+    assert took >= 0.4, f"delay not applied ({took:.3f}s)"
+    assert _log_values(cluster) == [b"fast", b"slow"]
+    p.close()
+
+
+def test_rate_limit_paces_transfer(cluster):
+    em = Sockem(rate_bps=40000)
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "connect_cb": em.connect_cb, "linger.ms": 2,
+                  "compression.codec": "none"})
+    payload = b"x" * 40000            # ~1 second at 40 kB/s
+    t0 = time.monotonic()
+    p.produce("net", value=payload, partition=0)
+    assert p.flush(20.0) == 0
+    took = time.monotonic() - t0
+    assert took >= 0.8, f"rate cap not applied ({took:.3f}s)"
+    p.close()
+
+
+def test_kill_mid_produce_retries_without_duplication(cluster):
+    """Throttle the link so the ProduceRequest is mid-transfer, kill the
+    connection, and verify the idempotent producer redelivers exactly
+    once after reconnect (reference 0075-retry.c + sockem kill)."""
+    em = Sockem()
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "connect_cb": em.connect_cb,
+                  "enable.idempotence": True,
+                  "linger.ms": 5, "retry.backoff.ms": 50,
+                  "message.send.max.retries": 20,
+                  "message.timeout.ms": 30000})
+    # warm connection + PID assignment
+    p.produce("net", value=b"warm", partition=0)
+    assert p.flush(10.0) == 0
+
+    # choke the pipe so the next request crawls, then cut it mid-flight
+    em.set(rate_bps=30000)
+    n = 100
+    for i in range(n):
+        p.produce("net", value=(b"m%03d-" % i) * 100, partition=0)  # ~600B
+    time.sleep(0.6)                  # request is mid-transfer now
+    killed = em.kill_all()
+    assert killed >= 1, "nothing was in flight to kill"
+    em.set(rate_bps=0)               # restore full speed for the retry
+
+    assert p.flush(30.0) == 0
+    vals = _log_values(cluster)
+    body = [v for v in vals if v != b"warm"]
+    assert len(body) == n, f"expected {n}, log has {len(body)}"
+    assert len(set(body)) == n, "duplicated messages in the log"
+    p.close()
+
+
+def test_connection_kill_recovery_consumer(cluster):
+    """Consumer side: kill the connection between fetches; the consumer
+    reconnects and resumes from its offsets without message loss."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(30):
+        p.produce("net", value=b"c%d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    em = Sockem()
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "connect_cb": em.connect_cb,
+                  "group.id": "gsock", "auto.offset.reset": "earliest",
+                  "session.timeout.ms": 30000})
+    c.subscribe(["net"])
+    got = []
+    deadline = time.monotonic() + 30
+    killed = False
+    while len(got) < 30 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m.value)
+        if len(got) >= 10 and not killed:
+            killed = True
+            em.kill_all()
+    assert killed
+    assert sorted(got) == sorted(b"c%d" % i for i in range(30)), \
+        f"got {len(got)}"
+    c.close()

@@ -1,0 +1,87 @@
+"""Regex subscription tests (reference: rdkafka_pattern.c + rdregex.c;
+behavior of `^`-prefixed topics in rd_kafka_subscribe): pattern
+subscriptions match against the full cluster topic list, newly created
+matching topics trigger a rebalance and get consumed, and non-matching
+topics are ignored."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"bench-a": 1, "other": 1},
+                    auto_create_topics=False)
+    yield c
+    c.stop()
+
+
+def _consume_until(c, want, timeout=25):
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < want and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append((m.topic, m.value))
+    return got
+
+
+def test_regex_matches_existing_and_new_topics(cluster):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    p.produce("bench-a", value=b"a1", partition=0)
+    p.produce("other", value=b"x1", partition=0)
+    assert p.flush(10.0) == 0
+
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "rgx", "auto.offset.reset": "earliest",
+                  # fast periodic full refresh so new topics are seen
+                  "topic.metadata.refresh.interval.ms": 400})
+    c.subscribe(["^bench-.*"])
+
+    got = _consume_until(c, 1)
+    assert got == [("bench-a", b"a1")], got
+
+    # create a new matching topic AFTER subscription: the pattern must
+    # pick it up on the next metadata refresh and rebalance onto it
+    cluster.create_topic("bench-b", 1)
+    p.produce("bench-b", value=b"b1", partition=0)
+    assert p.flush(10.0) == 0
+    got = _consume_until(c, 1)
+    assert got == [("bench-b", b"b1")], got
+
+    # non-matching topic traffic is never delivered
+    p.produce("other", value=b"x2", partition=0)
+    assert p.flush(10.0) == 0
+    assert _consume_until(c, 1, timeout=2) == []
+    c.close()
+    p.close()
+
+
+def test_mixed_literal_and_regex(cluster):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    p.produce("bench-a", value=b"a", partition=0)
+    p.produce("other", value=b"o", partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "rgx2", "auto.offset.reset": "earliest",
+                  "topic.metadata.refresh.interval.ms": 400})
+    c.subscribe(["other", "^bench-.*"])
+    got = _consume_until(c, 2)
+    assert sorted(got) == [("bench-a", b"a"), ("other", b"o")]
+    c.close()
+
+
+def test_bad_regex_raises(cluster):
+    from librdkafka_tpu import KafkaException
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "rgx3"})
+    with pytest.raises(KafkaException):
+        c.subscribe(["^ben[ch-"])
+    c.close()
