@@ -63,6 +63,8 @@ class Request:
     corrid: int = 0
     version: Optional[int] = None      # api version override
     opaque: object = None
+    ts_enq: float = 0.0                # enqueue_request() time (outbuf lat.)
+    ts_sent: float = 0.0               # wire write time (rtt)
 
 
 # max in-flight ProduceRequests per partition with idempotence
@@ -107,7 +109,12 @@ class Broker:
         # stats
         self.c_tx = self.c_rx = self.c_tx_bytes = self.c_rx_bytes = 0
         self.c_req_timeouts = 0
-        self.rtt_avg = rk.stats_avg_factory() if hasattr(rk, "stats_avg_factory") else None
+        # latency decomposition (reference: rkb_avg_rtt/outbuf_latency/
+        # throttle, rdkafka_broker.h; emitted rdkafka.c:1582-1630)
+        from .stats import Avg
+        self.rtt_avg = Avg()            # request sent -> response (µs)
+        self.outbuf_avg = Avg()         # enqueue -> wire write (µs)
+        self.throttle_avg = Avg(1, 5 * 60 * 1000, 3)  # broker throttle (ms)
         self.thread = threading.Thread(target=self._thread_main,
                                        name=f"rdk:broker/{self.name}",
                                        daemon=True)
@@ -125,6 +132,7 @@ class Broker:
     # -------------------------------------------------------- public API --
     def enqueue_request(self, req: Request) -> None:
         """Thread-safe: queue a request for transmission (any thread)."""
+        req.ts_enq = time.monotonic()
         self.ops.push(Op(OpType.BROKER_WAKEUP, payload=("xmit", req)))
 
     def add_toppar(self, toppar) -> None:
@@ -211,7 +219,11 @@ class Broker:
                                                "socket.timeout.ms") / 1000.0)
             self.sock.setblocking(False)
             if self.rk.conf.get("socket.nagle.disable"):
-                self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    self.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass    # not TCP (e.g. a sockem AF_UNIX pair)
         except OSError as e:
             self.sock = None
             self._connect_failed(f"connect failed: {e}")
@@ -362,6 +374,9 @@ class Broker:
         self._wbuf += wire
         self.c_tx += 1
         self.c_tx_bytes += len(wire)
+        req.ts_sent = time.monotonic()
+        if req.ts_enq:
+            self.outbuf_avg.add((req.ts_sent - req.ts_enq) * 1e6)
         if req.expect_response:
             self.waitresp[req.corrid] = req
             if not req.abs_timeout:
@@ -463,12 +478,17 @@ class Broker:
             self.rk.dbg("broker", f"{self.name}: unknown corrid {corrid}")
             return
         self.c_rx += 1
+        if req.ts_sent:
+            self.rtt_avg.add((time.monotonic() - req.ts_sent) * 1e6)
         try:
             _, body = apis.parse_response(req.api, payload)
         except Exception as e:
             self._req_fail(req, KafkaError(Err._BAD_MSG,
                                            f"response parse: {e!r}"))
             return
+        tt = body.get("throttle_time_ms") if isinstance(body, dict) else None
+        if tt:
+            self.throttle_avg.add(tt)
         if req.cb:
             req.cb(None, body)
 
@@ -569,6 +589,17 @@ class Broker:
         if not ready:
             return
 
+        # int_latency: produce() -> MessageSet write (reference rkb_avg
+        # int_latency fed per message at rdkafka_msgset_writer.c; here the
+        # batch's oldest+newest bound the window at 2 adds/batch instead
+        # of N)
+        for tp, msgs, _w in ready:
+            self.rk.stats.int_latency.add((now - msgs[0].enq_time) * 1e6)
+            if len(msgs) > 1:
+                self.rk.stats.int_latency.add(
+                    (now - msgs[-1].enq_time) * 1e6)
+        ts_codec = time.monotonic()
+
         # ---- phase 2: ONE batched compress + ONE batched CRC call across
         # partitions (both ride the same provider/offload axis; reference
         # does each per batch on the broker thread,
@@ -608,6 +639,8 @@ class Broker:
             for tp, msgs, _w in assembled:
                 self._release_unsent(tp, msgs, e)
             return
+        self.rk.stats.codec_latency.add(
+            (time.monotonic() - ts_codec) * 1e6)
         for (tp, msgs, writer), crc in zip(assembled, crcs):
             self._send_produce(tp, msgs, writer.patch_crc(int(crc)), now)
 

@@ -108,6 +108,7 @@ class ConsumerGroup:
         self.subscription = []
         self.patterns = []
         self._matched = set()
+        self.sub_version += 1    # abandon any JoinGroup in flight
         self._leave()
 
     def poll_tick(self):
@@ -153,7 +154,11 @@ class ConsumerGroup:
 
     # ------------------------------------------------- coordinator query --
     def _coord_query(self, now: float):
-        if self._pending or now - self.last_coord_query < 0.5:
+        # fast 1s retry while the coordinator is unknown, capped by
+        # coordinator.query.interval.ms (reference coord_query_intvl)
+        ivl = min(1.0,
+                  self.rk.conf.get("coordinator.query.interval.ms") / 1e3)
+        if self._pending or now - self.last_coord_query < ivl:
             return
         b = self.rk.any_up_broker()
         if b is None:
@@ -218,7 +223,11 @@ class ConsumerGroup:
         if self.sub_version != self._join_version:
             # subscription changed while the JoinGroup was in flight
             # (e.g. a regex matched new topics): abandon and rejoin with
-            # the fresh effective subscription
+            # the fresh effective subscription. Keep the broker-assigned
+            # member_id — rejoining with it replaces our slot instead of
+            # leaving a ghost member that stalls the group's rebalance
+            if err is None and resp.get("member_id"):
+                self.member_id = resp["member_id"]
             self.join_state = "init"
             return
         if err is not None:

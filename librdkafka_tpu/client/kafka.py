@@ -192,6 +192,14 @@ class Kafka:
             raise KafkaException(Err._INVALID_ARG,
                                  "bootstrap.servers not configured")
 
+        # plugins (plugin.library.paths; reference rdkafka_plugin.c —
+        # each entry's conf_init() registers interceptors)
+        plugin_paths = conf.get("plugin.library.paths")
+        if plugin_paths:
+            from .interceptor import load_plugins
+            self.interceptors = load_plugins(plugin_paths, conf)
+            conf.set("interceptors", self.interceptors)
+
         # interceptors on_new
         if self.interceptors:
             self.interceptors.on_new(self)

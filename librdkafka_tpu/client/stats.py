@@ -11,39 +11,46 @@ import threading
 import time
 from typing import TYPE_CHECKING
 
-import numpy as np
+from ..utils.hdrhistogram import HdrHistogram
 
 if TYPE_CHECKING:
     from .kafka import Kafka
 
 
 class Avg:
-    """Windowed sample set with rollover + percentiles (rd_avg_t analog)."""
+    """Windowed HdrHistogram with rollover (reference: rd_avg_t,
+    rdavg.h:37-165 — values accumulate into the current window; the
+    stats emitter rolls the window over and renders min/avg/max +
+    p50..p99.99, rdkafka.c:1582-1630). O(1) record, constant memory."""
 
-    __slots__ = ("_samples", "_lock")
+    __slots__ = ("_hist", "_lock")
 
-    def __init__(self):
-        self._samples: list[float] = []
+    #: STATISTICS.md percentile fields
+    PCTS = ((50, "p50"), (75, "p75"), (90, "p90"), (95, "p95"),
+            (99, "p99"), (99.99, "p99_99"))
+
+    def __init__(self, lowest: int = 1, highest: int = 60_000_000,
+                 sigfigs: int = 3):
+        self._hist = HdrHistogram(lowest, highest, sigfigs)
         self._lock = threading.Lock()
 
     def add(self, v: float):
         with self._lock:
-            if len(self._samples) < 100000:
-                self._samples.append(v)
+            self._hist.record(int(v))
 
     def rollover(self) -> dict:
         with self._lock:
-            s, self._samples = self._samples, []
-        if not s:
-            return {"min": 0, "max": 0, "avg": 0, "sum": 0, "cnt": 0,
-                    "p50": 0, "p75": 0, "p90": 0, "p95": 0, "p99": 0,
-                    "p99_99": 0}
-        a = np.asarray(s)
-        q = np.percentile(a, [50, 75, 90, 95, 99, 99.99])
-        return {"min": int(a.min()), "max": int(a.max()),
-                "avg": int(a.mean()), "sum": int(a.sum()), "cnt": len(s),
-                "p50": int(q[0]), "p75": int(q[1]), "p90": int(q[2]),
-                "p95": int(q[3]), "p99": int(q[4]), "p99_99": int(q[5])}
+            h = self._hist
+            vals, stddev = h.snapshot([p for p, _ in self.PCTS])
+            out = {"min": h.min_v, "max": h.max_v,
+                   "avg": int(h.mean()), "sum": h.sum_v, "cnt": h.total,
+                   "stddev": int(stddev),
+                   "hdrsize": h.memsize,
+                   "outofrange": h.out_of_range}
+            for (pct, name), v in zip(self.PCTS, vals):
+                out[name] = v
+            h.reset()
+        return out
 
 
 class StatsCollector:
@@ -66,6 +73,10 @@ class StatsCollector:
                 "tx": b.c_tx, "txbytes": b.c_tx_bytes,
                 "rx": b.c_rx, "rxbytes": b.c_rx_bytes,
                 "req_timeouts": b.c_req_timeouts,
+                # latency decomposition (STATISTICS.md broker window stats)
+                "rtt": b.rtt_avg.rollover(),
+                "outbuf_latency": b.outbuf_avg.rollover(),
+                "throttle": b.throttle_avg.rollover(),
                 "toppars": {f"{tp.topic}-{tp.partition}":
                             {"topic": tp.topic, "partition": tp.partition}
                             for tp in list(b.toppars)},

@@ -65,9 +65,17 @@ class _Pump(threading.Thread):
                     time.sleep(len(data) / em.rate)
                 if self.conn.dead:
                     break
-                try:
-                    self.dst.sendall(data)
-                except OSError:
+                # retry on send timeout: a momentarily-full socketpair
+                # buffer must stall the pump, not kill the connection
+                while data and not self.conn.dead:
+                    try:
+                        n = self.dst.send(data)
+                        data = data[n:]
+                    except socket.timeout:
+                        continue
+                    except OSError:
+                        return
+                if data:
                     break
         finally:
             self.conn.close()

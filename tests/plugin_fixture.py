@@ -1,0 +1,21 @@
+"""Plugin module for test_0066_plugins (the analog of the reference's
+tests/plugin_test shared object loaded via plugin.library.paths): the
+conf_init() contract receives (conf, chain) and registers interceptors."""
+
+CALLS = {"conf_init": 0, "on_send": 0, "on_acknowledgement": 0,
+         "on_new": 0}
+
+
+def conf_init(conf, chain):
+    CALLS["conf_init"] += 1
+    chain.add("plugin_fixture", "on_new",
+              lambda rk: CALLS.__setitem__("on_new", CALLS["on_new"] + 1))
+    chain.add("plugin_fixture", "on_send",
+              lambda msg: CALLS.__setitem__("on_send", CALLS["on_send"] + 1))
+    chain.add("plugin_fixture", "on_acknowledgement",
+              lambda msg: CALLS.__setitem__(
+                  "on_acknowledgement", CALLS["on_acknowledgement"] + 1))
+
+
+def custom_entry(conf, chain):
+    CALLS["conf_init"] += 100

@@ -1,0 +1,105 @@
+"""Statistics tests (reference: 0053-stats_cb.cpp / 0062-stats_event.c +
+rdhdrhistogram.c unittest at :709): HdrHistogram percentile accuracy
+against an oracle, rd_avg_t windowed rollover semantics, and the e2e
+stats blob carrying the STATISTICS.md latency decomposition
+(int_latency, per-broker rtt/outbuf_latency/throttle percentiles)."""
+import json
+import time
+
+import numpy as np
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.client.stats import Avg
+from librdkafka_tpu.utils.hdrhistogram import HdrHistogram
+
+
+class TestHdrHistogram:
+    def test_percentiles_vs_numpy(self):
+        rng = np.random.default_rng(7)
+        for data in (rng.integers(1, 1000, 20000),
+                     (rng.lognormal(8, 1.5, 20000)).astype(int) + 1):
+            h = HdrHistogram(1, 60_000_000, 3)
+            for v in data:
+                h.record(int(v))
+            for p in (50, 75, 90, 95, 99, 99.99):
+                got = h.value_at_percentile(p)
+                want = float(np.percentile(data, p, method="inverted_cdf"))
+                assert abs(got - want) / max(want, 1) < 0.002, (p, got, want)
+            assert h.min_v == data.min() and h.max_v == data.max()
+            assert abs(h.mean() - data.mean()) / data.mean() < 0.001
+            assert abs(h.stddev() - data.std()) / data.std() < 0.01
+
+    def test_constant_and_edge_values(self):
+        h = HdrHistogram(1, 1000, 2)
+        for _ in range(100):
+            h.record(777)
+        assert h.value_at_percentile(50) == h.value_at_percentile(99.99)
+        assert abs(h.value_at_percentile(50) - 777) <= 777 * 0.01
+        assert h.record(0) is True          # zero is trackable
+        assert h.record(5000) is False      # above range
+        assert h.record(-1) is False
+        assert h.out_of_range == 2
+        assert h.min_v == 0
+
+    def test_memory_is_constant(self):
+        h = HdrHistogram(1, 60_000_000, 3)
+        size0 = h.memsize
+        for v in range(1, 200000, 7):
+            h.record(v)
+        assert h.memsize == size0
+        assert h.total == len(range(1, 200000, 7))
+
+    def test_reset(self):
+        h = HdrHistogram()
+        h.record(42)
+        h.reset()
+        assert h.total == 0 and h.value_at_percentile(99) == 0
+
+
+class TestAvg:
+    def test_rollover_window_semantics(self):
+        a = Avg()
+        for v in (100, 200, 300, 400):
+            a.add(v)
+        w = a.rollover()
+        assert w["cnt"] == 4 and w["min"] == 100 and w["max"] == 400
+        assert 245 <= w["avg"] <= 255
+        assert w["p50"] >= 200 and w["p99"] >= 390 * 0.99
+        assert "stddev" in w and "outofrange" in w and "hdrsize" in w
+        # windows don't leak into each other
+        w2 = a.rollover()
+        assert w2["cnt"] == 0 and w2["p99"] == 0
+
+
+def test_stats_blob_latency_decomposition():
+    """e2e: the stats JSON must carry int_latency + per-broker
+    rtt/outbuf_latency/throttle with the percentile fields."""
+    blobs = []
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 2,
+                  "linger.ms": 2, "statistics.interval.ms": 200,
+                  "stats_cb": lambda js: blobs.append(json.loads(js))})
+    for i in range(300):
+        p.produce("st", value=b"v%d" % i, partition=i % 4)
+        if i % 50 == 0:
+            p.poll(0)
+            time.sleep(0.02)
+    assert p.flush(15.0) == 0
+    deadline = time.monotonic() + 5
+    while not blobs and time.monotonic() < deadline:
+        p.poll(0.1)
+    p.close()
+    assert blobs, "no stats emitted"
+    # find a blob with traffic recorded
+    best = max(blobs, key=lambda b: b["int_latency"]["cnt"])
+    il = best["int_latency"]
+    assert il["cnt"] > 0
+    for f in ("p50", "p75", "p90", "p95", "p99", "p99_99", "stddev",
+              "outofrange", "hdrsize"):
+        assert f in il
+    assert il["min"] <= il["p50"] <= il["p99"] <= il["max"]
+    with_rtt = [b for b in blobs
+                for br in b["brokers"].values() if br["rtt"]["cnt"] > 0]
+    assert with_rtt, "no broker rtt samples recorded"
+    br = next(br for br in best["brokers"].values())
+    assert "outbuf_latency" in br and "throttle" in br

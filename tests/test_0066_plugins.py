@@ -1,0 +1,32 @@
+"""Plugin loading tests (reference: 0066-plugins.cpp + rdkafka_plugin.c:
+plugin.library.paths entries are loaded at client creation, their
+conf_init() registers interceptors, and the hooks fire on the produce
+path)."""
+import plugin_fixture
+
+from librdkafka_tpu import Producer
+
+
+def test_plugin_library_paths_loads_and_hooks_fire():
+    before = dict(plugin_fixture.CALLS)
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "plugin.library.paths": "plugin_fixture",
+                  "linger.ms": 2})
+    assert plugin_fixture.CALLS["conf_init"] == before["conf_init"] + 1
+    assert plugin_fixture.CALLS["on_new"] == before["on_new"] + 1
+    n = 10
+    for i in range(n):
+        p.produce("plug", value=b"x%d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+    assert plugin_fixture.CALLS["on_send"] >= before["on_send"] + n
+    assert (plugin_fixture.CALLS["on_acknowledgement"]
+            >= before["on_acknowledgement"] + n)
+
+
+def test_plugin_custom_entry_point():
+    before = plugin_fixture.CALLS["conf_init"]
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "plugin.library.paths": "plugin_fixture:custom_entry"})
+    p.close()
+    assert plugin_fixture.CALLS["conf_init"] == before + 100
