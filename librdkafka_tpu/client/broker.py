@@ -880,6 +880,19 @@ class Broker:
             return
         rk = self.rk
         from .partition import FetchState
+        from ..protocol.msgset import iter_batches
+
+        # phase A: collect OK partitions; split v2 blobs into batches so
+        # CRC verify and decompress each run as ONE batched provider
+        # call across the whole Fetch response — the consumer-side
+        # mirror of the producer's batched codec seam (reference does
+        # both per batch on the broker thread,
+        # rdkafka_msgset_reader.c:950-1016 CRC, :258-530 decompress)
+        # every phase works from the (fetch_offset, version) snapshot
+        # taken here, so a concurrent seek() cannot desync the
+        # decompress decision (phase C) from the parse decision (D) —
+        # the op version stamp makes post-seek deliveries discardable
+        ok: list[tuple] = []      # (tp, pres, batches|None, fo, ver)
         for t in resp["topics"]:
             for p in t["partitions"]:
                 tp = rk.get_toppar(t["topic"], p["partition"], create=False)
@@ -891,7 +904,15 @@ class Broker:
                 if ec == Err.NO_ERROR:
                     tp.hi_offset = p["high_watermark"]
                     tp.ls_offset = p["last_stable_offset"]
-                    rk.fetch_reply_handle(tp, p, self)
+                    blob = p["records"] or b""
+                    batches = None
+                    if (len(blob) > proto.V2_OF_Magic
+                            and blob[proto.V2_OF_Magic] == 2):
+                        batches = [
+                            [info, payload,
+                             info.base_offset + info.last_offset_delta, full]
+                            for info, payload, full in iter_batches(blob)]
+                    ok.append((tp, p, batches, tp.fetch_offset, tp.version))
                 elif ec == Err.OFFSET_OUT_OF_RANGE:
                     rk.offset_reset(tp, f"fetch offset {tp.fetch_offset} out of range")
                 elif ec in (Err.NOT_LEADER_FOR_PARTITION,
@@ -904,3 +925,72 @@ class Broker:
                 else:
                     tp.fetch_backoff_until = time.monotonic() + \
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
+        if not ok:
+            return
+
+        # phase B: ONE batched CRC verify across every relevant batch
+        bad: set[int] = set()     # id(tp) of partitions failing CRC
+        if rk.conf.get("check.crcs"):
+            regions, owners = [], []
+            for tp, pres, batches, fo, ver in ok:
+                if not batches:
+                    continue
+                for b in batches:
+                    info, _payload, last, full = b
+                    if last < fo:
+                        continue
+                    regions.append(full[proto.V2_OF_Attributes:])
+                    owners.append((tp, info))
+            if regions:
+                crcs = rk.codec_provider.crc32c_many(regions)
+                for (tp, info), crc in zip(owners, crcs):
+                    if id(tp) in bad:
+                        continue     # one error per partition, not per batch
+                    if int(crc) != info.crc:
+                        bad.add(id(tp))
+                        rk.op_err(KafkaError(
+                            Err._BAD_MSG,
+                            f"{tp}: CRC mismatch at offset "
+                            f"{info.base_offset}"))
+                        tp.fetch_backoff_until = time.monotonic() + 0.5
+
+        # phase C: ONE batched decompress per codec across the response.
+        # A failing batch gets payload=None instead of failing its whole
+        # partition here: phase D skips aborted/control batches without
+        # reading them, so a corrupt batch inside an aborted transaction
+        # must not suppress the partition's valid committed data
+        by_codec: dict[str, list] = {}
+        for tp, pres, batches, fo, ver in ok:
+            if not batches or id(tp) in bad:
+                continue
+            for b in batches:
+                info, _payload, last, _full = b
+                if last >= fo and info.codec:
+                    by_codec.setdefault(info.codec, []).append(b)
+        for codec, items in by_codec.items():
+            blobs = None
+            try:
+                blobs = rk.codec_provider.decompress_many(
+                    codec, [b[1] for b in items])
+            except Exception:
+                pass   # isolate the failing batch below
+            for i, b in enumerate(items):
+                if blobs is not None:
+                    b[1] = blobs[i]
+                    continue
+                try:
+                    b[1] = rk.codec_provider.decompress_many(
+                        codec, [b[1]])[0]
+                except Exception:
+                    b[1] = None      # phase D errors it only if needed
+
+        # phase D: per-partition record parsing on pre-processed batches
+        for tp, pres, batches, fo, ver in ok:
+            if id(tp) in bad:
+                continue
+            rk.fetch_reply_handle(
+                tp, pres, self,
+                batches=None if batches is None else
+                [(info, payload, last)
+                 for info, payload, last, _full in batches],
+                fo=fo, ver=ver)

@@ -375,8 +375,58 @@ class ConsumerGroup:
         if offsets:
             self.commit_offsets(offsets, None)
 
+    @staticmethod
+    def _synth_offset_resp(items: dict, with_offsets: bool) -> dict:
+        """Build an OffsetCommit/OffsetFetch-shaped response for locally
+        (file-)stored offsets so every caller sees one response shape."""
+        by_topic: dict[str, list] = {}
+        for (t, p), off in items.items():
+            row = {"partition": p, "error_code": 0, "metadata": None}
+            if with_offsets:
+                row["offset"] = off if off is not None else -1
+            by_topic.setdefault(t, []).append(row)
+        return {"topics": [{"topic": t, "partitions": ps}
+                           for t, ps in by_topic.items()]}
+
     def commit_offsets(self, offsets: dict[tuple[str, int], int],
                        cb) -> bool:
+        # legacy file store split (offset.store.method=file,
+        # rdkafka_offset.c:98-330): file-backed topics commit locally
+        rk = self.rk
+        all_offsets = dict(offsets)      # full set for offset_commit_cb
+        store = rk.offset_store
+        if store is not None:
+            file_items = {k: v for k, v in offsets.items()
+                          if store.uses_file(k[0])}
+            if file_items:
+                store.commit_all(file_items)
+                for (t, p), off in file_items.items():
+                    tp = rk.get_toppar(t, p, create=False)
+                    if tp is not None:
+                        tp.committed_offset = off
+                if rk.interceptors:
+                    rk.interceptors.on_commit(file_items)
+                offsets = {k: v for k, v in offsets.items()
+                           if k not in file_items}
+                if not offsets:
+                    if cb:
+                        cb(None, self._synth_offset_resp(file_items, False))
+                    occb = rk.conf.get("offset_commit_cb")
+                    if occb:
+                        occb(None, file_items)
+                    return True
+                # mixed commit: report file-backed partitions alongside
+                # the broker result in both cb's response and occb
+                orig_cb = cb
+
+                def cb(err, resp, _orig=orig_cb, _file=file_items):
+                    if err is None and resp is not None:
+                        resp = dict(resp)
+                        resp["topics"] = (
+                            list(resp["topics"])
+                            + self._synth_offset_resp(_file, False)["topics"])
+                    if _orig:
+                        _orig(err, resp)
         b = self._coord_broker()
         if b is None:
             if cb:
@@ -404,7 +454,7 @@ class ConsumerGroup:
                 cb(err, resp)
             occb = self.rk.conf.get("offset_commit_cb")
             if occb:
-                occb(err, offsets)
+                occb(err, all_offsets)
 
         b.enqueue_request(Request(
             ApiKey.OffsetCommit,
@@ -416,18 +466,51 @@ class ConsumerGroup:
         return True
 
     def fetch_committed(self, tps: list[tuple[str, int]], cb) -> bool:
+        rk = self.rk
+        store = rk.offset_store
+        file_reads: dict[tuple[str, int], Optional[int]] = {}
+        if store is not None:
+            file_tps = [k for k in tps if store.uses_file(k[0])]
+            if file_tps:
+                file_reads = {(t, p): store.read(t, p) for t, p in file_tps}
+                tps = [k for k in tps if k not in file_reads]
+                if not tps:
+                    if cb:
+                        cb(None, self._synth_offset_resp(file_reads, True))
+                    return True
         b = self._coord_broker()
         if b is None:
+            if file_reads and cb:
+                # deliver the file offsets we DID read; the broker-backed
+                # partitions fall back to the caller's no-result path
+                cb(None, self._synth_offset_resp(file_reads, True))
+                return True
             return False
         by_topic: dict[str, list] = {}
         for t, p in tps:
             by_topic.setdefault(t, []).append(p)
+
+        def on_fetch(err, resp):
+            if file_reads:
+                # merge locally-read file offsets into the result; on
+                # broker error still deliver the file offsets rather
+                # than discarding successfully-read local state
+                if err is None:
+                    resp = dict(resp)
+                    resp["topics"] = (list(resp["topics"])
+                                      + self._synth_offset_resp(
+                                          file_reads, True)["topics"])
+                else:
+                    err, resp = None, self._synth_offset_resp(
+                        file_reads, True)
+            cb(err, resp)
+
         b.enqueue_request(Request(
             ApiKey.OffsetFetch,
             {"group_id": self.group_id,
              "topics": [{"topic": t, "partitions": ps}
                         for t, ps in by_topic.items()]},
-            cb=cb, retries_left=2))
+            cb=on_fetch if cb else None, retries_left=2))
         return True
 
     # --------------------------------------------------------------- leave --

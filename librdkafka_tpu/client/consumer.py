@@ -45,6 +45,10 @@ class Consumer:
         self._rk = Kafka(conf, CONSUMER)
         self._rk.consumer = self
         self.queue = OpQueue("consumer")
+        # single-queue consumer polling: the main reply queue (errors,
+        # stats, logs) forwards into the consumer queue (reference:
+        # rd_kafka_poll_set_consumer, rk_rep → rk_consumer fwd)
+        self._rk.rep.forward_to(self.queue)
         group_id = conf.get("group.id")
         self._rk.cgrp = ConsumerGroup(self._rk, group_id) if group_id else None
         self._assignment: dict[tuple[str, int], Toppar] = {}
@@ -219,6 +223,9 @@ class Consumer:
             if cb:
                 cb(self, code, parts)
             return None
+        # forwarded main-queue ops (errors/stats/logs): dispatch to the
+        # same handlers rd_kafka_poll would use
+        rk._serve_rep_op(op)
         return None
 
     # ------------------------------------------------------------ offsets --

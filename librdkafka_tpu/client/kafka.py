@@ -179,6 +179,12 @@ class Kafka:
         from .stats import StatsCollector
         self.stats = StatsCollector(self)
 
+        # legacy file offset store (offset.store.method=file)
+        self.offset_store = None
+        if self.is_consumer:
+            from .offset_store import FileOffsetStore
+            self.offset_store = FileOffsetStore(self)
+
         # implicit mock cluster (test.mock.num.brokers)
         nmock = conf.get("test.mock.num.brokers")
         bootstrap = conf.get("bootstrap.servers")
@@ -664,20 +670,42 @@ class Kafka:
         self.rep.push(Op(OpType.STATS, payload=blob))
 
     # ------------------------------------------------- consumer fetch path --
-    def fetch_reply_handle(self, tp: Toppar, pres: dict, broker: Broker):
+    def fetch_reply_handle(self, tp: Toppar, pres: dict, broker: Broker,
+                           batches: Optional[list] = None,
+                           fo: Optional[int] = None,
+                           ver: Optional[int] = None):
         """Parse a fetch response partition into messages
         (reference: rd_kafka_fetch_reply_handle → rd_kafka_msgset_parse,
-        rdkafka_msgset_reader.c:1410; aborted-txn filtering :1442-1560)."""
+        rdkafka_msgset_reader.c:1410; aborted-txn filtering :1442-1560).
+
+        ``batches``: pre-processed v2 batches from the broker's batched
+        phase — [(info, records_bytes_DECOMPRESSED, last_offset)] with
+        CRCs already verified in ONE provider call across the whole
+        Fetch response (the consumer-side mirror of the producer's
+        batched codec seam). None falls back to inline per-batch work
+        (legacy v0/v1 messagesets, tests). A batch payload of None marks
+        a decompress failure — errored only if the batch would actually
+        be delivered (aborted/control batches are skipped unread).
+
+        ``fo``/``ver``: the (fetch_offset, version) snapshot the caller
+        took when it decided this response is current; all skip/parse
+        decisions use the snapshot so a concurrent seek() can't desync
+        them, and deliveries are stamped with ``ver`` so post-seek ops
+        get discarded by the consumer's staleness filter."""
+        if fo is None:
+            fo = tp.fetch_offset
+        if ver is None:
+            ver = tp.version
         blob = pres["records"] or b""
         if not blob:
             if (self.conf.get("enable.partition.eof")
-                    and tp.fetch_offset >= tp.hi_offset
-                    and tp.eof_reported_at != tp.fetch_offset):
-                tp.eof_reported_at = tp.fetch_offset
+                    and fo >= tp.hi_offset
+                    and tp.eof_reported_at != fo):
+                tp.eof_reported_at = fo
                 m = Message(tp.topic, partition=tp.partition)
-                m.offset = tp.fetch_offset
+                m.offset = fo
                 m.error = KafkaError(Err._PARTITION_EOF, "partition EOF")
-                tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, tp.version)))
+                tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, ver)))
             return
         check_crcs = self.conf.get("check.crcs")
         read_committed = (self.conf.get("isolation.level") == "read_committed")
@@ -687,20 +715,39 @@ class Kafka:
                    for a in (pres["aborted_transactions"] or [])}
         active_aborts: set[int] = set()
         msgs: list[Message] = []
-        next_offset = tp.fetch_offset
+        next_offset = fo
         is_v2 = (len(blob) > proto.V2_OF_Magic and blob[proto.V2_OF_Magic] == 2)
         if is_v2:
-            for info, payload, full in iter_batches(blob):
-                last = info.base_offset + info.last_offset_delta
-                if last < tp.fetch_offset:
+            if batches is None:
+                # inline fallback path: per-batch CRC + decompress
+                batches = []
+                for info, payload, full in iter_batches(blob):
+                    last = info.base_offset + info.last_offset_delta
+                    if last >= fo:
+                        if check_crcs and not verify_crc_v2(info, full):
+                            self.op_err(KafkaError(
+                                Err._BAD_MSG,
+                                f"{tp}: CRC mismatch at offset "
+                                f"{info.base_offset}"))
+                            tp.fetch_backoff_until = time.monotonic() + 0.5
+                            return
+                        if info.codec:
+                            try:
+                                payload = self.codec_provider.decompress_many(
+                                    info.codec, [payload])[0]
+                            except Exception as e:
+                                self.op_err(KafkaError(
+                                    Err._BAD_COMPRESSION,
+                                    f"{tp}: decompress ({info.codec}): "
+                                    f"{e!r}"))
+                                tp.fetch_backoff_until = \
+                                    time.monotonic() + 0.5
+                                return
+                    batches.append((info, payload, last))
+            for info, payload, last in batches:
+                if last < fo:
                     next_offset = max(next_offset, last + 1)
                     continue
-                if check_crcs and not verify_crc_v2(info, full):
-                    self.op_err(KafkaError(Err._BAD_MSG,
-                                           f"{tp}: CRC mismatch at offset "
-                                           f"{info.base_offset}"))
-                    tp.fetch_backoff_until = time.monotonic() + 0.5
-                    return
                 # aborted-txn bookkeeping
                 pid = info.producer_id
                 if read_committed and pid in aborted:
@@ -710,7 +757,8 @@ class Kafka:
                 if info.is_control:
                     # control record: key = [version i16, type i16]
                     try:
-                        recs = parse_records_v2(info, payload)
+                        recs = (parse_records_v2(info, payload)
+                                if payload is not None else [])
                         if recs and recs[0].key and len(recs[0].key) >= 4:
                             ctype = int.from_bytes(recs[0].key[2:4], "big")
                             if ctype == proto.CTRL_ABORT:
@@ -723,18 +771,15 @@ class Kafka:
                         and pid in active_aborts):
                     next_offset = last + 1
                     continue
-                if info.codec:
-                    try:
-                        payload = self.codec_provider.decompress_many(
-                            info.codec, [payload])[0]
-                    except Exception as e:
-                        self.op_err(KafkaError(
-                            Err._BAD_COMPRESSION,
-                            f"{tp}: decompress ({info.codec}): {e!r}"))
-                        tp.fetch_backoff_until = time.monotonic() + 0.5
-                        return
+                if payload is None:      # decompress failed (phase C)
+                    self.op_err(KafkaError(
+                        Err._BAD_COMPRESSION,
+                        f"{tp}: decompress ({info.codec}) failed at "
+                        f"offset {info.base_offset}"))
+                    tp.fetch_backoff_until = time.monotonic() + 0.5
+                    return
                 for r in parse_records_v2(info, payload):
-                    if r.offset < tp.fetch_offset:
+                    if r.offset < fo:
                         continue
                     m = Message(tp.topic, value=r.value, key=r.key,
                                 partition=tp.partition,
@@ -746,7 +791,7 @@ class Kafka:
         else:
             dec = lambda codec, b: self.codec_provider.decompress_many(codec, [b])[0]
             for r in parse_msgset_v01(blob, dec):
-                if r.offset < tp.fetch_offset:
+                if r.offset < fo:
                     continue
                 m = Message(tp.topic, value=r.value, key=r.key,
                             partition=tp.partition, timestamp=r.timestamp)
@@ -754,12 +799,14 @@ class Kafka:
                 msgs.append(m)
                 next_offset = max(next_offset, r.offset + 1)
 
+        if tp.version != ver:
+            return      # seek/rebalance raced this response: drop it
         tp.fetch_offset = next_offset
         tp.eof_reported_at = proto.OFFSET_INVALID
         for m in msgs:
             if self.interceptors:
                 self.interceptors.on_consume(m)
-            tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, tp.version)))
+            tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, ver)))
         tp.fetchq_cnt += len(msgs)
         if self.stats:
             self.stats.c_rx_msgs += len(msgs)
@@ -797,6 +844,8 @@ class Kafka:
             self.interceptors.on_destroy(self)
         if self.mock_cluster:
             self.mock_cluster.stop()
+        if self.offset_store is not None:
+            self.offset_store.close()
 
     # ----------------------------------------------------------- security --
     def ssl_ctx(self):

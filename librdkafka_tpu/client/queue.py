@@ -93,9 +93,17 @@ class OpQueue:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while not self._items:
-                if self._fwd is not None:
-                    return None
                 remain = None if deadline is None else deadline - time.monotonic()
+                if self._fwd is not None:
+                    # forwarded queue: new pushes go to the target, so
+                    # nothing will ever arrive here — but honor the
+                    # caller's timeout instead of busy-returning (the
+                    # reference's rd_kafka_q_pop on a fwd queue waits).
+                    # A None timeout returns immediately rather than
+                    # blocking forever on a dead queue.
+                    if remain is not None and remain > 0:
+                        self._cond.wait(timeout=remain)
+                    return None
                 if remain is not None and remain <= 0:
                     return None
                 if not self._cond.wait(timeout=remain):

@@ -1,0 +1,100 @@
+"""Consumer-side batched verify/decompress tests (reference:
+rdkafka_msgset_reader.c:950-1016 CRC verify + :258-530 decompress; the
+rebuild runs both as ONE provider call per Fetch response): corrupted
+wire bytes are rejected by the batched CRC check, compressed multi-
+partition fetches decode through the batched decompress, and clean
+traffic round-trips."""
+import struct
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.errors import Err
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.protocol import proto
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"fv": 3})
+    yield c
+    c.stop()
+
+
+def _produce(cluster, n, codec="lz4", parts=3):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5, "compression.codec": codec})
+    for i in range(n):
+        p.produce("fv", value=b"fetch-%04d-" % i * 20, key=b"k%d" % i,
+                  partition=i % parts)
+    assert p.flush(10.0) == 0
+    p.close()
+
+
+def test_batched_decompress_multi_partition_round_trip(cluster):
+    _produce(cluster, 120, codec="lz4")
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gfv", "auto.offset.reset": "earliest",
+                  "check.crcs": True})
+    c.subscribe(["fv"])
+    got = []
+    deadline = time.monotonic() + 25
+    while len(got) < 120 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m.value)
+    c.close()
+    assert sorted(got) == sorted(b"fetch-%04d-" % i * 20 for i in range(120))
+
+
+def test_corrupted_batch_rejected_by_batched_crc(cluster):
+    _produce(cluster, 10, codec="none", parts=1)
+    # flip a bit inside the records region of the stored wire blob
+    part = cluster.partition("fv", 0)
+    base, blob = part.log[0]
+    corrupt = bytearray(blob)
+    corrupt[proto.V2_HEADER_SIZE + 2] ^= 0xFF
+    part.log[0] = (base, bytes(corrupt))
+
+    errs = []
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gcrc", "auto.offset.reset": "earliest",
+                  "check.crcs": True,
+                  "error_cb": lambda e: errs.append(e)})
+    c.subscribe(["fv"])
+    deadline = time.monotonic() + 10
+    got = []
+    while time.monotonic() < deadline and not errs:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    c.close()
+    assert any(e.code == Err._BAD_MSG for e in errs), errs
+    assert not got, "corrupted batch must not be delivered"
+
+
+def test_check_crcs_disabled_skips_verify(cluster):
+    """check.crcs=false: corrupted CRC field itself is ignored (payload
+    intact), messages still delivered — proving the verify is gated by
+    conf like the reference."""
+    _produce(cluster, 5, codec="none", parts=1)
+    part = cluster.partition("fv", 0)
+    base, blob = part.log[0]
+    corrupt = bytearray(blob)
+    # corrupt the stored CRC field (not the payload)
+    struct.pack_into(">I", corrupt, proto.V2_OF_CRC, 0xDEADBEEF)
+    part.log[0] = (base, bytes(corrupt))
+
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gnocrc", "auto.offset.reset": "earliest",
+                  "check.crcs": False})
+    c.subscribe(["fv"])
+    got = []
+    deadline = time.monotonic() + 15
+    while len(got) < 5 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    c.close()
+    assert len(got) == 5

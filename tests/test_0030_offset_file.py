@@ -1,0 +1,116 @@
+"""Legacy file offset store tests (reference: rdkafka_offset.c:98-330,
+offset.store.method=file): commits land in per-toppar text files,
+committed() reads them back, and a restarted consumer resumes from the
+file offset without touching the broker's offset storage."""
+import os
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.consumer import TopicPartition
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.protocol.proto import ApiKey
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"filo": 1})
+    yield c
+    c.stop()
+
+
+def _consumer(cluster, tmpdir, group="gfile", **extra):
+    conf = {"bootstrap.servers": cluster.bootstrap_servers(),
+            "group.id": group, "auto.offset.reset": "earliest",
+            "enable.auto.commit": False,
+            "offset.store.method": "file",
+            "offset.store.path": str(tmpdir),
+            "offset.store.sync.interval.ms": 0}
+    conf.update(extra)
+    return Consumer(conf)
+
+
+def test_commit_writes_file_and_committed_reads_it(cluster, tmp_path):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(20):
+        p.produce("filo", value=b"m%02d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c = _consumer(cluster, tmp_path)
+    c.subscribe(["filo"])
+    got = []
+    deadline = time.monotonic() + 20
+    while len(got) < 10 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    assert len(got) == 10
+    c.commit(message=got[-1])
+
+    path = tmp_path / "filo-0.offset"
+    assert path.exists(), list(tmp_path.iterdir())
+    assert int(path.read_text().strip()) == got[-1].offset + 1
+
+    committed = c.committed([TopicPartition("filo", 0)])
+    assert committed[0].offset == got[-1].offset + 1
+    c.close()
+
+    # the broker must have seen no OffsetCommit at all
+    commits = [a for _, a in cluster.request_log
+               if a == int(ApiKey.OffsetCommit)]
+    assert not commits, "file-store commit leaked to the broker"
+
+
+def test_restart_resumes_from_file_offset(cluster, tmp_path):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(15):
+        p.produce("filo", value=b"r%02d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c1 = _consumer(cluster, tmp_path)
+    c1.subscribe(["filo"])
+    got = []
+    deadline = time.monotonic() + 20
+    while len(got) < 7 and time.monotonic() < deadline:
+        m = c1.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    c1.commit(message=got[-1])
+    c1.close()
+
+    # second consumer instance: resumes at the file offset, not earliest
+    c2 = _consumer(cluster, tmp_path)
+    c2.subscribe(["filo"])
+    got2 = []
+    deadline = time.monotonic() + 20
+    while len(got2) < 8 and time.monotonic() < deadline:
+        m = c2.poll(0.3)
+        if m is not None and m.error is None:
+            got2.append(m)
+    c2.close()
+    assert [m.value for m in got2] == [b"r%02d" % i for i in range(7, 15)]
+
+
+def test_file_corruption_falls_back_to_reset_policy(cluster, tmp_path):
+    (tmp_path / "filo-0.offset").write_text("not-a-number\n")
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    p.produce("filo", value=b"only", partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c = _consumer(cluster, tmp_path)
+    c.subscribe(["filo"])
+    got = []
+    deadline = time.monotonic() + 15
+    while not got and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m.value)
+    c.close()
+    assert got == [b"only"]    # auto.offset.reset=earliest kicked in
