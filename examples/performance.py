@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Performance harness (reference: examples/rdkafka_performance.c, the
+benchmark tool of record — produce mode prints msgs/s and MB/s like
+:555-644; latency decomposition comes from the stats blob).
+
+    python examples/performance.py -P               # produce to mock
+    python examples/performance.py -P -z lz4 -s 1024 -c 200000
+    python examples/performance.py -C               # consume back
+    python examples/performance.py -P -b host:9092 -t topic
+"""
+import argparse
+import json
+import time
+
+from librdkafka_tpu import Consumer, Producer
+
+
+def produce_mode(args):
+    conf = {"bootstrap.servers": args.bootstrap,
+            "linger.ms": args.linger,
+            "batch.num.messages": args.batch,
+            "compression.codec": args.codec,
+            "compression.backend": args.backend,
+            "statistics.interval.ms": 3000,
+            "stats_cb": lambda js: stats.append(json.loads(js))}
+    if not args.bootstrap:
+        conf["test.mock.num.brokers"] = args.mock_brokers
+        conf["test.mock.default.partitions"] = args.partitions
+    stats = []
+    delivered = [0]
+    errors = [0]
+
+    def on_dr(err, msg):
+        if err is None:
+            delivered[0] += 1
+        else:
+            errors[0] += 1
+
+    conf["dr_msg_cb"] = on_dr
+    p = Producer(conf)
+    payload = bytes(bytearray(i & 0xFF for i in range(args.size)))
+    t0 = time.monotonic()
+    for i in range(args.count):
+        while True:
+            try:
+                p.produce(args.topic, value=payload,
+                          partition=i % args.partitions)
+                break
+            except BufferError:
+                p.poll(0.01)
+        if i % 10000 == 0:
+            p.poll(0)
+    rem = p.flush(300.0)
+    dt = time.monotonic() - t0
+    p.close()
+    rate = delivered[0] / dt
+    mb = delivered[0] * args.size / dt / 1e6
+    print(f"% {delivered[0]} msgs delivered ({errors[0]} failed, "
+          f"{rem} stuck) in {dt:.3f}s: {rate:,.0f} msgs/s, {mb:.2f} MB/s")
+    if stats:
+        il = stats[-1]["int_latency"]
+        print(f"% int_latency p50={il['p50']}us p99={il['p99']}us")
+    return rate
+
+
+def consume_mode(args):
+    conf = {"bootstrap.servers": args.bootstrap,
+            "group.id": args.group,
+            "auto.offset.reset": "earliest",
+            "check.crcs": True}
+    c = Consumer(conf)
+    c.subscribe([args.topic])
+    n = 0
+    nbytes = 0
+    t0 = None
+    idle_deadline = time.monotonic() + 30
+    while time.monotonic() < idle_deadline:
+        m = c.poll(0.5)
+        if m is None or m.error is not None:
+            continue
+        if t0 is None:
+            t0 = time.monotonic()
+        n += 1
+        nbytes += len(m.value or b"")
+        idle_deadline = time.monotonic() + 3
+        if args.count and n >= args.count:
+            break
+    dt = (time.monotonic() - t0) if t0 else 1
+    c.close()
+    print(f"% consumed {n} msgs in {dt:.3f}s: {n / dt:,.0f} msgs/s, "
+          f"{nbytes / dt / 1e6:.2f} MB/s")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-P", action="store_true", help="produce mode")
+    ap.add_argument("-C", action="store_true", help="consume mode")
+    ap.add_argument("-b", dest="bootstrap", default="")
+    ap.add_argument("-t", dest="topic", default="perf")
+    ap.add_argument("-g", dest="group", default="perf-group")
+    ap.add_argument("-s", dest="size", type=int, default=1024)
+    ap.add_argument("-c", dest="count", type=int, default=100000)
+    ap.add_argument("-z", dest="codec", default="none",
+                    choices=["none", "gzip", "snappy", "lz4", "zstd"])
+    ap.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--linger", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=10000)
+    ap.add_argument("--partitions", type=int, default=4)
+    ap.add_argument("--mock-brokers", type=int, default=1)
+    args = ap.parse_args()
+    if args.C:
+        consume_mode(args)
+    else:
+        produce_mode(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -29,3 +29,4 @@ from .client.producer import Producer  # noqa: F401
 from .client.consumer import Consumer  # noqa: F401
 from .client.admin import (AdminClient, ConfigEntry, ConfigResource,  # noqa: F401
                            NewPartitions, NewTopic)
+from .client.event import Event  # noqa: F401

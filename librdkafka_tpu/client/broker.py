@@ -34,6 +34,8 @@ from ..protocol.apis import APIS
 from ..protocol.msgset import MsgsetWriterV2
 from ..protocol.proto import ApiKey
 from .errors import Err, KafkaError, KafkaException
+from .feature import (MSGVER1, MSGVER2, fallback_api_versions,
+                      features_from_api_versions, pick_version)
 from .msg import Message, MsgStatus
 from .queue import Op, OpQueue, OpType
 
@@ -98,6 +100,8 @@ class Broker:
         self._wakeup_w.setblocking(False)
         self.ops.set_wakeup_cb(self._wakeup)
         self.api_versions: dict[int, int] = {}
+        self.features: set[str] = set()
+        self._apiversion_failed = False   # broker closed on ApiVersions
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
         self._next_connect = 0.0
         self.terminate = False
@@ -281,20 +285,47 @@ class Broker:
 
     def _connected(self):
         self._set_state(BrokerState.APIVERSION_QUERY)
-        # ApiVersions negotiation (reference: rdkafka_request.c:1809)
-        if self.rk.conf.get("api.version.request"):
+        # ApiVersions negotiation (reference: rdkafka_request.c:1809).
+        # Pre-0.10 brokers close the connection on unknown requests; the
+        # reference retries the connect WITHOUT ApiVersions and applies
+        # broker.version.fallback (rdkafka_feature.c legacy versions)
+        if (self.rk.conf.get("api.version.request")
+                and not self._apiversion_failed):
             self._xmit(Request(ApiKey.ApiVersions, {},
                                cb=self._handle_apiversions))
         else:
+            self._apply_version_fallback()
             self._broker_up()
 
+    def _apply_version_fallback(self):
+        fb = self.rk.conf.get("broker.version.fallback")
+        self.api_versions = fallback_api_versions(fb)
+        self.features = features_from_api_versions(self.api_versions)
+        # one-shot: the NEXT reconnect probes ApiVersions again, so a
+        # transient blip can't pin a modern broker to legacy mode
+        self._apiversion_failed = False
+        self.rk.dbg("feature",
+                    f"{self.name}: assuming broker {fb}: "
+                    f"features {sorted(self.features)}")
+
     def _handle_apiversions(self, err, resp):
+        if err is not None and err.code in (Err._TRANSPORT, Err._TIMED_OUT):
+            # broker closed/ignored the request — a pre-0.10 broker.
+            # Reconnect once without ApiVersions (reference behavior)
+            self._apiversion_failed = True
+            if err.code == Err._TIMED_OUT:
+                # a timeout does not tear the connection down by itself
+                self._disconnect(KafkaError(
+                    Err._TRANSPORT, "ApiVersions timed out"))
+            return      # the disconnect path triggers the reconnect
         if err or resp["error_code"] != 0:
-            # fall back to assumed versions (broker.version.fallback)
-            self.api_versions = {}
+            self._apply_version_fallback()
         else:
             self.api_versions = {v["api_key"]: v["max_version"]
                                  for v in resp["api_versions"]}
+            self.features = features_from_api_versions(self.api_versions)
+            self.rk.dbg("feature",
+                        f"{self.name}: features {sorted(self.features)}")
         if self.rk.sasl_required():
             self._set_state(BrokerState.AUTH_HANDSHAKE)
             self.rk.sasl_start(self)
@@ -368,6 +399,7 @@ class Broker:
         if ver is None:
             our = APIS[req.api][0]
             ver = min(our, self.api_versions.get(int(req.api), our))
+        req.version = ver          # response parses with the same schema
         wire = apis.build_request(req.api, req.corrid,
                                   self.rk.conf.get("client.id"), req.body,
                                   version=ver)
@@ -481,7 +513,8 @@ class Broker:
         if req.ts_sent:
             self.rtt_avg.add((time.monotonic() - req.ts_sent) * 1e6)
         try:
-            _, body = apis.parse_response(req.api, payload)
+            _, body = apis.parse_response(req.api, payload,
+                                          version=req.version)
         except Exception as e:
             self._req_fail(req, KafkaError(Err._BAD_MSG,
                                            f"response parse: {e!r}"))
@@ -527,7 +560,9 @@ class Broker:
         linger = rk.conf.get("queue.buffering.max.ms") / 1000.0
         batch_max = rk.conf.get("batch.num.messages")
         codec = rk.conf.get("compression.codec")
-        ready: list[tuple] = []   # (toppar, msgs, writer)
+        # pre-0.11 broker: magic 0/1 path — skip V2 writer construction
+        legacy = bool(self.features) and MSGVER2 not in self.features
+        ready: list[tuple] = []   # (toppar, msgs, writer|None-when-legacy)
 
         for tp in list(self.toppars):
             if tp.leader_id != self.nodeid:
@@ -549,7 +584,9 @@ class Broker:
                         msgs = list(tp.retry_batches.popleft())
                         tp.inflight_msgids.add(msgs[0].msgid)
                     tp.inflight += 1
-                    ready.append((tp, msgs, self._make_writer(tp, msgs, codec)))
+                    ready.append((tp, msgs,
+                                  None if legacy else
+                                  self._make_writer(tp, msgs, codec)))
             if tp.retry_batches or tp.inflight >= max_inflight:
                 continue
             if not tp.xmit_msgq or now < tp.retry_backoff_until:
@@ -583,8 +620,9 @@ class Broker:
             with tp.lock:
                 tp.inflight_msgids.add(msgs[0].msgid)
             tp.inflight += 1
-            writer = self._make_writer(tp, msgs, codec)
-            ready.append((tp, msgs, writer))
+            ready.append((tp, msgs,
+                          None if legacy else
+                          self._make_writer(tp, msgs, codec)))
 
         if not ready:
             return
@@ -599,6 +637,13 @@ class Broker:
                 self.rk.stats.int_latency.add(
                     (now - msgs[-1].enq_time) * 1e6)
         ts_codec = time.monotonic()
+
+        # legacy broker (no MSGVER2): magic 0/1 messagesets via the v01
+        # writer, Produce <= v2 (reference MsgVersion selection,
+        # rdkafka_msgset_writer.c:100 by feature set)
+        if legacy:
+            self._produce_legacy(ready, codec, now)
+            return
 
         # ---- phase 2: ONE batched compress + ONE batched CRC call across
         # partitions (both ride the same provider/offload axis; reference
@@ -667,7 +712,34 @@ class Broker:
         w.build(msgs, int(time.time() * 1000))
         return w
 
-    def _send_produce(self, tp, msgs: list[Message], wire: bytes, now: float):
+    def _produce_legacy(self, ready: list, codec: str, now: float):
+        """Magic 0/1 path for pre-0.11 brokers: per-batch msgset build +
+        compression wrapper (no batched CRC seam — MsgVer0/1 CRC is the
+        per-message zlib crc32 the v01 writer computes inline)."""
+        from ..protocol.msgset import write_msgset_v01
+        rk = self.rk
+        magic = 1 if MSGVER1 in self.features else 0
+        ver = pick_version(self.api_versions, ApiKey.Produce, 2)
+        provider = rk.codec_provider
+        now_ms = int(time.time() * 1000)
+        for tp, msgs, _writer in ready:
+            try:
+                compress_fn = None
+                use_codec = None if codec == "none" else codec
+                if use_codec:
+                    lvl = rk.topic_conf_for(tp.topic).get("compression.level")
+                    compress_fn = (lambda raw, c=use_codec, l=lvl:
+                                   provider.compress_many(c, [raw], l)[0])
+                wire = write_msgset_v01(msgs, magic=magic, codec=use_codec,
+                                        now_ms=now_ms,
+                                        compress_fn=compress_fn)
+            except Exception as e:
+                self._release_unsent(tp, msgs, e)
+                continue
+            self._send_produce(tp, msgs, wire, now, version=ver)
+
+    def _send_produce(self, tp, msgs: list[Message], wire: bytes, now: float,
+                      version: Optional[int] = None):
         rk = self.rk
         tconf = rk.topic_conf_for(tp.topic)
         acks = tconf.get("request.required.acks")
@@ -683,6 +755,7 @@ class Broker:
              "topics": [{"topic": tp.topic, "partitions": [
                  {"partition": tp.partition, "records": wire}]}]},
             expect_response=(acks != 0),
+            version=version,
             cb=lambda err, resp, tp=tp, msgs=msgs: self._handle_produce(
                 tp, msgs, err, resp))
         self._xmit(req)
@@ -837,7 +910,8 @@ class Broker:
                 for tp in tps]} for t, tps in by_topic.items()]}
         self.fetch_inflight = True
         versions = {(tp.topic, tp.partition): tp.version for tp in fetch_parts}
-        self._xmit(Request(ApiKey.Fetch, body,
+        fetch_ver = pick_version(self.api_versions, ApiKey.Fetch, 4)
+        self._xmit(Request(ApiKey.Fetch, body, version=fetch_ver,
                            cb=lambda err, resp: self._handle_fetch(
                                err, resp, versions)))
 
@@ -851,8 +925,11 @@ class Broker:
         tp.fetch_state = FetchState.OFFSET_WAIT
         body = {"replica_id": -1,
                 "topics": [{"topic": tp.topic, "partitions": [
-                    {"partition": tp.partition, "timestamp": ts}]}]}
+                    {"partition": tp.partition, "timestamp": ts,
+                     "max_num_offsets": 1}]}]}    # v0 field; v1 ignores
         self._xmit(Request(ApiKey.ListOffsets, body, retries_left=3,
+                           version=pick_version(self.api_versions,
+                                                ApiKey.ListOffsets, 1),
                            cb=lambda err, resp, tp=tp:
                            self._handle_offset(tp, err, resp)))
 
@@ -870,7 +947,19 @@ class Broker:
             tp.fetch_backoff_until = time.monotonic() + \
                 self.rk.conf.get("fetch.error.backoff.ms") / 1000.0
             return
-        tp.fetch_offset = pres["offset"]
+        if "offset" in pres:
+            resolved = pres["offset"]
+        else:                       # ListOffsets v0: plural offsets
+            offs = pres.get("offsets") or [-1]
+            resolved = offs[0]
+        if resolved < 0:
+            # no resolvable offset: back off and re-query rather than
+            # fetching at -1 (OFFSET_OUT_OF_RANGE loop)
+            tp.fetch_state = FetchState.OFFSET_QUERY
+            tp.fetch_backoff_until = time.monotonic() + \
+                self.rk.conf.get("fetch.error.backoff.ms") / 1000.0
+            return
+        tp.fetch_offset = resolved
         tp.fetch_state = FetchState.ACTIVE
         self.rk.dbg("fetch", f"{tp}: offset query -> {tp.fetch_offset}")
 
@@ -903,7 +992,8 @@ class Broker:
                 ec = Err.from_wire(p["error_code"])
                 if ec == Err.NO_ERROR:
                     tp.hi_offset = p["high_watermark"]
-                    tp.ls_offset = p["last_stable_offset"]
+                    tp.ls_offset = p.get("last_stable_offset",
+                                         p["high_watermark"])
                     blob = p["records"] or b""
                     batches = None
                     if (len(blob) > proto.V2_OF_Magic

@@ -211,6 +211,9 @@ class ConsumerGroup:
              "session_timeout": self.rk.conf.get("session.timeout.ms"),
              "rebalance_timeout": self.rk.conf.get("max.poll.interval.ms"),
              "member_id": self.member_id,
+             # KIP-345 static membership (JoinGroup v5+)
+             "group_instance_id":
+                 self.rk.conf.get("group.instance.id") or None,
              "protocol_type": "consumer",
              "protocols": [{"name": n.strip(), "metadata": meta}
                            for n in names if n.strip()]},
@@ -435,7 +438,8 @@ class ConsumerGroup:
         by_topic: dict[str, list] = {}
         for (t, p), off in offsets.items():
             by_topic.setdefault(t, []).append(
-                {"partition": p, "offset": off, "metadata": None})
+                {"partition": p, "offset": off, "metadata": None,
+                 "timestamp": -1})    # OffsetCommit v1 field; v2 ignores
 
         def on_commit(err, resp):
             if err is None and self.rk.interceptors:
@@ -516,7 +520,11 @@ class ConsumerGroup:
     # --------------------------------------------------------------- leave --
     def _leave(self):
         b = self._coord_broker()
-        if b is not None and self.member_id:
+        # KIP-345: static members do NOT send LeaveGroup — the member
+        # slot survives restarts until session.timeout.ms (reference:
+        # rd_kafka_cgrp_leave skips for group.instance.id)
+        static = bool(self.rk.conf.get("group.instance.id"))
+        if b is not None and self.member_id and not static:
             b.enqueue_request(Request(
                 ApiKey.LeaveGroup,
                 {"group_id": self.group_id, "member_id": self.member_id},

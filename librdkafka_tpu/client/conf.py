@@ -225,6 +225,12 @@ PROPERTIES: list[Prop] = [
     _p("error_cb", GLOBAL, "ptr", None, "Error callback."),
     _p("throttle_cb", GLOBAL, "ptr", None, "Throttle callback."),
     _p("stats_cb", GLOBAL, "ptr", None, "Statistics callback."),
+    _p("background_event_cb", GLOBAL, "ptr", None,
+       "Background event callback: events are served from a dedicated "
+       "background thread instead of poll() (rdkafka_background.c)."),
+    _p("enabled_events", GLOBAL, "list", "",
+       "Event types to generate for queue_poll()/background consumption "
+       "(rd_kafka_conf_set_events analog): dr, error, log, stats."),
     _p("log_cb", GLOBAL, "ptr", None, "Log callback."),
     _p("oauthbearer_token_refresh_cb", GLOBAL, "ptr", None, "OAUTHBEARER refresh callback."),
     _p("socket_cb", GLOBAL, "ptr", None, "Socket creation callback (sockem hook)."),

@@ -136,6 +136,7 @@ class Kafka:
         self._metadata_lock = threading.Lock()
         self._metadata_inflight = False
         self._metadata_refresh_queued = False
+        self._fast_refresh_scheduled = False
         self.flushing = False
         self.terminating = False
         self.fatal_error: Optional[KafkaError] = None
@@ -184,6 +185,14 @@ class Kafka:
         if self.is_consumer:
             from .offset_store import FileOffsetStore
             self.offset_store = FileOffsetStore(self)
+
+        # optional background event thread (rdkafka_background.c:109,
+        # created at rd_kafka_new rdkafka.c:2189-2196)
+        self.background = None
+        bg_cb = conf.get("background_event_cb")
+        if bg_cb is not None:
+            from .event import BackgroundThread
+            self.background = BackgroundThread(self, bg_cb)
 
         # implicit mock cluster (test.mock.num.brokers)
         nmock = conf.get("test.mock.num.brokers")
@@ -337,6 +346,23 @@ class Kafka:
         if full and self.cgrp is not None:
             # regex subscription re-evaluation (rdkafka_pattern.c)
             self.cgrp.metadata_update(seen)
+        # leaderless partitions (election in progress): re-query on the
+        # fast interval (topic.metadata.refresh.fast.interval.ms;
+        # reference rd_kafka_metadata_refresh fast path)
+        leaderless = any(
+            p["leader"] < 0
+            for t in resp["topics"] if t["error_code"] == 0
+            for p in t["partitions"])
+        if leaderless and not self._fast_refresh_scheduled:
+            self._fast_refresh_scheduled = True
+            fast = self.conf.get(
+                "topic.metadata.refresh.fast.interval.ms") / 1000.0
+
+            def _fast_refresh():
+                self._fast_refresh_scheduled = False
+                self.metadata_refresh("fast")
+
+            self.timers.add(fast, _fast_refresh, once=True)
         # instantiate broker threads for newly discovered nodes
         with self._brokers_lock:
             for nid, (host, port) in new_brokers.items():
@@ -520,7 +546,9 @@ class Kafka:
         if self.interceptors:
             for m in msgs:
                 self.interceptors.on_acknowledgement(m)
-        if self.conf.get("dr_msg_cb") or self.conf.get("dr_cb"):
+        if (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
+                or "dr" in self.conf.get("enabled_events")
+                or self.background is not None):
             only_err = self.conf.get("delivery.report.only.error")
             out = msgs if (err or not only_err) else \
                 [m for m in msgs if m.error]
@@ -540,6 +568,14 @@ class Kafka:
             t = 0
             self._serve_rep_op(op)
             served += 1
+
+    def queue_poll(self, timeout: float = 0.0):
+        """Pop one typed Event from the reply queue (reference:
+        rd_kafka_queue_poll → rd_kafka_event_t). Alternative to the
+        callback dispatch of poll()."""
+        from .event import Event
+        op = self.rep.pop(timeout)
+        return Event(op) if op is not None else None
 
     def _serve_rep_op(self, op: Op):
         if op.type == OpType.DR:
@@ -709,10 +745,11 @@ class Kafka:
             return
         check_crcs = self.conf.get("check.crcs")
         read_committed = (self.conf.get("isolation.level") == "read_committed")
+        aborted_list = pres.get("aborted_transactions") or []
         aborted = {a["producer_id"]: sorted(x["first_offset"]
-                   for x in pres["aborted_transactions"]
+                   for x in aborted_list
                    if x["producer_id"] == a["producer_id"])
-                   for a in (pres["aborted_transactions"] or [])}
+                   for a in aborted_list}
         active_aborts: set[int] = set()
         msgs: list[Message] = []
         next_offset = fo
@@ -846,6 +883,8 @@ class Kafka:
             self.mock_cluster.stop()
         if self.offset_store is not None:
             self.offset_store.close()
+        if self.background is not None:
+            self.background.stop()
 
     # ----------------------------------------------------------- security --
     def ssl_ctx(self):

@@ -135,7 +135,8 @@ class MockCluster:
     def __init__(self, num_brokers: int = 3, topics: Optional[dict] = None,
                  auto_create_topics: bool = True, default_partitions: int = 4,
                  tls: Optional[dict] = None,
-                 sasl_users: Optional[dict] = None):
+                 sasl_users: Optional[dict] = None,
+                 broker_version: Optional[str] = None):
         """``tls``: enable the TLS listener mode —
         ``{"certfile": ..., "keyfile": ..., "cafile": ...,
         "require_client_cert": bool}``. All mock brokers then speak TLS
@@ -150,6 +151,12 @@ class MockCluster:
         real password to derive keys)."""
         self.num_brokers = num_brokers
         self.sasl_users = sasl_users
+        # emulate an old broker: closes the connection on ApiVersions
+        # when < 0.10 (the real pre-0.10 behavior clients must survive)
+        self.broker_version = broker_version
+        if broker_version is not None:
+            from ..client.feature import _parse_version
+            self._bv_tuple = _parse_version(broker_version)
         self._tls_ctx = None
         if tls:
             from ..client.tls import make_server_ctx
@@ -425,6 +432,14 @@ class MockCluster:
             if stack:
                 inject = stack.popleft()
 
+        # legacy-broker emulation: pre-0.10 brokers do not know
+        # ApiVersions and close the connection on unknown requests
+        if (self.broker_version is not None
+                and api == ApiKey.ApiVersions
+                and self._bv_tuple < (0, 10, 0)):
+            self._close(conn)
+            return
+
         handler = getattr(self, f"_h_{api.name}", None)
         if handler is None:
             self._close(conn)
@@ -432,10 +447,11 @@ class MockCluster:
         resp = handler(conn, corrid, hdr, body, inject)
         if resp is None:
             return  # parked (fetch/join) — handler responds later
-        self._respond(conn, corrid, api, resp)
+        self._respond(conn, corrid, api, resp, version=hdr["api_version"])
 
-    def _respond(self, conn: _Conn, corrid: int, api: ApiKey, body: dict):
-        wire = apis.build_response(api, corrid, body)
+    def _respond(self, conn: _Conn, corrid: int, api: ApiKey, body: dict,
+                 version: int | None = None):
+        wire = apis.build_response(api, corrid, body, version=version)
         rtt = self._rtt_ms.get(conn.broker_id, 0)
         if rtt > 0:
             with self._lock:
@@ -446,8 +462,14 @@ class MockCluster:
 
     # ---------------------------------------------------------- handlers ---
     def _h_ApiVersions(self, conn, corrid, hdr, body, inject):
-        vers = [{"api_key": int(k), "min_version": 0, "max_version": v}
-                for k, (v, _, _) in APIS.items()]
+        if self.broker_version is not None:
+            from ..client.feature import fallback_api_versions
+            av = fallback_api_versions(self.broker_version)
+            vers = [{"api_key": k, "min_version": 0, "max_version": v}
+                    for k, v in av.items()]
+        else:
+            vers = [{"api_key": int(k), "min_version": 0, "max_version": v}
+                    for k, (v, _, _) in APIS.items()]
         return {"error_code": (inject.wire if inject else 0),
                 "api_versions": vers}
 
@@ -542,7 +564,8 @@ class MockCluster:
             return resp
         # no data yet: park until max_wait or data arrives
         deadline = now + body["max_wait_time"] / 1000.0
-        self._parked_fetches.append((deadline, conn, corrid, body))
+        self._parked_fetches.append((deadline, conn, corrid, body,
+                                     hdr["api_version"]))
         return None
 
     def _try_fetch(self, conn, body, inject, force: bool = False):
@@ -588,14 +611,14 @@ class MockCluster:
 
     def _serve_parked_fetches(self, now: float):
         still = []
-        for deadline, conn, corrid, body in self._parked_fetches:
+        for deadline, conn, corrid, body, ver in self._parked_fetches:
             if conn.closed:
                 continue
             resp = self._try_fetch(conn, body, None, force=(now >= deadline))
             if resp is not None:
-                self._respond(conn, corrid, ApiKey.Fetch, resp)
+                self._respond(conn, corrid, ApiKey.Fetch, resp, version=ver)
             else:
-                still.append((deadline, conn, corrid, body))
+                still.append((deadline, conn, corrid, body, ver))
         self._parked_fetches = still
 
     def _h_ListOffsets(self, conn, corrid, hdr, body, inject):
@@ -617,7 +640,9 @@ class MockCluster:
                                   else part.end_offset)
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
-                         "timestamp": -1, "offset": offset})
+                         "timestamp": -1, "offset": offset,
+                         # plural form for ListOffsets v0 responses
+                         "offsets": [offset] if offset >= 0 else []})
                 out.append(tp)
         return {"topics": out}
 
@@ -637,6 +662,17 @@ class MockCluster:
                 self.groups[gid] = MockGroup(group_id=gid)
             return self.groups[gid]
 
+    def _member_id_for(self, g, body, client_id):
+        """Static members (group.instance.id) keep a stable member_id
+        across restarts (KIP-345); dynamic members get a fresh one."""
+        inst = body.get("group_instance_id")
+        if inst:
+            for m in g.members.values():
+                if getattr(m, "instance_id", None) == inst:
+                    return m.member_id
+            return f"{client_id}-static-{inst}"
+        return None
+
     def _h_JoinGroup(self, conn, corrid, hdr, body, inject):
         if inject:
             return {"throttle_time_ms": 0, "error_code": inject.wire,
@@ -645,6 +681,10 @@ class MockCluster:
         g = self._group(body["group_id"])
         with self._lock:
             member_id = body["member_id"]
+            static_id = self._member_id_for(g, body,
+                                            hdr["client_id"] or "member")
+            if static_id is not None:
+                member_id = static_id
             if not member_id:
                 member_id = f"{hdr['client_id'] or 'member'}-{len(g.members) + 1}-{int(time.monotonic()*1e6) & 0xFFFF}"
             m = g.members.get(member_id)
@@ -652,17 +692,18 @@ class MockCluster:
                 m = GroupMember(member_id=member_id,
                                 client_id=hdr["client_id"] or "",
                                 client_host="/127.0.0.1")
+                m.instance_id = body.get("group_instance_id")
                 g.members[member_id] = m
             m.protocols = [(p["name"], p["metadata"]) for p in body["protocols"]]
             m.metadata = m.protocols[0][1] if m.protocols else b""
             m.session_timeout_ms = body["session_timeout"]
             m.last_heartbeat = time.monotonic()
             g.protocol_type = body["protocol_type"]
-            m.pending_join = (conn, corrid)
+            m.pending_join = (conn, corrid, hdr["api_version"])
             if g.state in ("Empty", "Stable", "CompletingRebalance"):
                 g.state = "PreparingRebalance"
                 g.rebalance_deadline = time.monotonic() + min(
-                    body["rebalance_timeout"], 3000) / 1000.0
+                    body.get("rebalance_timeout", 3000), 3000) / 1000.0
             # complete immediately if every member has rejoined
             self._maybe_complete_join(g)
         return None  # parked; responded by _maybe_complete_join / timer
@@ -693,16 +734,17 @@ class MockCluster:
         g.state = "CompletingRebalance"
         members_meta = [
             {"member_id": m.member_id,
+             "group_instance_id": getattr(m, "instance_id", None),
              "metadata": dict(m.protocols).get(g.protocol, b"")}
             for m in g.members.values()]
         for m in g.members.values():
-            conn, corrid = m.pending_join
+            conn, corrid, jver = m.pending_join
             m.pending_join = None
             body = {"throttle_time_ms": 0, "error_code": 0,
                     "generation_id": g.generation, "protocol": g.protocol,
                     "leader_id": g.leader, "member_id": m.member_id,
                     "members": members_meta if m.member_id == g.leader else []}
-            self._respond(conn, corrid, ApiKey.JoinGroup, body)
+            self._respond(conn, corrid, ApiKey.JoinGroup, body, version=jver)
 
     def _serve_group_timers(self, now: float):
         with self._lock:
@@ -744,17 +786,19 @@ class MockCluster:
                         g.members[a["member_id"]].assignment = a["assignment"]
                 g.state = "Stable"
                 # flush parked syncs
-                for (pconn, pcorrid, pmid) in g.pending_syncs:
+                for (pconn, pcorrid, pmid, pver) in g.pending_syncs:
                     self._respond(pconn, pcorrid, ApiKey.SyncGroup,
                                   {"throttle_time_ms": 0, "error_code": 0,
-                                   "assignment": g.members[pmid].assignment})
+                                   "assignment": g.members[pmid].assignment},
+                                  version=pver)
                 g.pending_syncs.clear()
                 return {"throttle_time_ms": 0, "error_code": 0,
                         "assignment": g.members[g.leader].assignment}
             if g.state == "Stable":
                 return {"throttle_time_ms": 0, "error_code": 0,
                         "assignment": g.members[body["member_id"]].assignment}
-            g.pending_syncs.append((conn, corrid, body["member_id"]))
+            g.pending_syncs.append((conn, corrid, body["member_id"],
+                                    hdr["api_version"]))
             return None
 
     def _h_Heartbeat(self, conn, corrid, hdr, body, inject):

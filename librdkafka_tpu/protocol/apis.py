@@ -45,6 +45,31 @@ METADATA_V2_RESP = Schema(
             ("replicas", Array(Int32)), ("isr", Array(Int32)))))))))
 
 # --------------------------------------------------------------- Produce --
+# Legacy versions for pre-0.11 brokers (broker.version.fallback;
+# reference emits the version the feature set allows,
+# rdkafka_request.c:2927 + rdkafka_feature.c)
+PRODUCE_V0_REQ = Schema(
+    ("acks", Int16), ("timeout", Int32),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("records", Bytes))))))))
+PRODUCE_V0_RESP = Schema(
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16),
+            ("base_offset", Int64))))))))
+# v2: throttle + per-partition log_append_time, req still w/o txn id
+PRODUCE_V2_REQ = PRODUCE_V0_REQ
+PRODUCE_V2_RESP = Schema(
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16),
+            ("base_offset", Int64), ("log_append_time", Int64))))))),
+    ("throttle_time_ms", Int32))
+
 PRODUCE_V3_REQ = Schema(
     ("transactional_id", NullableString),
     ("acks", Int16), ("timeout", Int32),
@@ -61,6 +86,28 @@ PRODUCE_V3_RESP = Schema(
     ("throttle_time_ms", Int32))
 
 # ----------------------------------------------------------------- Fetch --
+FETCH_V0_REQ = Schema(
+    ("replica_id", Int32), ("max_wait_time", Int32), ("min_bytes", Int32),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("fetch_offset", Int64),
+            ("max_bytes", Int32))))))))
+FETCH_V0_RESP = Schema(
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16),
+            ("high_watermark", Int64), ("records", Bytes))))))))
+FETCH_V2_REQ = FETCH_V0_REQ
+FETCH_V2_RESP = Schema(
+    ("throttle_time_ms", Int32),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16),
+            ("high_watermark", Int64), ("records", Bytes))))))))
+
 FETCH_V4_REQ = Schema(
     ("replica_id", Int32), ("max_wait_time", Int32), ("min_bytes", Int32),
     ("max_bytes", Int32), ("isolation_level", Int8),
@@ -112,6 +159,21 @@ JOINGROUP_V2_RESP = Schema(
     ("generation_id", Int32), ("protocol", String),
     ("leader_id", String), ("member_id", String),
     ("members", Array(Schema(("member_id", String), ("metadata", Bytes)))))
+
+# JoinGroup v5 (KIP-345 static membership): + group_instance_id
+JOINGROUP_V5_REQ = Schema(
+    ("group_id", String), ("session_timeout", Int32),
+    ("rebalance_timeout", Int32), ("member_id", String),
+    ("group_instance_id", NullableString),
+    ("protocol_type", String),
+    ("protocols", Array(Schema(("name", String), ("metadata", Bytes)))))
+JOINGROUP_V5_RESP = Schema(
+    ("throttle_time_ms", Int32), ("error_code", Int16),
+    ("generation_id", Int32), ("protocol", String),
+    ("leader_id", String), ("member_id", String),
+    ("members", Array(Schema(
+        ("member_id", String), ("group_instance_id", NullableString),
+        ("metadata", Bytes)))))
 
 # ------------------------------------------------------------- SyncGroup --
 SYNCGROUP_V1_REQ = Schema(
@@ -276,7 +338,7 @@ APIS: dict[ApiKey, tuple[int, Schema, Schema]] = {
     ApiKey.Fetch: (4, FETCH_V4_REQ, FETCH_V4_RESP),
     ApiKey.ListOffsets: (1, LISTOFFSETS_V1_REQ, LISTOFFSETS_V1_RESP),
     ApiKey.FindCoordinator: (1, FINDCOORDINATOR_V1_REQ, FINDCOORDINATOR_V1_RESP),
-    ApiKey.JoinGroup: (2, JOINGROUP_V2_REQ, JOINGROUP_V2_RESP),
+    ApiKey.JoinGroup: (5, JOINGROUP_V5_REQ, JOINGROUP_V5_RESP),
     ApiKey.SyncGroup: (1, SYNCGROUP_V1_REQ, SYNCGROUP_V1_RESP),
     ApiKey.Heartbeat: (1, HEARTBEAT_V1_REQ, HEARTBEAT_V1_RESP),
     ApiKey.LeaveGroup: (1, LEAVEGROUP_V1_REQ, LEAVEGROUP_V1_RESP),
@@ -296,15 +358,196 @@ APIS: dict[ApiKey, tuple[int, Schema, Schema]] = {
 }
 
 
+#: Explicit (api, version) schema overrides for legacy broker support
+#: (broker.version.fallback; reference rdkafka_feature.c maps version
+#: ranges to emitted request versions). Versions between table entries
+#: resolve DOWN to the nearest listed one.
+PRODUCE_V1_RESP = Schema(     # v1: +throttle, no log_append_time yet
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16),
+            ("base_offset", Int64))))))),
+    ("throttle_time_ms", Int32))
+
+VERSIONED: dict[tuple[ApiKey, int], tuple[Schema, Schema]] = {
+    (ApiKey.Produce, 0): (PRODUCE_V0_REQ, PRODUCE_V0_RESP),
+    (ApiKey.Produce, 1): (PRODUCE_V0_REQ, PRODUCE_V1_RESP),
+    (ApiKey.Produce, 2): (PRODUCE_V2_REQ, PRODUCE_V2_RESP),
+    (ApiKey.Fetch, 0): (FETCH_V0_REQ, FETCH_V0_RESP),
+    (ApiKey.Fetch, 1): (FETCH_V2_REQ, FETCH_V2_RESP),
+    (ApiKey.Fetch, 2): (FETCH_V2_REQ, FETCH_V2_RESP),
+    (ApiKey.Fetch, 3): (FETCH_V2_REQ, FETCH_V2_RESP),
+}
+# Fetch v3 request adds top-level max_bytes (response like v2)
+FETCH_V3_REQ = Schema(
+    ("replica_id", Int32), ("max_wait_time", Int32), ("min_bytes", Int32),
+    ("max_bytes", Int32),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("fetch_offset", Int64),
+            ("max_bytes", Int32))))))))
+VERSIONED[(ApiKey.Fetch, 3)] = (FETCH_V3_REQ, FETCH_V2_RESP)
+
+# --- group / offset APIs for pre-1.0 brokers (all subset schemas: the
+# client builds one superset body dict; a version's schema writes only
+# its own fields) ---
+JOINGROUP_V0_REQ = Schema(
+    ("group_id", String), ("session_timeout", Int32), ("member_id", String),
+    ("protocol_type", String),
+    ("protocols", Array(Schema(("name", String), ("metadata", Bytes)))))
+JOINGROUP_V01_RESP = Schema(
+    ("error_code", Int16),
+    ("generation_id", Int32), ("protocol", String),
+    ("leader_id", String), ("member_id", String),
+    ("members", Array(Schema(("member_id", String), ("metadata", Bytes)))))
+VERSIONED[(ApiKey.JoinGroup, 0)] = (JOINGROUP_V0_REQ, JOINGROUP_V01_RESP)
+VERSIONED[(ApiKey.JoinGroup, 1)] = (JOINGROUP_V2_REQ, JOINGROUP_V01_RESP)
+for _jv in (2, 3, 4):
+    VERSIONED[(ApiKey.JoinGroup, _jv)] = (JOINGROUP_V2_REQ,
+                                          JOINGROUP_V2_RESP)
+
+SYNCGROUP_V0_RESP = Schema(("error_code", Int16), ("assignment", Bytes))
+VERSIONED[(ApiKey.SyncGroup, 0)] = (SYNCGROUP_V1_REQ, SYNCGROUP_V0_RESP)
+
+HEARTBEAT_V0_RESP = Schema(("error_code", Int16))
+VERSIONED[(ApiKey.Heartbeat, 0)] = (HEARTBEAT_V1_REQ, HEARTBEAT_V0_RESP)
+VERSIONED[(ApiKey.LeaveGroup, 0)] = (LEAVEGROUP_V1_REQ, HEARTBEAT_V0_RESP)
+
+# FindCoordinator v0 ("GroupCoordinator"): bare group key, no throttle
+FINDCOORDINATOR_V0_REQ = Schema(("key", String))
+FINDCOORDINATOR_V0_RESP = Schema(
+    ("error_code", Int16),
+    ("node_id", Int32), ("host", String), ("port", Int32))
+VERSIONED[(ApiKey.FindCoordinator, 0)] = (FINDCOORDINATOR_V0_REQ,
+                                          FINDCOORDINATOR_V0_RESP)
+
+# ListOffsets v0: per-partition max_num_offsets + plural offsets reply
+LISTOFFSETS_V0_REQ = Schema(
+    ("replica_id", Int32),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("timestamp", Int64),
+            ("max_num_offsets", Int32))))))))
+LISTOFFSETS_V0_RESP = Schema(
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16),
+            ("offsets", Array(Int64)))))))))
+VERSIONED[(ApiKey.ListOffsets, 0)] = (LISTOFFSETS_V0_REQ,
+                                      LISTOFFSETS_V0_RESP)
+
+# Metadata v0: no rack/is_internal/cluster_id/controller_id; v1 adds
+# rack + controller_id + is_internal (cluster_id arrives in v2)
+METADATA_V0_RESP = Schema(
+    ("brokers", Array(Schema(
+        ("node_id", Int32), ("host", String), ("port", Int32)))),
+    ("topics", Array(Schema(
+        ("error_code", Int16), ("topic", String),
+        ("partitions", Array(Schema(
+            ("error_code", Int16), ("partition", Int32), ("leader", Int32),
+            ("replicas", Array(Int32)), ("isr", Array(Int32)))))))))
+METADATA_V1_RESP = Schema(
+    ("brokers", Array(Schema(
+        ("node_id", Int32), ("host", String), ("port", Int32),
+        ("rack", NullableString)))),
+    ("controller_id", Int32),
+    ("topics", Array(Schema(
+        ("error_code", Int16), ("topic", String), ("is_internal", Boolean),
+        ("partitions", Array(Schema(
+            ("error_code", Int16), ("partition", Int32), ("leader", Int32),
+            ("replicas", Array(Int32)), ("isr", Array(Int32)))))))))
+VERSIONED[(ApiKey.Metadata, 0)] = (METADATA_V2_REQ, METADATA_V0_RESP)
+VERSIONED[(ApiKey.Metadata, 1)] = (METADATA_V2_REQ, METADATA_V1_RESP)
+
+# OffsetCommit v0/v1 (pre-0.9 brokers)
+OFFSETCOMMIT_V0_REQ = Schema(
+    ("group_id", String),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("offset", Int64),
+            ("metadata", NullableString))))))))
+OFFSETCOMMIT_V1_REQ = Schema(
+    ("group_id", String), ("generation_id", Int32), ("member_id", String),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("offset", Int64),
+            ("timestamp", Int64), ("metadata", NullableString))))))))
+VERSIONED[(ApiKey.OffsetCommit, 0)] = (OFFSETCOMMIT_V0_REQ,
+                                       OFFSETCOMMIT_V2_RESP)
+VERSIONED[(ApiKey.OffsetCommit, 1)] = (OFFSETCOMMIT_V1_REQ,
+                                       OFFSETCOMMIT_V2_RESP)
+
+# CreateTopics v0/v1 and DeleteTopics v0: no throttle (v0 also lacks
+# error_message / validate_only)
+CREATETOPICS_V0_REQ = Schema(
+    ("topics", Array(Schema(
+        ("topic", String), ("num_partitions", Int32),
+        ("replication_factor", Int16),
+        ("replica_assignment", Array(Schema(
+            ("partition", Int32), ("replicas", Array(Int32))))),
+        ("configs", Array(Schema(
+            ("name", String), ("value", NullableString))))))),
+    ("timeout", Int32))
+CREATETOPICS_V0_RESP = Schema(
+    ("topics", Array(Schema(("topic", String), ("error_code", Int16)))))
+CREATETOPICS_V1_RESP = Schema(
+    ("topics", Array(Schema(
+        ("topic", String), ("error_code", Int16),
+        ("error_message", NullableString)))))
+VERSIONED[(ApiKey.CreateTopics, 0)] = (CREATETOPICS_V0_REQ,
+                                       CREATETOPICS_V0_RESP)
+VERSIONED[(ApiKey.CreateTopics, 1)] = (CREATETOPICS_V2_REQ,
+                                       CREATETOPICS_V1_RESP)
+DELETETOPICS_V0_RESP = Schema(
+    ("topics", Array(Schema(("topic", String), ("error_code", Int16)))))
+VERSIONED[(ApiKey.DeleteTopics, 0)] = (DELETETOPICS_V1_REQ,
+                                       DELETETOPICS_V0_RESP)
+
+# DescribeConfigs v0: entries without synonyms, no include_synonyms
+DESCRIBECONFIGS_V0_REQ = Schema(
+    ("resources", Array(Schema(
+        ("resource_type", Int8), ("resource_name", String),
+        ("config_names", Array(String))))))
+DESCRIBECONFIGS_V0_RESP = Schema(
+    ("throttle_time_ms", Int32),
+    ("resources", Array(Schema(
+        ("error_code", Int16), ("error_message", NullableString),
+        ("resource_type", Int8), ("resource_name", String),
+        ("entries", Array(Schema(
+            ("name", String), ("value", NullableString),
+            ("read_only", Boolean), ("is_default", Boolean),
+            ("sensitive", Boolean))))))))
+VERSIONED[(ApiKey.DescribeConfigs, 0)] = (DESCRIBECONFIGS_V0_REQ,
+                                          DESCRIBECONFIGS_V0_RESP)
+
+
+def schemas_for(api: ApiKey, version: int | None) -> tuple[int, Schema, Schema]:
+    """Resolve (version, req_schema, resp_schema): explicit versioned
+    entry if present, else the default single-version schema."""
+    ver, req_schema, resp_schema = APIS[api]
+    if version is not None and version != ver:
+        ovr = VERSIONED.get((api, version))
+        if ovr is not None:
+            return version, ovr[0], ovr[1]
+        return version, req_schema, resp_schema
+    return ver, req_schema, resp_schema
+
+
 def build_request(api: ApiKey, corrid: int, client_id: str | None,
                   body: dict, version: int | None = None) -> bytes:
     """Frame a request: 4-byte size + header + body (rd_kafka_buf pattern)."""
     from ..utils.buf import SegBuf
-    ver, req_schema, _ = APIS[api]
+    ver, req_schema, _ = schemas_for(api, version)
     buf = SegBuf()
     szpos = buf.write_i32(0)
     REQUEST_HEADER.write(buf, {"api_key": int(api),
-                               "api_version": version if version is not None else ver,
+                               "api_version": ver,
                                "correlation_id": corrid,
                                "client_id": client_id})
     req_schema.write(buf, body)
@@ -312,9 +555,10 @@ def build_request(api: ApiKey, corrid: int, client_id: str | None,
     return buf.as_bytes()
 
 
-def build_response(api: ApiKey, corrid: int, body: dict) -> bytes:
+def build_response(api: ApiKey, corrid: int, body: dict,
+                   version: int | None = None) -> bytes:
     from ..utils.buf import SegBuf
-    _, _, resp_schema = APIS[api]
+    _, _, resp_schema = schemas_for(api, version)
     buf = SegBuf()
     szpos = buf.write_i32(0)
     buf.write_i32(corrid)
@@ -329,14 +573,15 @@ def parse_request(payload: bytes) -> tuple[dict, dict]:
     sl = Slice(payload)
     hdr = REQUEST_HEADER.read(sl)
     api = ApiKey(hdr["api_key"])
-    _, req_schema, _ = APIS[api]
+    _, req_schema, _ = schemas_for(api, hdr["api_version"])
     return hdr, req_schema.read(sl)
 
 
-def parse_response(api: ApiKey, payload: bytes) -> tuple[int, dict]:
+def parse_response(api: ApiKey, payload: bytes,
+                   version: int | None = None) -> tuple[int, dict]:
     """Parse an unframed response. Returns (correlation_id, body)."""
     from ..utils.buf import Slice
     sl = Slice(payload)
     corrid = sl.read_i32()
-    _, _, resp_schema = APIS[api]
+    _, _, resp_schema = schemas_for(api, version)
     return corrid, resp_schema.read(sl)

@@ -65,12 +65,15 @@ SAMPLES = {
                               "host": "h", "port": 1234}),
     ApiKey.JoinGroup: ({"group_id": "g", "session_timeout": 10000,
                         "rebalance_timeout": 30000, "member_id": "",
+                        "group_instance_id": "node-7",
                         "protocol_type": "consumer",
                         "protocols": [{"name": "range", "metadata": b"md"}]},
                        {"throttle_time_ms": 0, "error_code": 0,
                         "generation_id": 1, "protocol": "range",
                         "leader_id": "m1", "member_id": "m1",
-                        "members": [{"member_id": "m1", "metadata": b"md"}]}),
+                        "members": [{"member_id": "m1",
+                                     "group_instance_id": None,
+                                     "metadata": b"md"}]}),
     ApiKey.SyncGroup: ({"group_id": "g", "generation_id": 1,
                         "member_id": "m1",
                         "assignments": [{"member_id": "m1",

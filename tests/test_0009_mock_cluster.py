@@ -219,6 +219,7 @@ def test_group_join_sync_single_member(cluster):
         j = c.call(ApiKey.JoinGroup, {
             "group_id": "g1", "session_timeout": 10000,
             "rebalance_timeout": 3000, "member_id": "",
+            "group_instance_id": None,
             "protocol_type": "consumer",
             "protocols": [{"name": "range", "metadata": b"MD"}]})
         assert j["error_code"] == 0

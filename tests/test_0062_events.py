@@ -1,0 +1,71 @@
+"""Event API + background queue tests (reference: rdkafka_event.c typed
+events + 0062-stats_event.c; background thread rdkafka_background.c:109):
+queue_poll returns typed events as an alternative to callback dispatch,
+and background_event_cb serves DR/STATS/ERROR events from a dedicated
+thread with no app polling at all."""
+import json
+import time
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.client.event import (EVENT_DR, EVENT_ERROR, EVENT_LOG,
+                                         EVENT_STATS)
+
+
+def test_queue_poll_typed_dr_events():
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "linger.ms": 2, "enabled_events": "dr"})
+    for i in range(5):
+        p.produce("ev", value=b"e%d" % i, partition=0)
+    # drain DR events via queue_poll instead of poll()+callback
+    got = []
+    deadline = time.monotonic() + 10
+    while len(got) < 5 and time.monotonic() < deadline:
+        ev = p.rk.queue_poll(0.2)
+        if ev is None:
+            continue
+        if ev.type == EVENT_DR:
+            got.extend(ev.messages())
+    assert len(got) == 5
+    assert all(m.error is None for m in got)
+    assert sorted(m.value for m in got) == [b"e%d" % i for i in range(5)]
+    p.close()
+
+
+def test_background_event_thread_serves_without_polling():
+    events = []
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "linger.ms": 2, "statistics.interval.ms": 150,
+                  "background_event_cb": lambda ev: events.append(ev)})
+    for i in range(10):
+        p.produce("bg", value=b"b%d" % i, partition=0)
+    # NO poll() calls at all: the background thread must deliver
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        drs = [m for e in events if e.type == EVENT_DR for m in e.messages()]
+        stats = [e for e in events if e.type == EVENT_STATS]
+        if len(drs) >= 10 and stats:
+            break
+        time.sleep(0.05)
+    p.close()
+    drs = [m for e in events if e.type == EVENT_DR for m in e.messages()]
+    stats = [e for e in events if e.type == EVENT_STATS]
+    assert len(drs) == 10, f"background DRs: {len(drs)}"
+    assert stats and json.loads(stats[0].stats())["type"] == "producer"
+
+
+def test_error_event_type():
+    events = []
+    p = Producer({"bootstrap.servers": "127.0.0.1:1",  # nothing listening
+                  "message.timeout.ms": 1200,
+                  "background_event_cb": lambda ev: events.append(ev)})
+    p.produce("never", value=b"x", partition=0)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if any(e.type == EVENT_DR for e in events):
+            break
+        time.sleep(0.05)
+    p.close()
+    dr = [m for e in events if e.type == EVENT_DR for m in e.messages()]
+    assert dr and dr[0].error is not None

@@ -1,0 +1,90 @@
+"""Broker version fallback / feature negotiation tests (reference:
+rdkafka_feature.c — feature bitmask from ApiVersion ranges, legacy
+version map via broker.version.fallback; MsgVersion selection
+rdkafka_msgset_writer.c:100): the client must interoperate with brokers
+that predate ApiVersions (which close the connection on unknown
+requests), selecting magic 0/1 messagesets and old request versions."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.feature import (
+    MSGVER1, MSGVER2, fallback_api_versions, features_from_api_versions)
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.protocol import proto
+from librdkafka_tpu.protocol.proto import ApiKey
+
+
+def test_feature_map():
+    av_new = fallback_api_versions("2.0.0")
+    f = features_from_api_versions(av_new)
+    assert MSGVER2 in f and MSGVER1 in f and "IDEMPOTENT_PRODUCER" in f
+
+    av_010 = fallback_api_versions("0.10.0")
+    f = features_from_api_versions(av_010)
+    assert MSGVER1 in f and MSGVER2 not in f
+
+    av_09 = fallback_api_versions("0.9.0")
+    f = features_from_api_versions(av_09)
+    assert MSGVER1 not in f and MSGVER2 not in f
+    assert "BROKER_BALANCED_CONSUMER" in f
+    assert "THROTTLETIME" in f
+
+    av_08 = fallback_api_versions("0.8.2")
+    f = features_from_api_versions(av_08)
+    assert "BROKER_BALANCED_CONSUMER" not in f
+
+
+@pytest.mark.parametrize("bver,magic", [("0.9.0", 0), ("0.10.0", 1)])
+def test_produce_consume_legacy_broker(bver, magic):
+    """Against a pre-0.11 mock: ApiVersions closes the connection for
+    <0.10 (the client must reconnect without it and apply the fallback),
+    produce uses magic-0/1 messagesets, and the consumer reads them
+    back — including a compressed wrapper round trip."""
+    cluster = MockCluster(num_brokers=1, topics={"old": 1},
+                          broker_version=bver)
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "broker.version.fallback": bver,
+                      "linger.ms": 5, "compression.codec": "gzip"})
+        for i in range(40):
+            p.produce("old", value=b"legacy-%02d" % i, key=b"k%d" % i,
+                      partition=0)
+        assert p.flush(15.0) == 0
+
+        # wire check: stored blobs are v0/v1 messagesets, not v2 batches
+        blobs = [blob for _base, blob in cluster.partition("old", 0).log]
+        assert blobs
+        for blob in blobs:
+            assert blob[proto.V2_OF_Magic] == magic   # same byte position
+        p.close()
+
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "broker.version.fallback": bver,
+                      "group.id": "gleg", "auto.offset.reset": "earliest"})
+        c.subscribe(["old"])
+        got = []
+        deadline = time.monotonic() + 25
+        while len(got) < 40 and time.monotonic() < deadline:
+            m = c.poll(0.3)
+            if m is not None and m.error is None:
+                got.append((m.key, m.value))
+        c.close()
+        assert sorted(got) == sorted(
+            (b"k%d" % i, b"legacy-%02d" % i) for i in range(40))
+    finally:
+        cluster.stop()
+
+
+def test_modern_broker_still_uses_v2():
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "linger.ms": 2})
+    p.produce("new", value=b"modern", partition=0)
+    assert p.flush(10.0) == 0
+    cluster = p._rk.mock_cluster
+    blob = cluster.partition("new", 0).log[0][1]
+    assert blob[proto.V2_OF_Magic] == 2
+    b = next(iter(p._rk.brokers.values()))
+    assert MSGVER2 in b.features
+    p.close()

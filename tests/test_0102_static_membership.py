@@ -1,0 +1,55 @@
+"""Static group membership tests (KIP-345, group.instance.id; reference
+conf rdkafka_conf.c group.instance.id + JoinGroup v5): a static member
+keeps its member_id across restarts so rejoining does not create a new
+member or force a full rebalance storm."""
+import time
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+def _consume_n(c, n, timeout=20):
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m.value)
+    return got
+
+
+def test_static_member_keeps_member_id_across_restart():
+    cluster = MockCluster(num_brokers=1, topics={"sm": 2})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "linger.ms": 2})
+        for i in range(10):
+            p.produce("sm", value=b"s%d" % i, partition=i % 2)
+        assert p.flush(10.0) == 0
+        p.close()
+
+        conf = {"bootstrap.servers": cluster.bootstrap_servers(),
+                "group.id": "gstat", "group.instance.id": "node-1",
+                "auto.offset.reset": "earliest",
+                "session.timeout.ms": 30000}
+        c1 = Consumer(dict(conf))
+        c1.subscribe(["sm"])
+        assert len(_consume_n(c1, 10)) == 10
+        mid1 = c1._rk.cgrp.member_id
+        assert "static-node-1" in mid1
+        c1.close()
+
+        # restart: same instance id → same member_id slot, one member
+        c2 = Consumer(dict(conf))
+        c2.subscribe(["sm"])
+        deadline = time.monotonic() + 15
+        while c2._rk.cgrp.join_state != "steady" and \
+                time.monotonic() < deadline:
+            c2.poll(0.2)
+        mid2 = c2._rk.cgrp.member_id
+        assert mid2 == mid1, (mid1, mid2)
+        g = cluster.groups["gstat"]
+        assert len(g.members) == 1
+        c2.close()
+    finally:
+        cluster.stop()
