@@ -102,6 +102,7 @@ class Broker:
         self.api_versions: dict[int, int] = {}
         self.features: set[str] = set()
         self._apiversion_failed = False   # broker closed on ApiVersions
+        self._fallback_until = 0.0        # api.version.fallback.ms window
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
         self._next_connect = 0.0
         self.terminate = False
@@ -290,7 +291,8 @@ class Broker:
         # reference retries the connect WITHOUT ApiVersions and applies
         # broker.version.fallback (rdkafka_feature.c legacy versions)
         if (self.rk.conf.get("api.version.request")
-                and not self._apiversion_failed):
+                and not self._apiversion_failed
+                and time.monotonic() >= self._fallback_until):
             self._xmit(Request(ApiKey.ApiVersions, {},
                                cb=self._handle_apiversions))
         else:
@@ -301,8 +303,12 @@ class Broker:
         fb = self.rk.conf.get("broker.version.fallback")
         self.api_versions = fallback_api_versions(fb)
         self.features = features_from_api_versions(self.api_versions)
-        # one-shot: the NEXT reconnect probes ApiVersions again, so a
-        # transient blip can't pin a modern broker to legacy mode
+        # one-shot: the NEXT reconnect (after api.version.fallback.ms)
+        # probes ApiVersions again, so a transient blip can't pin a
+        # modern broker to legacy mode forever
+        if self._apiversion_failed:
+            self._fallback_until = time.monotonic() + \
+                self.rk.conf.get("api.version.fallback.ms") / 1000.0
         self._apiversion_failed = False
         self.rk.dbg("feature",
                     f"{self.name}: assuming broker {fb}: "
@@ -357,7 +363,13 @@ class Broker:
             self.rk.conf.get("reconnect.backoff.max.ms") / 1000.0)
         self.rk.broker_down(self, KafkaError(Err._TRANSPORT, reason))
 
-    def _disconnect(self, err: KafkaError):
+    def _disconnect(self, err: KafkaError, quiet: bool = False):
+        if quiet:
+            # log.connection.close=false: idle disconnects are expected
+            # (broker idle reaper); reconnect with a debug line only
+            self.rk.dbg("broker", f"{self.name}: {err.reason} (quiet)")
+        elif self.sock is not None and not self.terminate:
+            self.rk.log("INFO", f"{self.name}: disconnected: {err.reason}")
         if self.sock:
             try:
                 self.sock.close()
@@ -478,8 +490,10 @@ class Broker:
                                             f"recv failed: {e}"))
                 return
             if not data:
-                self._disconnect(KafkaError(Err._TRANSPORT,
-                                            "connection closed by peer"))
+                quiet = not self.rk.conf.get("log.connection.close")
+                self._disconnect(KafkaError(
+                    Err._TRANSPORT, "connection closed by peer",
+                    retriable=True), quiet=quiet)
                 return
             self._rbuf += data
             got += len(data)
@@ -781,6 +795,20 @@ class Broker:
             with tp.lock:
                 tp.inflight_msgids.discard(msgs[0].msgid)
 
+    def _gapless_fatal(self, tp, kerr: KafkaError) -> Optional[KafkaError]:
+        """enable.gapless.guarantee: any permanently failed message in an
+        idempotent stream leaves a sequence gap — escalate to a fatal
+        error (reference: RD_KAFKA_RESP_ERR__GAPLESS_GUARANTEE)."""
+        rk = self.rk
+        if rk.idemp is None or not rk.conf.get("enable.gapless.guarantee"):
+            return None
+        fatal = KafkaError(
+            Err._GAPLESS_GUARANTEE,
+            f"{tp}: message failed ({kerr.code.name}) and "
+            "enable.gapless.guarantee is set")
+        rk.set_fatal_error(fatal)
+        return fatal
+
     def _handle_produce0(self, tp, msgs: list[Message], err, resp):
         rk = self.rk
         if err is None:
@@ -853,10 +881,11 @@ class Broker:
                     tp.retry_backoff_until = time.monotonic() + \
                         rk.conf.get("retry.backoff.ms") / 1000.0
                 else:
-                    rk.dr_msgq(msgs, kerr)
+                    rk.dr_msgq(msgs, self._gapless_fatal(tp, kerr) or kerr)
                 return
             retry = [m for m in msgs if m.retries < max_retries]
             fail = [m for m in msgs if m.retries >= max_retries]
+            # (non-idempotent path continues below)
             for m in retry:
                 m.retries += 1
             if retry:
@@ -864,9 +893,9 @@ class Broker:
                 tp.retry_backoff_until = time.monotonic() + \
                     rk.conf.get("retry.backoff.ms") / 1000.0
             if fail:
-                rk.dr_msgq(fail, kerr)
+                rk.dr_msgq(fail, self._gapless_fatal(tp, kerr) or kerr)
         else:
-            rk.dr_msgq(msgs, kerr)
+            rk.dr_msgq(msgs, self._gapless_fatal(tp, kerr) or kerr)
 
     # =================================================== CONSUMER SERVE ===
     def _consumer_serve(self, now: float):
@@ -888,6 +917,9 @@ class Broker:
             if now < tp.fetch_backoff_until:
                 continue
             if tp.fetchq_cnt >= rk.conf.get("queued.min.messages"):
+                continue
+            if tp.fetchq_bytes >= rk.conf.get(
+                    "queued.max.messages.kbytes") * 1024:
                 continue
             if tp.fetch_offset < 0:
                 continue

@@ -95,7 +95,8 @@ class ConsumerGroup:
         if not self.patterns:
             return
         matched = {t for t in topic_names
-                   if any(p.search(t) for p in self.patterns)}
+                   if not self.rk.blacklisted(t)
+                   and any(p.search(t) for p in self.patterns)}
         if matched == self._matched:
             return
         added = matched - self._matched

@@ -97,7 +97,6 @@ PROPERTIES: list[Prop] = [
        vmin=0, vmax=3600000),
     _p("statistics.interval.ms", GLOBAL, "int", 0,
        "Statistics emit interval; 0 disables.", vmin=0, vmax=86400000),
-    _p("enabled_events", GLOBAL, "int", 0, "Event type enable mask.", vmin=0, vmax=2147483647),
     _p("log_level", GLOBAL, "int", 6, "Max syslog level.", vmin=0, vmax=7),
     _p("log.queue", GLOBAL, "bool", False, "Forward logs to queue instead of stderr."),
     _p("log.thread.name", GLOBAL, "bool", True, "Print thread name in logs."),
@@ -216,8 +215,6 @@ PROPERTIES: list[Prop] = [
     _p("tpu.launch.min.batches", GLOBAL, "int", 4,
        "Min partition batches to coalesce into one TPU launch (launch quorum); "
        "fewer than this falls back to the CPU provider.", vmin=1, vmax=4096),
-    _p("tpu.launch.max.wait.ms", GLOBAL, "float", 1.0,
-       "Extra linger waiting for the TPU launch quorum.", vmin=0, vmax=1000),
     _p("tpu.mesh.devices", GLOBAL, "int", 0,
        "Number of devices to shard codec launches over (0 = all local).",
        vmin=0, vmax=8192),
@@ -274,8 +271,6 @@ PROPERTIES: list[Prop] = [
        "Path to local offset file store (legacy).", app=C),
     _p("offset.store.sync.interval.ms", TOPIC, "int", -1,
        "fsync interval for file store.", app=C, vmin=-1, vmax=86400000),
-    _p("consume.callback.max.messages", TOPIC, "int", 0,
-       "Max messages per consume callback dispatch.", app=C, vmin=0, vmax=1000000),
 ]
 
 _BY_NAME: dict[str, Prop] = {}

@@ -118,6 +118,8 @@ class Consumer:
                 tp.fetch_state = FetchState.STOPPED
                 tp.version += 1
                 tp.fetchq.forward_to(None)
+                tp.fetchq_cnt = 0
+                tp.fetchq_bytes = 0
         if rk.cgrp:
             rk.cgrp.assignment = assignment
         if not new_keys:
@@ -204,8 +206,13 @@ class Consumer:
             tp, msg, version = op.payload
             if tp.version != version or (tp.topic, tp.partition) not in \
                     self._assignment and rk.cgrp is not None:
-                return None   # stale: partition seeked/revoked since fetch
+                # stale: partition seeked/revoked since fetch — release
+                # the queue accounting this op still holds
+                tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
+                tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
+                return None
             tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
+            tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
             tp.app_offset = msg.offset + 1
             if rk.conf.get("enable.auto.offset.store"):
                 tp.stored_offset = msg.offset + 1
@@ -310,6 +317,7 @@ class Consumer:
         tp.version += 1
         tp.fetchq.pop_all()
         tp.fetchq_cnt = 0
+        tp.fetchq_bytes = 0
         if partition.offset in (proto.OFFSET_BEGINNING, proto.OFFSET_END):
             tp.fetch_offset = partition.offset
             tp.fetch_state = FetchState.OFFSET_QUERY

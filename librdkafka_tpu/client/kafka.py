@@ -137,12 +137,15 @@ class Kafka:
         self._metadata_inflight = False
         self._metadata_refresh_queued = False
         self._fast_refresh_scheduled = False
+        self._addr_cache: dict = {}        # broker.address.ttl DNS cache
         self.flushing = False
         self.terminating = False
         self.fatal_error: Optional[KafkaError] = None
         self.msg_cnt = 0                       # queue.buffering.max.messages
+        self.msg_bytes = 0                     # queue.buffering.max.kbytes
         self._msg_cnt_lock = threading.Lock()
         self._max_msgs = conf.get("queue.buffering.max.messages")
+        self._max_msg_bytes = conf.get("queue.buffering.max.kbytes") * 1024
         self.cgrp = None                       # set by Consumer
         self.consumer = None                   # back-ref set by Consumer
         self.interceptors = conf.get("interceptors") or None
@@ -150,13 +153,20 @@ class Kafka:
         self.stats = None                      # StatsCollector, set below
         self.debug_contexts = set(conf.get("debug"))
         self.log_cb = conf.get("log_cb")
+        # topic.blacklist (reference rdkafka_pattern.c blacklist list):
+        # matching topics are invisible to metadata/subscriptions
+        import re as _re
+        self._blacklist = [_re.compile(pat if pat.startswith("^") else
+                                       "^" + _re.escape(pat) + "$")
+                           for pat in conf.get("topic.blacklist")]
 
         # codec provider selection (compression.backend; SURVEY.md §7 st.5)
         backend = conf.get("compression.backend")
         if backend == "tpu":
             from ..ops.tpu import TpuCodecProvider
             self.codec_provider = TpuCodecProvider(
-                min_batches=conf.get("tpu.launch.min.batches"))
+                min_batches=conf.get("tpu.launch.min.batches"),
+                mesh_devices=conf.get("tpu.mesh.devices"))
         else:
             from ..ops.cpu import CpuCodecProvider
             self.codec_provider = CpuCodecProvider()
@@ -274,6 +284,9 @@ class Kafka:
             op.cb(op)
 
     # ----------------------------------------------------------- metadata --
+    def blacklisted(self, topic: str) -> bool:
+        return any(p.search(topic) for p in self._blacklist)
+
     def any_up_broker(self) -> Optional[Broker]:
         with self._brokers_lock:
             ups = [b for b in self.brokers.values() if b.is_up()]
@@ -323,6 +336,8 @@ class Kafka:
             self.metadata["controller_id"] = resp.get("controller_id", -1)
             seen = set()
             for t in resp["topics"]:
+                if self.blacklisted(t["topic"]):
+                    continue
                 terr = Err.from_wire(t["error_code"])
                 if terr == Err.UNKNOWN_TOPIC_OR_PART:
                     # topic deleted: drop it from the cache
@@ -480,11 +495,14 @@ class Kafka:
             key = key.encode()
         if self.fatal_error:
             raise KafkaException(self.fatal_error)
+        sz = (len(value) if value else 0) + (len(key) if key else 0)
         with self._msg_cnt_lock:
-            if self.msg_cnt >= self._max_msgs:
+            if (self.msg_cnt >= self._max_msgs
+                    or self.msg_bytes + sz > self._max_msg_bytes):
                 raise KafkaException(Err._QUEUE_FULL,
                                      "producer queue is full")
             self.msg_cnt += 1
+            self.msg_bytes += sz
         m = Message(topic, value=value, key=key, partition=partition,
                     headers=headers, timestamp=timestamp, opaque=opaque)
         if self.interceptors:
@@ -507,6 +525,7 @@ class Kafka:
                 # (reference: rd_kafka_msg_partitioner → UNKNOWN_PARTITION)
                 with self._msg_cnt_lock:
                     self.msg_cnt -= 1
+                    self.msg_bytes -= sz
                 raise KafkaException(
                     Err._UNKNOWN_PARTITION,
                     f"{topic}[{partition}]: partition does not exist")
@@ -540,6 +559,7 @@ class Kafka:
         rdkafka_broker.c:2432)."""
         with self._msg_cnt_lock:
             self.msg_cnt -= len(msgs)
+            self.msg_bytes -= sum(m.size for m in msgs)
         if err is not None:
             for m in msgs:
                 m.error = err
@@ -691,9 +711,16 @@ class Kafka:
                 if any(m.status == MsgStatus.POSSIBLY_PERSISTED
                        for m in expired):
                     any_possibly_persisted = True
-                self.dr_msgq(expired,
-                             KafkaError(Err._MSG_TIMED_OUT,
-                                        "message timed out"))
+                terr = KafkaError(Err._MSG_TIMED_OUT, "message timed out")
+                if self.idemp and self.conf.get("enable.gapless.guarantee"):
+                    # a timed-out message leaves a sequence gap: fatal
+                    # under gapless (reference _GAPLESS_GUARANTEE)
+                    terr = KafkaError(
+                        Err._GAPLESS_GUARANTEE,
+                        f"{tp}: message timed out with "
+                        "enable.gapless.guarantee set")
+                    self.set_fatal_error(terr)
+                self.dr_msgq(expired, terr)
         if any_possibly_persisted and self.idemp:
             # timing out possibly-persisted messages leaves a sequence gap
             # the broker will reject; recover via drain + epoch bump
@@ -845,6 +872,7 @@ class Kafka:
                 self.interceptors.on_consume(m)
             tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, ver)))
         tp.fetchq_cnt += len(msgs)
+        tp.fetchq_bytes += sum(m.size for m in msgs)
         if self.stats:
             self.stats.c_rx_msgs += len(msgs)
 
@@ -907,8 +935,7 @@ class Kafka:
             fam_conf, socket.AF_UNSPEC)
         sock_cb = self.conf.get("socket_cb")
         last_err = None
-        for af, stype, sproto, _, addr in socket.getaddrinfo(
-                host, port, family, socket.SOCK_STREAM):
+        for af, stype, sproto, _, addr in self._resolve(host, port, family):
             try:
                 s = (sock_cb(af, stype, sproto) if sock_cb is not None
                      else socket.socket(af, stype, sproto))
@@ -934,6 +961,20 @@ class Kafka:
                 except OSError:
                     pass
         raise last_err or OSError(f"cannot resolve {host}:{port}")
+
+    def _resolve(self, host: str, port: int, family) -> list:
+        """getaddrinfo with a broker.address.ttl cache (reference:
+        rdaddr.c rd_sockaddr_list caching + rotation)."""
+        ttl = self.conf.get("broker.address.ttl") / 1000.0
+        key = (host, port, family)
+        now = time.monotonic()
+        hit = self._addr_cache.get(key)
+        if hit is not None and now < hit[0]:
+            return hit[1]
+        infos = socket.getaddrinfo(host, port, family, socket.SOCK_STREAM)
+        if ttl > 0:
+            self._addr_cache[key] = (now + ttl, infos)
+        return infos
 
     # ---------------------------------------------------------------- SASL --
     def sasl_required(self) -> bool:

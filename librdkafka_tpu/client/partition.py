@@ -61,8 +61,8 @@ class Toppar:
         self.ls_offset: int = proto.OFFSET_INVALID      # last stable
         self.paused = False
         self.fetch_backoff_until = 0.0
-        self.fetchq_cnt = 0              # msgs sitting in fetchq (queued.min)
-        self.fetchq_bytes = 0
+        self.fetchq_cnt = 0        # msgs sitting in fetchq (queued.min)
+        self.fetchq_bytes = 0      # queued.max.messages.kbytes accounting
         self.eof_reported_at = proto.OFFSET_INVALID
         self.aborted_txns: dict[int, list[int]] = {}  # pid -> abort offsets
         self.version = 1                 # barrier for stale fetch ops

@@ -45,10 +45,15 @@ class TpuCodecProvider:
 
     name = "tpu"
 
-    def __init__(self, min_batches: int = 4, warmup: bool = True):
+    def __init__(self, min_batches: int = 4, warmup: bool = True,
+                 mesh_devices: int = 0):
         # below this many independent buffers a launch isn't worth it;
         # fall back to the CPU provider (identical bytes either way).
         self.min_batches = max(1, int(min_batches))
+        # tpu.mesh.devices: >1 shards block compression over a 1-D
+        # jax.sharding.Mesh (parallel/mesh.py shard_map scale-out)
+        self.mesh_devices = int(mesh_devices or 0)
+        self._mesh = None
         self._cpu = _cpu.CpuCodecProvider()
         if warmup:
             # compile the fixed-shape kernels off the critical path (the
@@ -84,7 +89,12 @@ class TpuCodecProvider:
                 blocks.append(b[pos:pos + LZ4F_BLOCKSIZE])
             spans.append((first, len(blocks) - first))
 
-        cblocks = lz4_block_compress_many(blocks)
+        mesh = self._get_mesh()
+        if mesh is not None:
+            from ..parallel.mesh import shard_compress
+            cblocks, _, _ = shard_compress(mesh, blocks, with_crc=False)
+        else:
+            cblocks = lz4_block_compress_many(blocks)
 
         out = []
         hdr = struct.pack("<IBBB", LZ4F_MAGIC, 0x60, 0x40, _frame_hc())
@@ -102,6 +112,15 @@ class TpuCodecProvider:
             parts.append(b"\x00\x00\x00\x00")  # EndMark
             out.append(b"".join(parts))
         return out
+
+    def _get_mesh(self):
+        if self._mesh is None and self.mesh_devices > 1:
+            import jax
+            from ..parallel.mesh import make_mesh
+            n = min(self.mesh_devices, len(jax.devices()))
+            if n > 1:
+                self._mesh = make_mesh(n)
+        return self._mesh
 
     # -------------------------------------------------------- interface --
 

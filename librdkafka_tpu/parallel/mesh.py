@@ -30,16 +30,19 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 _STEP_CACHE: dict = {}
 
 
-def sharded_codec_step(mesh: Mesh, N: int):
+def sharded_codec_step(mesh: Mesh, N: int, with_crc: bool = True):
     """Build the jitted multi-chip codec step for (B, N) blocks.
 
     Returns fn(data (B,N) uint8 right-padded, lens (B,) int32,
     valid (B,) int32 row mask) →
       (lz4 bytes (B,C) uint8, lz4 lens (B,), crc32c (B,) uint32,
        total_out_bytes scalar — psum of valid rows across the mesh).
-    B must be a multiple of the mesh size.
+    B must be a multiple of the mesh size. ``with_crc=False`` builds a
+    compress-only step (no CRC matmul, no psum) for callers that
+    checksum elsewhere — e.g. the codec provider, whose batch CRC
+    covers the assembled record batch, not raw blocks.
     """
-    key = (tuple(d.id for d in mesh.devices.flat), N)
+    key = (tuple(d.id for d in mesh.devices.flat), N, with_crc)
     cached = _STEP_CACHE.get(key)
     if cached is not None:
         return cached
@@ -48,6 +51,8 @@ def sharded_codec_step(mesh: Mesh, N: int):
 
     def local(data, lens, valid):
         out, olen = jax.vmap(lambda d, n: _lz4_block_one(d, n, N))(data, lens)
+        if not with_crc:
+            return out, olen
         # the crc kernel needs LEFT-padded rows (leading zeros are a no-op
         # under a zero register); shift each right-padded row into place
         j = jnp.arange(N, dtype=jnp.int32)[None, :]
@@ -60,18 +65,22 @@ def sharded_codec_step(mesh: Mesh, N: int):
         total = jax.lax.psum(jnp.sum(olen * valid), "batch")
         return out, olen, crc, total
 
+    out_specs = ((P("batch", None), P("batch"), P("batch"), P())
+                 if with_crc else (P("batch", None), P("batch")))
     shard = jax.shard_map(
         local, mesh=mesh,
         in_specs=(P("batch", None), P("batch"), P("batch")),
-        out_specs=(P("batch", None), P("batch"), P("batch"), P()),
+        out_specs=out_specs,
         check_vma=False)
     fn = jax.jit(shard)
     _STEP_CACHE[key] = fn
     return fn
 
 
-def shard_compress(mesh: Mesh, blocks: list[bytes]):
-    """Compress blocks across the mesh (pads B up to a mesh multiple)."""
+def shard_compress(mesh: Mesh, blocks: list[bytes], with_crc: bool = True):
+    """Compress blocks across the mesh (pads B up to a mesh multiple).
+    Returns (blocks, crcs, total) with crcs=None/total=0 when
+    with_crc=False."""
     from ..ops.packing import next_pow2, pad_right
 
     ndev = mesh.devices.size
@@ -84,12 +93,17 @@ def shard_compress(mesh: Mesh, blocks: list[bytes]):
         data = np.concatenate([data, np.zeros((Bp - B, N), np.uint8)])
         lens = np.concatenate([lens, np.zeros((Bp - B,), np.int32)])
         valid = np.concatenate([valid, np.zeros((Bp - B,), np.int32)])
-    fn = sharded_codec_step(mesh, N)
+    fn = sharded_codec_step(mesh, N, with_crc)
     row = NamedSharding(mesh, P("batch"))
-    out, olen, crc, total = fn(
+    res = fn(
         jax.device_put(data, NamedSharding(mesh, P("batch", None))),
         jax.device_put(lens, row), jax.device_put(valid, row))
+    if with_crc:
+        out, olen, crc, total = res
+    else:
+        out, olen = res
+        crc, total = None, 0
     out = np.asarray(out)
     olen = np.asarray(olen)
     return ([out[i, :olen[i]].tobytes() for i in range(B)],
-            np.asarray(crc)[:B], int(total))
+            None if crc is None else np.asarray(crc)[:B], int(total))
