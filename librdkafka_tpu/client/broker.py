@@ -74,6 +74,80 @@ class Request:
 IDEMP_MAX_INFLIGHT = 5
 
 
+def _run_codec_phase(rk, ready: list, codec: str) -> list:
+    """Compress + assemble + CRC a batch set. Pure compute — safe on the
+    codec worker thread. Returns [(tp, msgs, wire|None, exc|None)]."""
+    provider = rk.codec_provider
+    results = []
+    try:
+        if codec != "none" and ready:
+            blobs = provider.compress_many(
+                codec, [w.records_bytes for _, _, w in ready],
+                rk.topic_conf_for(ready[0][0].topic).get("compression.level"))
+        else:
+            blobs = [None] * len(ready)
+    except Exception as e:
+        return [(tp, msgs, None, e) for tp, msgs, _w in ready]
+
+    assembled = []                # (tp, msgs, writer)
+    regions = []                  # CRC region per batch
+    for (tp, msgs, writer), blob in zip(ready, blobs):
+        try:
+            if blob is not None and len(blob) >= len(writer.records_bytes):
+                blob = None       # incompressible: send plain
+                writer.codec = None
+            regions.append(writer.assemble(blob))
+            assembled.append((tp, msgs, writer))
+        except Exception as e:
+            results.append((tp, msgs, None, e))
+    if assembled:
+        try:
+            crcs = provider.crc32c_many(regions)
+            for (tp, msgs, writer), crc in zip(assembled, crcs):
+                results.append((tp, msgs, writer.patch_crc(int(crc)), None))
+        except Exception as e:
+            for tp, msgs, _w in assembled:
+                results.append((tp, msgs, None, e))
+    return results
+
+
+class CodecWorker(threading.Thread):
+    """The codec pipeline thread (one per producer instance): runs the
+    batched compress+CRC phase off the broker threads so socket IO and
+    batch formation overlap with device/native launches (the
+    double-buffered offload of SURVEY.md §5 axis 2, absent in the
+    reference — its compression runs inline on each broker thread,
+    rdkafka_msgset_writer.c:1129)."""
+
+    def __init__(self, rk):
+        super().__init__(daemon=True, name="rdk:codec")
+        import queue as _q
+        self.rk = rk
+        self.jobs = _q.Queue()
+        self.start()
+
+    def submit(self, broker: "Broker", ready: list, codec: str,
+               ts_codec: float, purge_epoch: int) -> None:
+        self.jobs.put((broker, ready, codec, ts_codec, purge_epoch))
+
+    def stop(self) -> None:
+        self.jobs.put(None)
+
+    def run(self):
+        while True:
+            job = self.jobs.get()
+            if job is None:
+                return
+            broker, ready, codec, ts_codec, pepoch = job
+            try:
+                results = _run_codec_phase(self.rk, ready, codec)
+            except Exception as e:      # belt & braces: fail every batch
+                results = [(tp, msgs, None, e) for tp, msgs, _w in ready]
+            broker.ops.push(Op(OpType.BROKER_WAKEUP,
+                               payload=("codec_done", results, ts_codec,
+                                        pepoch)))
+
+
 class Broker:
     """One broker connection + its serve thread."""
 
@@ -108,6 +182,7 @@ class Broker:
         self.terminate = False
         self.fetch_inflight = False
         self._tls_handshaking = False
+        self._codec_outstanding = 0     # async codec jobs in flight
         self.toppars: set = set()           # toppars led by this broker
         self._lock = threading.Lock()
         self.ts_connected = 0.0
@@ -202,10 +277,26 @@ class Broker:
         """(reference: rd_kafka_broker_op_serve, rdkafka_broker.c:2597)"""
         if op.type == OpType.TERMINATE:
             self.terminate = True
+        elif op.type == OpType.PURGE:
+            # abandon in-flight ProduceRequests (rd_kafka_purge
+            # RD_KAFKA_PURGE_F_INFLIGHT): fail them locally; the late
+            # response hits an unknown corrid and is dropped
+            for corrid, req in list(self.waitresp.items()):
+                if req.api == ApiKey.Produce:
+                    del self.waitresp[corrid]
+                    if req.cb:
+                        req.cb(KafkaError(Err._PURGE_INFLIGHT,
+                                          "purged in flight",
+                                          retriable=False), None)
         elif op.type == OpType.PARTITION_JOIN:
             self.toppars.add(op.payload)
         elif op.type == OpType.PARTITION_LEAVE:
             self.toppars.discard(op.payload)
+        elif (op.type == OpType.BROKER_WAKEUP and op.payload
+                and op.payload[0] == "codec_done"):
+            _, results, ts_codec, pepoch = op.payload
+            self._codec_outstanding -= 1
+            self._codec_results(results, ts_codec, pepoch)
         elif op.type == OpType.BROKER_WAKEUP and op.payload:
             kind, req = op.payload
             if kind == "xmit":
@@ -576,6 +667,11 @@ class Broker:
         codec = rk.conf.get("compression.codec")
         # pre-0.11 broker: magic 0/1 path — skip V2 writer construction
         legacy = bool(self.features) and MSGVER2 not in self.features
+        # codec pipeline backpressure: at most `depth` launches in
+        # flight; messages keep accumulating in xmit_msgq meanwhile
+        if (rk.codec_worker is not None
+                and self._codec_outstanding >= rk.codec_pipeline_depth):
+            return
         ready: list[tuple] = []   # (toppar, msgs, writer|None-when-legacy)
 
         for tp in list(self.toppars):
@@ -666,42 +762,53 @@ class Broker:
         # already accounted in-flight; any failure from here on must
         # release the accounting and error-DR the batch or tp.inflight
         # leaks (flush() would hang, DRAIN never resolves)
-        provider = rk.codec_provider
-        try:
-            if codec != "none" and ready:
-                blobs = provider.compress_many(
-                    codec, [w.records_bytes for _, _, w in ready],
-                    rk.topic_conf_for(ready[0][0].topic).get("compression.level"))
-            else:
-                blobs = [None] * len(ready)
-        except Exception as e:
-            for tp, msgs, _w in ready:
-                self._release_unsent(tp, msgs, e)
+        # With codec.pipeline.depth > 0 this phase runs on the client's
+        # codec worker thread (SURVEY.md §5 parallelism axis 2: pipeline
+        # overlap): the broker thread keeps serving socket IO and forms
+        # the NEXT batch while this launch compresses; results come back
+        # through the broker ops queue (FIFO — per-partition send order,
+        # and with it idempotent sequence order, is preserved)
+        worker = rk.codec_worker
+        if worker is not None:
+            self._codec_outstanding += 1
+            worker.submit(self, ready, codec, ts_codec,
+                          rk._purge_epoch)
             return
+        self._codec_results(_run_codec_phase(rk, ready, codec), ts_codec,
+                            rk._purge_epoch)
 
-        assembled = []                # (tp, msgs, writer) with wire built
-        regions = []                  # CRC region per batch
-        for (tp, msgs, writer), blob in zip(ready, blobs):
-            try:
-                if blob is not None and len(blob) >= len(writer.records_bytes):
-                    blob = None       # incompressible: send plain
-                    writer.codec = None
-                regions.append(writer.assemble(blob))
-                assembled.append((tp, msgs, writer))
-            except Exception as e:
-                self._release_unsent(tp, msgs, e)
-        if not assembled:
-            return
-        try:
-            crcs = provider.crc32c_many(regions)
-        except Exception as e:
-            for tp, msgs, _w in assembled:
-                self._release_unsent(tp, msgs, e)
-            return
-        self.rk.stats.codec_latency.add(
-            (time.monotonic() - ts_codec) * 1e6)
-        for (tp, msgs, writer), crc in zip(assembled, crcs):
-            self._send_produce(tp, msgs, writer.patch_crc(int(crc)), now)
+    def _codec_results(self, results: list, ts_codec: float,
+                       purge_epoch: int):
+        """Phase 3: finalize+send (or fail) each batch from the codec
+        phase. Runs on the broker thread.
+
+        Two invalidation gates: a purge(in_flight=True) issued while the
+        batch was inside the pipeline discards it with _PURGE_INFLIGHT;
+        a broker no longer UP (disconnected mid-launch) requeues the
+        batch as a frozen retry batch so the message-timeout scan and
+        reconnect logic own it — it must NOT be parked in outq where no
+        timeout scan can reach it."""
+        rk = self.rk
+        now = time.monotonic()
+        rk.stats.codec_latency.add((now - ts_codec) * 1e6)
+        purged = purge_epoch != rk._purge_epoch
+        for tp, msgs, wire, exc in results:
+            if purged:
+                tp.inflight -= 1
+                with tp.lock:
+                    tp.inflight_msgids.discard(msgs[0].msgid)
+                rk.dr_msgq(msgs, KafkaError(Err._PURGE_INFLIGHT,
+                                            "purged in flight",
+                                            retriable=False))
+            elif exc is not None:
+                self._release_unsent(tp, msgs, exc)
+            elif self.state != BrokerState.UP or self.terminate:
+                tp.inflight -= 1
+                with tp.lock:
+                    tp.inflight_msgids.discard(msgs[0].msgid)
+                tp.enqueue_retry_batch(msgs)
+            else:
+                self._send_produce(tp, msgs, wire, now)
 
     def _release_unsent(self, tp, msgs: list[Message], exc: Exception):
         tp.inflight -= 1
@@ -802,6 +909,8 @@ class Broker:
         rk = self.rk
         if rk.idemp is None or not rk.conf.get("enable.gapless.guarantee"):
             return None
+        if kerr.code in (Err._PURGE_QUEUE, Err._PURGE_INFLIGHT):
+            return None          # app-initiated purge is not a gap
         fatal = KafkaError(
             Err._GAPLESS_GUARANTEE,
             f"{tp}: message failed ({kerr.code.name}) and "

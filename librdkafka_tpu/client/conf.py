@@ -215,6 +215,10 @@ PROPERTIES: list[Prop] = [
     _p("tpu.launch.min.batches", GLOBAL, "int", 4,
        "Min partition batches to coalesce into one TPU launch (launch quorum); "
        "fewer than this falls back to the CPU provider.", vmin=1, vmax=4096),
+    _p("codec.pipeline.depth", GLOBAL, "int", 2,
+       "Max codec launches in flight per broker; 0 = compress inline on "
+       "the broker thread (pipeline overlap of batch build vs codec).",
+       vmin=0, vmax=64, app=P),
     _p("tpu.mesh.devices", GLOBAL, "int", 0,
        "Number of devices to shard codec launches over (0 = all local).",
        vmin=0, vmax=8192),

@@ -138,6 +138,8 @@ class Kafka:
         self._metadata_refresh_queued = False
         self._fast_refresh_scheduled = False
         self._addr_cache: dict = {}        # broker.address.ttl DNS cache
+        self._purge_epoch = 0              # invalidates in-pipeline batches
+        self._metadata_topic_ts: dict = {}  # topic -> last metadata time
         self.flushing = False
         self.terminating = False
         self.fatal_error: Optional[KafkaError] = None
@@ -174,6 +176,14 @@ class Kafka:
         self.idemp = (IdempotenceManager(self)
                       if self.is_producer and conf.get("enable.idempotence")
                       else None)
+
+        # codec pipeline thread (codec.pipeline.depth; SURVEY.md §5
+        # axis 2 — overlap batch build/socket IO with codec launches)
+        self.codec_pipeline_depth = conf.get("codec.pipeline.depth")
+        self.codec_worker = None
+        if self.is_producer and self.codec_pipeline_depth > 0:
+            from .broker import CodecWorker
+            self.codec_worker = CodecWorker(self)
 
         # TLS context — one per instance, shared by all broker threads
         # (reference: rd_kafka_ssl_ctx_init, rdkafka_ssl.c)
@@ -315,6 +325,16 @@ class Kafka:
         if self.cgrp is not None and self.cgrp.patterns:
             # regex subscriptions need the full cluster topic list
             names = None
+        # metadata.max.age.ms: drop cache entries past their age so
+        # stale leaders can't be used after long disconnects (reference
+        # rdkafka_metadata_cache.c:289 expiry)
+        max_age = self.conf.get("metadata.max.age.ms") / 1000.0
+        now = time.monotonic()
+        with self._metadata_lock:
+            for name, ts in list(self._metadata_topic_ts.items()):
+                if now - ts > max_age:
+                    self.metadata["topics"].pop(name, None)
+                    del self._metadata_topic_ts[name]
         self.dbg("metadata", f"refresh ({reason}) via {b.name}")
         full = not names        # None or [] → broker enumerates all topics
         b.enqueue_request(Request(
@@ -352,6 +372,7 @@ class Kafka:
                 seen.add(t["topic"])
                 self.metadata["topics"][t["topic"]] = {
                     p["partition"]: p["leader"] for p in t["partitions"]}
+                self._metadata_topic_ts[t["topic"]] = time.monotonic()
             if full:
                 # a full metadata response enumerates every topic: prune
                 # cache entries that vanished (deleted topics)
@@ -646,7 +667,12 @@ class Kafka:
             self.flushing = False
 
     def purge(self, in_queue: bool = True, in_flight: bool = False) -> None:
-        """Purge queued messages with DR _PURGE_QUEUE errors."""
+        """Purge messages (reference: rd_kafka_purge):
+        ``in_queue`` — every queued message (msgq, xmit_msgq, frozen
+        retry batches, UA parking) gets a _PURGE_QUEUE DR;
+        ``in_flight`` — outstanding ProduceRequests are abandoned on the
+        broker threads and their messages get _PURGE_INFLIGHT DRs (any
+        late broker response is dropped by the corrid filter)."""
         purged = []
         with self._toppars_lock:
             tps = list(self._toppars.values())
@@ -656,6 +682,11 @@ class Kafka:
                     purged.extend(tp.msgq)
                     tp.msgq.clear()
                     tp.msgq_bytes = 0
+                    purged.extend(tp.xmit_msgq)
+                    tp.xmit_msgq.clear()
+                    for batch in tp.retry_batches:
+                        purged.extend(batch)
+                    tp.retry_batches.clear()
         with self._topics_lock:
             for t in self.topics.values():
                 with t.lock:
@@ -664,6 +695,20 @@ class Kafka:
                         t.ua_msgq.clear()
         if purged:
             self.dr_msgq(purged, KafkaError(Err._PURGE_QUEUE, "purged"))
+        if in_flight:
+            # batches inside the codec pipeline are neither queued nor in
+            # waitresp: bump the purge epoch so their codec_done results
+            # are discarded with _PURGE_INFLIGHT instead of being sent
+            self._purge_epoch += 1
+            with self._brokers_lock:
+                brokers = list(self.brokers.values())
+            for b in brokers:
+                b.ops.push(Op(OpType.PURGE))
+        if self.idemp and (purged or in_flight):
+            # purged messages consumed msgids: the sequence chain has a
+            # gap the broker would reject — resync PID/epoch (the DRAIN
+            # rebase recomputes the base from what is still pending)
+            self.idemp.drain_epoch_bump("purge")
 
     def _wake_all_brokers(self):
         with self._brokers_lock:
@@ -692,6 +737,7 @@ class Kafka:
         with self._toppars_lock:
             tps = list(self._toppars.values())
         any_possibly_persisted = False
+        any_expired = False
         for tp in tps:
             tmo = self.topic_conf_for(tp.topic).get("message.timeout.ms") / 1000.0
             if tmo <= 0:
@@ -708,6 +754,7 @@ class Kafka:
                        and now - tp.retry_batches[0][0].enq_time > tmo):
                     expired.extend(tp.retry_batches.popleft())
             if expired:
+                any_expired = True
                 if any(m.status == MsgStatus.POSSIBLY_PERSISTED
                        for m in expired):
                     any_possibly_persisted = True
@@ -721,10 +768,11 @@ class Kafka:
                         "enable.gapless.guarantee set")
                     self.set_fatal_error(terr)
                 self.dr_msgq(expired, terr)
-        if any_possibly_persisted and self.idemp:
-            # timing out possibly-persisted messages leaves a sequence gap
-            # the broker will reject; recover via drain + epoch bump
-            # (reference: rdkafka_broker.c:3291-3309)
+        if any_expired and self.idemp:
+            # ANY timed-out message leaves a sequence gap the broker will
+            # reject — even never-transmitted ones consumed msgids;
+            # recover via drain + epoch bump (reference:
+            # rdkafka_broker.c:3291-3309)
             self.idemp.drain_epoch_bump("message(s) timed out")
 
     # --------------------------------------------------------- stats emit --
@@ -913,6 +961,8 @@ class Kafka:
             self.offset_store.close()
         if self.background is not None:
             self.background.stop()
+        if self.codec_worker is not None:
+            self.codec_worker.stop()
 
     # ----------------------------------------------------------- security --
     def ssl_ctx(self):

@@ -1,0 +1,73 @@
+"""Purge API tests (reference: rd_kafka_purge + 0086-purge.c): in-queue
+purge drains every queue tier (msgq, xmit, frozen retry batches, UA
+parking) with _PURGE_QUEUE DRs; in-flight purge abandons outstanding
+ProduceRequests with _PURGE_INFLIGHT DRs and flush() returns."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.client.errors import Err
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.mock.sockem import Sockem
+
+
+def test_purge_in_queue_covers_all_tiers():
+    drs = []
+    cluster = MockCluster(num_brokers=1, topics={"pq": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 60000,      # park in msgq forever
+                  "dr_msg_cb": lambda e, m: drs.append(e)})
+    try:
+        for i in range(10):
+            p.produce("pq", value=b"q%d" % i, partition=0)
+        p.produce("unknown-topic-parked", value=b"ua")   # UA parking
+        time.sleep(0.3)
+        p.purge(in_queue=True, in_flight=False)
+        assert p.flush(10.0) == 0
+        deadline = time.monotonic() + 5
+        while len(drs) < 11 and time.monotonic() < deadline:
+            p.poll(0.1)           # purge DRs arrive via the reply queue
+        errs = [e for e in drs if e is not None]
+        assert len(errs) >= 10
+        assert all(e.code == Err._PURGE_QUEUE for e in errs[:10])
+        assert p._rk.msg_cnt == 0 and p._rk.msg_bytes == 0
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_purge_in_flight():
+    """Choke the network so a ProduceRequest is stuck in flight, purge,
+    and verify _PURGE_INFLIGHT DRs + fast flush return."""
+    drs = []
+    em = Sockem()
+    cluster = MockCluster(num_brokers=1, topics={"pf": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "connect_cb": em.connect_cb, "linger.ms": 2,
+                  "message.timeout.ms": 120000,
+                  "dr_msg_cb": lambda e, m: drs.append(e)})
+    try:
+        p.produce("pf", value=b"warm", partition=0)
+        assert p.flush(10.0) == 0
+        em.set(rate_bps=2000)             # responses crawl
+        for i in range(5):
+            p.produce("pf", value=b"f%d" % i * 200, partition=0)
+        time.sleep(0.6)                   # request now in flight
+        t0 = time.monotonic()
+        p.purge(in_queue=True, in_flight=True)
+        assert p.flush(10.0) == 0
+        assert time.monotonic() - t0 < 5.0, "flush blocked despite purge"
+        deadline = time.monotonic() + 5
+        while len(drs) < 6 and time.monotonic() < deadline:
+            p.poll(0.1)
+        errs = [e for e in drs if e is not None]
+        assert errs, "no purge DRs delivered"
+        assert all(e.code in (Err._PURGE_QUEUE, Err._PURGE_INFLIGHT)
+                   for e in errs)
+        assert any(e.code == Err._PURGE_INFLIGHT for e in errs), \
+            "no in-flight purge happened"
+    finally:
+        p.close()
+        cluster.stop()
+        em.kill_all()
