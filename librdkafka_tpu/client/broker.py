@@ -81,9 +81,18 @@ def _run_codec_phase(rk, ready: list, codec: str) -> list:
     results = []
     try:
         if codec != "none" and ready:
-            blobs = provider.compress_many(
-                codec, [w.records_bytes for _, _, w in ready],
-                rk.topic_conf_for(ready[0][0].topic).get("compression.level"))
+            # compression.level is topic-scoped: group the fan-in by
+            # level so one serve pass honors every topic's setting
+            blobs = [None] * len(ready)
+            by_level: dict = {}
+            for i, (tp, _msgs, w) in enumerate(ready):
+                lvl = rk.topic_conf_for(tp.topic).get("compression.level")
+                by_level.setdefault(lvl, []).append(i)
+            for lvl, idxs in by_level.items():
+                out = provider.compress_many(
+                    codec, [ready[i][2].records_bytes for i in idxs], lvl)
+                for i, blob in zip(idxs, out):
+                    blobs[i] = blob
         else:
             blobs = [None] * len(ready)
     except Exception as e:
@@ -689,8 +698,12 @@ class Broker:
             # rebase on the main thread never runs past messages held in
             # this serve pass's `ready` list
             if now >= tp.retry_backoff_until:
-                while tp.retry_batches and tp.inflight < max_inflight:
+                while tp.inflight < max_inflight:
                     with tp.lock:
+                        # emptiness re-checked under the lock: purge()
+                        # clears retry_batches from the app thread
+                        if not tp.retry_batches:
+                            break
                         msgs = list(tp.retry_batches.popleft())
                         tp.inflight_msgids.add(msgs[0].msgid)
                     tp.inflight += 1
@@ -794,26 +807,20 @@ class Broker:
         purged = purge_epoch != rk._purge_epoch
         for tp, msgs, wire, exc in results:
             if purged:
-                tp.inflight -= 1
-                with tp.lock:
-                    tp.inflight_msgids.discard(msgs[0].msgid)
+                tp.release_inflight(msgs)
                 rk.dr_msgq(msgs, KafkaError(Err._PURGE_INFLIGHT,
                                             "purged in flight",
                                             retriable=False))
             elif exc is not None:
                 self._release_unsent(tp, msgs, exc)
             elif self.state != BrokerState.UP or self.terminate:
-                tp.inflight -= 1
-                with tp.lock:
-                    tp.inflight_msgids.discard(msgs[0].msgid)
+                tp.release_inflight(msgs)
                 tp.enqueue_retry_batch(msgs)
             else:
                 self._send_produce(tp, msgs, wire, now)
 
     def _release_unsent(self, tp, msgs: list[Message], exc: Exception):
-        tp.inflight -= 1
-        with tp.lock:
-            tp.inflight_msgids.discard(msgs[0].msgid)
+        tp.release_inflight(msgs)
         self.rk.log("ERROR", f"{self.name}: batch codec failed: {exc!r}")
         self.rk.dr_msgq(msgs, KafkaError(Err._FAIL,
                                          f"batch codec failed: {exc!r}"))
@@ -881,9 +888,7 @@ class Broker:
                 tp, msgs, err, resp))
         self._xmit(req)
         if acks == 0:
-            tp.inflight -= 1
-            with tp.lock:
-                tp.inflight_msgids.discard(msgs[0].msgid)
+            tp.release_inflight(msgs)
             for m in msgs:
                 m.offset = -1
             rk.dr_msgq(msgs, None)
@@ -898,9 +903,7 @@ class Broker:
         try:
             self._handle_produce0(tp, msgs, err, resp)
         finally:
-            tp.inflight -= 1
-            with tp.lock:
-                tp.inflight_msgids.discard(msgs[0].msgid)
+            tp.release_inflight(msgs)
 
     def _gapless_fatal(self, tp, kerr: KafkaError) -> Optional[KafkaError]:
         """enable.gapless.guarantee: any permanently failed message in an

@@ -325,9 +325,11 @@ class Kafka:
         if self.cgrp is not None and self.cgrp.patterns:
             # regex subscriptions need the full cluster topic list
             names = None
-        # metadata.max.age.ms: drop cache entries past their age so
-        # stale leaders can't be used after long disconnects (reference
-        # rdkafka_metadata_cache.c:289 expiry)
+        # metadata.max.age.ms: expire cache entries past their age
+        # (reference rdkafka_metadata_cache.c:289). Existing toppar
+        # leader delegation is updated by the refresh RESPONSE
+        # (_assign_toppar_leader); the expiry only keeps get_toppar and
+        # admin list_topics from reading decayed entries meanwhile
         max_age = self.conf.get("metadata.max.age.ms") / 1000.0
         now = time.monotonic()
         with self._metadata_lock:

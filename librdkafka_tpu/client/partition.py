@@ -97,6 +97,15 @@ class Toppar:
                             key=lambda m: m.msgid)
             self.xmit_msgq = deque(merged)
 
+    def release_inflight(self, msgs) -> None:
+        """Release one batch's in-flight accounting. MUST run only after
+        the requeue-or-DR decision (the DRAIN rebase on the main thread
+        keys off inflight==0 — releasing early lets it rebase past
+        messages still owned by a broker/codec thread)."""
+        self.inflight -= 1
+        with self.lock:
+            self.inflight_msgids.discard(msgs[0].msgid)
+
     def enqueue_retry_batch(self, msgs: list[Message]) -> None:
         """Requeue a failed produce batch FROZEN — original membership and
         order — so a resend carries the same (BaseSequence, record_count)
