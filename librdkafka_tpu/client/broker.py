@@ -143,6 +143,15 @@ class CodecWorker(threading.Thread):
         self.jobs.put(None)
 
     def run(self):
+        if self.rk.interceptors:
+            self.rk.interceptors.on_thread_start("codec", self.name)
+        try:
+            self._run()
+        finally:
+            if self.rk.interceptors:
+                self.rk.interceptors.on_thread_exit("codec", self.name)
+
+    def _run(self):
         while True:
             job = self.jobs.get()
             if job is None:
@@ -238,6 +247,8 @@ class Broker:
 
     # --------------------------------------------------------- the thread --
     def _thread_main(self):
+        if self.rk.interceptors:
+            self.rk.interceptors.on_thread_start("broker", self.name)
         while not self.terminate:
             try:
                 self._serve()
@@ -246,6 +257,8 @@ class Broker:
                 self._disconnect(KafkaError(Err._FAIL, repr(e)))
                 time.sleep(0.05)
         self._disconnect(KafkaError(Err._DESTROY, "terminating"))
+        if self.rk.interceptors:
+            self.rk.interceptors.on_thread_exit("broker", self.name)
 
     def _serve(self):
         now = time.monotonic()
@@ -521,6 +534,9 @@ class Broker:
         req.ts_sent = time.monotonic()
         if req.ts_enq:
             self.outbuf_avg.add((req.ts_sent - req.ts_enq) * 1e6)
+        if self.rk.interceptors:
+            self.rk.interceptors.on_request_sent(
+                self.nodeid, int(req.api), req.corrid, len(wire))
         if req.expect_response:
             self.waitresp[req.corrid] = req
             if not req.abs_timeout:
@@ -814,8 +830,10 @@ class Broker:
             elif exc is not None:
                 self._release_unsent(tp, msgs, exc)
             elif self.state != BrokerState.UP or self.terminate:
-                tp.release_inflight(msgs)
+                # requeue FIRST: the DRAIN rebase scans retry_batches the
+                # instant inflight drops to 0 (release_inflight docstring)
                 tp.enqueue_retry_batch(msgs)
+                tp.release_inflight(msgs)
             else:
                 self._send_produce(tp, msgs, wire, now)
 

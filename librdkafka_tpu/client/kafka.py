@@ -278,6 +278,8 @@ class Kafka:
 
     # -------------------------------------------------------- main thread --
     def _thread_main(self):
+        if self.interceptors:
+            self.interceptors.on_thread_start("main", "rdk:main")
         while not self.terminating:
             timeout = self.timers.next_timeout(0.1)
             op = self.ops.pop(timeout)
@@ -288,6 +290,8 @@ class Kafka:
                 self.idemp.serve()
             if self.cgrp:
                 self.cgrp.serve()
+        if self.interceptors:
+            self.interceptors.on_thread_exit("main", "rdk:main")
 
     def _op_serve(self, op: Op):
         if op.cb:

@@ -600,10 +600,24 @@ class MockCluster:
                         any_err = True
                     if records:
                         any_data = True
+                    aborted = []
+                    if body.get("isolation_level", 0) == 1 and records:
+                        # read_committed: report only aborted-txn ranges
+                        # overlapping the fetched span — an entry whose
+                        # ABORT marker precedes the fetch offset must
+                        # not be re-reported or the client would filter
+                        # later committed data from the same pid
+                        # (txn index test-seeded via part.aborted;
+                        # optional "last_offset" = abort marker offset)
+                        aborted = [
+                            a for a in getattr(part, "aborted", []) or []
+                            if a.get("last_offset", 1 << 62)
+                            >= p["fetch_offset"]]
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
                          "high_watermark": hwm, "last_stable_offset": lso,
-                         "aborted_transactions": [], "records": records})
+                         "aborted_transactions": aborted,
+                         "records": records})
                 out_topics.append(tp)
         if not any_data and not any_err and not force:
             return None

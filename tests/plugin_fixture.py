@@ -3,7 +3,8 @@ tests/plugin_test shared object loaded via plugin.library.paths): the
 conf_init() contract receives (conf, chain) and registers interceptors."""
 
 CALLS = {"conf_init": 0, "on_send": 0, "on_acknowledgement": 0,
-         "on_new": 0}
+         "on_new": 0, "on_request_sent": 0, "on_thread_start": 0,
+         "on_thread_exit": 0}
 
 
 def conf_init(conf, chain):
@@ -15,6 +16,15 @@ def conf_init(conf, chain):
     chain.add("plugin_fixture", "on_acknowledgement",
               lambda msg: CALLS.__setitem__(
                   "on_acknowledgement", CALLS["on_acknowledgement"] + 1))
+    chain.add("plugin_fixture", "on_request_sent",
+              lambda *a: CALLS.__setitem__(
+                  "on_request_sent", CALLS["on_request_sent"] + 1))
+    chain.add("plugin_fixture", "on_thread_start",
+              lambda *a: CALLS.__setitem__(
+                  "on_thread_start", CALLS["on_thread_start"] + 1))
+    chain.add("plugin_fixture", "on_thread_exit",
+              lambda *a: CALLS.__setitem__(
+                  "on_thread_exit", CALLS["on_thread_exit"] + 1))
 
 
 def custom_entry(conf, chain):

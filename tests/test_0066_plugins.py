@@ -22,6 +22,10 @@ def test_plugin_library_paths_loads_and_hooks_fire():
     assert plugin_fixture.CALLS["on_send"] >= before["on_send"] + n
     assert (plugin_fixture.CALLS["on_acknowledgement"]
             >= before["on_acknowledgement"] + n)
+    # broker requests went out and threads ran under the interceptors
+    assert plugin_fixture.CALLS["on_request_sent"] > before["on_request_sent"]
+    assert plugin_fixture.CALLS["on_thread_start"] > before["on_thread_start"]
+    assert plugin_fixture.CALLS["on_thread_exit"] > before["on_thread_exit"]
 
 
 def test_plugin_custom_entry_point():

@@ -117,9 +117,11 @@ def test_two_consumers_rebalance_under_load():
     p.close()
 
     seen = []
+    per_consumer = {1: 0, 2: 0}
+    assigned = {1: 0, 2: 0}
     seen_lock = threading.Lock()
 
-    def consume(cid, barrier_at):
+    def consume(cid):
         c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
                       "group.id": "grb", "auto.offset.reset": "earliest",
                       "session.timeout.ms": 30000})
@@ -128,21 +130,25 @@ def test_two_consumers_rebalance_under_load():
         idle = 0
         while time.monotonic() < deadline and idle < 12:
             m = c.poll(0.25)
+            assigned[cid] = len(c.assignment())   # final assignment wins
             if m is not None and m.error is None:
                 with seen_lock:
                     seen.append(m.value)
+                    per_consumer[cid] += 1
                 idle = 0
             else:
                 idle += 1
         c.close()
 
-    c1 = threading.Thread(target=consume, args=(1, None))
+    c1 = threading.Thread(target=consume, args=(1,))
     c1.start()
     time.sleep(1.5)            # c1 mid-consumption
-    c2 = threading.Thread(target=consume, args=(2, None))
+    c2 = threading.Thread(target=consume, args=(2,))
     c2.start()
     c1.join()
     c2.join()
     cluster.stop()
     missing = set(b"r%05d" % i for i in range(N)) - set(seen)
     assert not missing, f"{len(missing)} messages never consumed"
+    # the rebalance must actually have moved partitions to c2
+    assert assigned[2] >= 1, "consumer 2 was never assigned partitions"
