@@ -12,6 +12,8 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from collections import deque
+
 from ..protocol import proto
 from ..protocol.proto import ApiKey
 from .broker import Request
@@ -52,6 +54,9 @@ class Consumer:
         group_id = conf.get("group.id")
         self._rk.cgrp = ConsumerGroup(self._rk, group_id) if group_id else None
         self._assignment: dict[tuple[str, int], Toppar] = {}
+        # messages from a batched FETCH op awaiting delivery via poll()
+        self._pending: deque = deque()
+        self._auto_store = conf.get("enable.auto.offset.store")
         self._closed = False
 
     # ---------------------------------------------------------- subscribe --
@@ -174,6 +179,11 @@ class Consumer:
             self._rk.cgrp.poll_tick()
         deadline = time.monotonic() + timeout
         while True:
+            while self._pending:
+                tp, m, ver = self._pending.popleft()
+                msg = self._deliver(tp, m, ver)
+                if msg is not None:
+                    return msg
             remain = deadline - time.monotonic()
             op = self.queue.pop(max(0.0, min(remain, 0.1)))
             if op is None:
@@ -203,22 +213,11 @@ class Consumer:
     def _serve_op(self, op: Op) -> Optional[Message]:
         rk = self._rk
         if op.type == OpType.FETCH:
-            tp, msg, version = op.payload
-            if tp.version != version or (tp.topic, tp.partition) not in \
-                    self._assignment and rk.cgrp is not None:
-                # stale: partition seeked/revoked since fetch — release
-                # the queue accounting this op still holds
-                tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
-                tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
-                return None
-            tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
-            tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
-            tp.app_offset = msg.offset + 1
-            if rk.conf.get("enable.auto.offset.store"):
-                tp.stored_offset = msg.offset + 1
-            if rk.stats:
-                rk.stats.c_rx_msgs += 0  # counted at fetch parse
-            return msg
+            tp, msgs, version = op.payload
+            first = self._deliver(tp, msgs[0], version)
+            for m in msgs[1:]:
+                self._pending.append((tp, m, version))
+            return first
         if op.type == OpType.CONSUMER_ERR:
             tp, msg, version = op.payload
             return msg if tp.version == version else None
@@ -234,6 +233,21 @@ class Consumer:
         # same handlers rd_kafka_poll would use
         rk._serve_rep_op(op)
         return None
+
+    def _deliver(self, tp: Toppar, msg: Message,
+                 version: int) -> Optional[Message]:
+        """Per-message delivery bookkeeping; None when the message is
+        stale (partition seeked/revoked since the fetch)."""
+        rk = self._rk
+        tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
+        tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
+        if tp.version != version or (tp.topic, tp.partition) not in \
+                self._assignment and rk.cgrp is not None:
+            return None     # stale: accounting released above
+        tp.app_offset = msg.offset + 1
+        if self._auto_store:
+            tp.stored_offset = msg.offset + 1
+        return msg
 
     # ------------------------------------------------------------ offsets --
     def stored_offsets(self) -> dict[tuple[str, int], int]:

@@ -64,11 +64,11 @@ class Event:
     # ------------------------------------------------------- accessors ---
     def messages(self) -> list:
         """DR: the acked/failed messages (rd_kafka_event_message_array).
-        FETCH: the single consumed message."""
+        FETCH: the consumed message batch."""
         if self.op.type == OpType.DR:
             return list(self.op.payload)
         if self.op.type == OpType.FETCH:
-            return [self.op.payload[1]]
+            return list(self.op.payload[1])
         return []
 
     def error(self):

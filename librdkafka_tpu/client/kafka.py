@@ -822,7 +822,7 @@ class Kafka:
                 m = Message(tp.topic, partition=tp.partition)
                 m.offset = fo
                 m.error = KafkaError(Err._PARTITION_EOF, "partition EOF")
-                tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, ver)))
+                tp.fetchq.push(Op(OpType.FETCH, payload=(tp, [m], ver)))
             return
         check_crcs = self.conf.get("check.crcs")
         read_committed = (self.conf.get("isolation.level") == "read_committed")
@@ -921,12 +921,17 @@ class Kafka:
             return      # seek/rebalance raced this response: drop it
         tp.fetch_offset = next_offset
         tp.eof_reported_at = proto.OFFSET_INVALID
-        for m in msgs:
-            if self.interceptors:
+        if self.interceptors:
+            for m in msgs:
                 self.interceptors.on_consume(m)
-            tp.fetchq.push(Op(OpType.FETCH, payload=(tp, m, ver)))
+        # accounting BEFORE the push: the app thread may drain the op
+        # (decrements clamp at 0) the instant it becomes visible
         tp.fetchq_cnt += len(msgs)
         tp.fetchq_bytes += sum(m.size for m in msgs)
+        if msgs:
+            # ONE op per parsed partition response (per-message op
+            # push/pop dominated the consume profile)
+            tp.fetchq.push(Op(OpType.FETCH, payload=(tp, msgs, ver)))
         if self.stats:
             self.stats.c_rx_msgs += len(msgs)
 

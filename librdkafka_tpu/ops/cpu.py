@@ -34,6 +34,8 @@ def lib() -> ctypes.CDLL:
         L.tk_crc32c_many.argtypes = [ctypes.c_char_p, i64p, i64p, u32p, ctypes.c_int]
         L.tk_xxh32.restype = u32
         L.tk_xxh32.argtypes = [ctypes.c_char_p, i64, u32]
+        L.tk_parse_v2.restype = i64
+        L.tk_parse_v2.argtypes = [ctypes.c_char_p, i64, i64, i64p]
         for name in ("tk_lz4_block_compress", "tk_lz4_block_decompress",
                      "tk_lz4f_compress", "tk_lz4f_decompress",
                      "tk_snappy_compress", "tk_snappy_decompress"):
