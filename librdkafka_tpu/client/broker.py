@@ -406,8 +406,11 @@ class Broker:
         if (self.rk.conf.get("api.version.request")
                 and not self._apiversion_failed
                 and time.monotonic() >= self._fallback_until):
-            self._xmit(Request(ApiKey.ApiVersions, {},
-                               cb=self._handle_apiversions))
+            self._xmit(Request(
+                ApiKey.ApiVersions, {},
+                abs_timeout=time.monotonic() + self.rk.conf.get(
+                    "api.version.request.timeout.ms") / 1000.0,
+                cb=self._handle_apiversions))
         else:
             self._apply_version_fallback()
             self._broker_up()
@@ -658,6 +661,7 @@ class Broker:
     def _req_fail(self, req: Request, err: KafkaError):
         if err.retriable and req.retries_left > 0:
             req.retries_left -= 1
+            req.abs_timeout = 0.0    # retry gets a fresh timeout window
             backoff = self.rk.conf.get("retry.backoff.ms") / 1000.0
             self.retryq.append((time.monotonic() + backoff, req))
             return

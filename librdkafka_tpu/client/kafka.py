@@ -154,6 +154,10 @@ class Kafka:
         self.mock_cluster = None
         self.stats = None                      # StatsCollector, set below
         self.debug_contexts = set(conf.get("debug"))
+        # debug contexts force DEBUG visibility (the reference raises
+        # log_level to 7 whenever debug is set, rd_kafka_conf_finalize)
+        self._log_level = (7 if self.debug_contexts
+                           else conf.get("log_level"))
         self.log_cb = conf.get("log_cb")
         # topic.blacklist (reference rdkafka_pattern.c blacklist list):
         # matching topics are invisible to metadata/subscriptions
@@ -266,7 +270,13 @@ class Kafka:
         self.metadata_refresh("bootstrap")
 
     # ------------------------------------------------------------ logging --
+    _LOG_LEVELS = {"EMERG": 0, "ALERT": 1, "CRIT": 2, "ERROR": 3,
+                   "WARN": 4, "NOTICE": 5, "INFO": 6, "DEBUG": 7}
+
     def log(self, level: str, msg: str):
+        # numeric syslog-style filter (reference log_level, default 6)
+        if self._LOG_LEVELS.get(level, 6) > self._log_level:
+            return
         if self.log_cb:
             self.log_cb(level, "rdkafka", msg)
         elif level in ("ERROR", "WARN"):
@@ -345,6 +355,8 @@ class Kafka:
         full = not names        # None or [] → broker enumerates all topics
         b.enqueue_request(Request(
             ApiKey.Metadata, {"topics": names}, retries_left=2,
+            abs_timeout=time.monotonic() +
+            self.conf.get("metadata.request.timeout.ms") / 1000.0,
             cb=lambda e, r: self._handle_metadata(e, r, full=full)))
 
     def _handle_metadata(self, err, resp, full: bool = False):
@@ -514,8 +526,6 @@ class Kafka:
                 on_delivery=None, timestamp=0, headers=(), opaque=None) -> None:
         # positional order matches the confluent-style public API
         # (topic, value, key, partition, on_delivery, timestamp, headers)
-        if on_delivery is not None and not self.conf.get("dr_msg_cb"):
-            self.conf.set("dr_msg_cb", on_delivery)
         if isinstance(value, str):
             value = value.encode()
         if isinstance(key, str):
@@ -532,6 +542,8 @@ class Kafka:
             self.msg_bytes += sz
         m = Message(topic, value=value, key=key, partition=partition,
                     headers=headers, timestamp=timestamp, opaque=opaque)
+        if on_delivery is not None:
+            m.on_delivery = on_delivery   # per-message DR callback
         if self.interceptors:
             self.interceptors.on_send(m)
         # lock-free fast path: dict reads are atomic under the GIL; fall
@@ -595,7 +607,8 @@ class Kafka:
                 self.interceptors.on_acknowledgement(m)
         if (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
                 or "dr" in self.conf.get("enabled_events")
-                or self.background is not None):
+                or self.background is not None
+                or any(m.on_delivery is not None for m in msgs)):
             only_err = self.conf.get("delivery.report.only.error")
             out = msgs if (err or not only_err) else \
                 [m for m in msgs if m.error]
@@ -627,9 +640,10 @@ class Kafka:
     def _serve_rep_op(self, op: Op):
         if op.type == OpType.DR:
             cb = self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
-            if cb:
-                for m in op.payload:
-                    cb(m.error, m)
+            for m in op.payload:
+                mcb = m.on_delivery or cb
+                if mcb:
+                    mcb(m.error, m)
         elif op.type == OpType.ERR:
             cb = self.conf.get("error_cb")
             if cb:

@@ -32,6 +32,7 @@ class Message:
     __slots__ = ("topic", "partition", "key", "value", "headers", "offset",
                  "timestamp", "timestamp_type", "error", "opaque", "msgid",
                  "retries", "status", "enq_time", "ts_backoff", "latency_us",
+                 "on_delivery",
                  "size")
 
     def __init__(self, topic: str, value: Optional[bytes] = None,
@@ -55,6 +56,7 @@ class Message:
         self.enq_time = time.monotonic()
         self.ts_backoff = 0.0
         self.latency_us = 0
+        self.on_delivery = None       # per-message DR callback
         self.size = (len(value) if value else 0) + (len(key) if key else 0)
 
     def __len__(self) -> int:

@@ -624,6 +624,9 @@ static inline int vi_dec(const uint8_t *p, const uint8_t *end, int64_t *out) {
 
 EXPORT int64_t tk_parse_v2(const uint8_t *buf, int64_t n, int64_t max_recs,
                            int64_t *out) {
+    // NOTE: all bounds checks are in LENGTH space (len > rend - p), not
+    // pointer space (p + len > rend) — the lengths come off the wire
+    // and p + INT64_MAX is undefined behavior the optimizer may exploit
     const uint8_t *p = buf, *end = buf + n;
     int64_t cnt = 0;
     while (p < end && cnt < max_recs) {
@@ -631,8 +634,8 @@ EXPORT int64_t tk_parse_v2(const uint8_t *buf, int64_t n, int64_t max_recs,
         int c = vi_dec(p, end, &rec_len);
         if (c < 0 || rec_len < 0) return -1;
         p += c;
+        if (rec_len > end - p) return -1;
         const uint8_t *rend = p + rec_len;
-        if (rend > end) return -1;
         if (p >= rend) return -1;
         p += 1;                                   // record attributes
         int64_t ts_delta, off_delta, klen, vlen, nh;
@@ -644,14 +647,14 @@ EXPORT int64_t tk_parse_v2(const uint8_t *buf, int64_t n, int64_t max_recs,
         p += c;
         int64_t key_off = p - buf;
         if (klen > 0) {
-            if (p + klen > rend) return -1;
+            if (klen > rend - p) return -1;
             p += klen;
         }
         if ((c = vi_dec(p, rend, &vlen)) < 0) return -1;
         p += c;
         int64_t val_off = p - buf;
         if (vlen > 0) {
-            if (p + vlen > rend) return -1;
+            if (vlen > rend - p) return -1;
             p += vlen;
         }
         if ((c = vi_dec(p, rend, &nh)) < 0) return -1;
@@ -665,12 +668,12 @@ EXPORT int64_t tk_parse_v2(const uint8_t *buf, int64_t n, int64_t max_recs,
             int64_t hkl, hvl;
             if ((c = vi_dec(p, rend, &hkl)) < 0 || hkl < 0) return -1;
             p += c;
-            if (p + hkl > rend) return -1;
+            if (hkl > rend - p) return -1;
             p += hkl;
             if ((c = vi_dec(p, rend, &hvl)) < 0) return -1;
             p += c;
             if (hvl > 0) {
-                if (p + hvl > rend) return -1;
+                if (hvl > rend - p) return -1;
                 p += hvl;
             }
         }

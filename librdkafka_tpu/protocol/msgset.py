@@ -349,6 +349,11 @@ def _parse_records_v2_native(info: BatchInfo,
     n = info.record_count
     if n <= 0:
         return []
+    # a v2 record is >= 7 bytes; a forged record_count must not drive
+    # the allocation (the Fetch payload is untrusted network data)
+    if n > len(records_bytes) // 7 + 1:
+        raise CrcMismatch(
+            f"record_count {n} impossible for {len(records_bytes)} bytes")
     fields = np.empty((n, 8), dtype=np.int64)
     got = L.tk_parse_v2(
         records_bytes, len(records_bytes), n,
@@ -378,6 +383,10 @@ def _parse_records_v2_native(info: BatchInfo,
 def _parse_headers(buf: bytes, off: int, nh: int) -> list:
     sl = Slice(buf)
     sl.skip(off)
+    return _read_headers(sl, nh)
+
+
+def _read_headers(sl: "Slice", nh: int) -> list:
     headers = []
     for _ in range(nh):
         hklen = sl.read_varint()
@@ -406,13 +415,7 @@ def _parse_records_v2_py(info: BatchInfo,
         vlen = rsl.read_varint()
         value = None if vlen < 0 else rsl.read(vlen)
         nh = rsl.read_varint()
-        headers = []
-        for _ in range(nh):
-            hklen = rsl.read_varint()
-            hk = rsl.read(hklen).decode("utf-8", "replace")
-            hvlen = rsl.read_varint()
-            hv = None if hvlen < 0 else rsl.read(hvlen)
-            headers.append((hk, hv))
+        headers = _read_headers(rsl, nh) if nh else []
         out.append(Record(
             key=key, value=value, headers=headers,
             timestamp=info.first_timestamp + ts_delta,

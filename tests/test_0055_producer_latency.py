@@ -1,0 +1,82 @@
+"""Producer latency tests (reference: 0055-producer_latency.c): the
+linger gate (rdkafka_broker.c:3453-3470) bounds int_latency — low
+linger delivers fast; high linger accumulates batches; flush() overrides
+linger and sends immediately."""
+import json
+import time
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+def _deliver_one(p, topic, timeout=10.0):
+    done = []
+    p.produce(topic, value=b"lat", partition=0,
+              on_delivery=lambda e, m: done.append(time.monotonic()))
+    t0 = time.monotonic()
+    deadline = t0 + timeout
+    while not done and time.monotonic() < deadline:
+        p.poll(0.01)
+    assert done, "never delivered"
+    return done[0] - t0
+
+
+def test_low_linger_is_fast():
+    cluster = MockCluster(num_brokers=1, topics={"lat": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 0})
+    try:
+        _deliver_one(p, "lat")              # warm connection
+        lat = min(_deliver_one(p, "lat") for _ in range(5))
+        assert lat < 0.15, f"linger.ms=0 latency {lat*1000:.1f}ms"
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_high_linger_accumulates_then_flush_overrides():
+    cluster = MockCluster(num_brokers=1, topics={"lat": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5000, "batch.num.messages": 10000})
+    try:
+        p.produce("lat", value=b"warm", partition=0)
+        assert p.flush(10.0) == 0           # flush sends despite linger
+        for i in range(50):
+            p.produce("lat", value=b"m%d" % i, partition=0)
+        time.sleep(0.4)
+        # still lingering: nothing new in the log
+        assert cluster.partition("lat", 0).end_offset == 1
+        t0 = time.monotonic()
+        assert p.flush(10.0) == 0
+        assert time.monotonic() - t0 < 2.0, "flush waited for linger"
+        assert cluster.partition("lat", 0).end_offset == 51
+        # the lingered 50 went out as ONE batch (one wire blob)
+        assert len(cluster.partition("lat", 0).log) == 2
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_int_latency_stat_reflects_linger():
+    blobs = []
+    cluster = MockCluster(num_brokers=1, topics={"lat": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 300, "statistics.interval.ms": 200,
+                  "stats_cb": lambda js: blobs.append(json.loads(js))})
+    try:
+        for i in range(20):
+            p.produce("lat", value=b"s%d" % i, partition=0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            p.poll(0.1)
+            if any(b["int_latency"]["cnt"] for b in blobs):
+                break
+        il = next(b["int_latency"] for b in blobs
+                  if b["int_latency"]["cnt"])
+        # the batch lingered ~300ms before framing
+        assert il["max"] >= 250_000, il   # µs
+    finally:
+        p.close()
+        cluster.stop()
