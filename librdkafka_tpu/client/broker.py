@@ -659,9 +659,12 @@ class Broker:
             req.cb(None, body)
 
     def _req_fail(self, req: Request, err: KafkaError):
-        if err.retriable and req.retries_left > 0:
+        # the absolute timeout budget spans retries (reference keeps one
+        # deadline per request); an exhausted budget means no retry
+        budget_left = (not req.abs_timeout
+                       or time.monotonic() < req.abs_timeout)
+        if err.retriable and req.retries_left > 0 and budget_left:
             req.retries_left -= 1
-            req.abs_timeout = 0.0    # retry gets a fresh timeout window
             backoff = self.rk.conf.get("retry.backoff.ms") / 1000.0
             self.retryq.append((time.monotonic() + backoff, req))
             return
@@ -951,7 +954,8 @@ class Broker:
             if ec == Err.NO_ERROR:
                 base = pres["base_offset"]
                 if (rk.interceptors or rk.conf.get("dr_msg_cb")
-                        or rk.conf.get("dr_cb")):
+                        or rk.conf.get("dr_cb")
+                        or any(m.on_delivery is not None for m in msgs)):
                     for i, m in enumerate(msgs):
                         m.offset = base + i if base >= 0 else -1
                         m.status = MsgStatus.PERSISTED

@@ -371,6 +371,83 @@ class Consumer:
             time.sleep(0.01)
         return (0, tp.hi_offset)
 
+    def offsets_for_times(self, partitions: list[TopicPartition],
+                          timeout: float = 10.0) -> list[TopicPartition]:
+        """Earliest offsets at/after the given timestamps (reference:
+        rd_kafka_offsets_for_times -> ListOffsets v1 with real
+        timestamps). Input offsets carry the timestamps (ms), like the
+        reference API. A timestamp past the end of the log yields
+        offset -1 with NO error (reference semantics)."""
+        rk = self._rk
+        results: dict = {}
+        deadline = time.monotonic() + timeout   # ONE budget for the call
+
+        def make_cb(keys):
+            def cb(err, resp):
+                if err is None:
+                    for tr in resp["topics"]:
+                        for pr in tr["partitions"]:
+                            off = pr.get("offset")
+                            if off is None:     # ListOffsets v0: plural
+                                offs = pr.get("offsets") or [-1]
+                                off = offs[0]
+                            key = (tr["topic"], pr["partition"])
+                            results[key] = (pr["error_code"], off)
+                else:
+                    for k in keys:
+                        results[k] = (-1, proto.OFFSET_INVALID)
+            return cb
+
+        # group by leader broker like the fetch path
+        by_broker: dict = {}
+        for tpo in partitions:
+            tp = rk.get_toppar(tpo.topic, tpo.partition)
+            i = 0
+            while tp.leader_id < 0 and time.monotonic() < deadline:
+                if i % 10 == 0:     # refresh at ~0.5s cadence, not 50ms
+                    rk.metadata_refresh("offsets_for_times")
+                i += 1
+                time.sleep(0.05)
+            by_broker.setdefault(tp.leader_id, []).append(tpo)
+        from .broker import Request
+        for leader, tpos in by_broker.items():
+            b = rk.brokers.get(leader)
+            if b is None:
+                for tpo in tpos:
+                    results[(tpo.topic, tpo.partition)] = (
+                        -1, proto.OFFSET_INVALID)
+                continue
+            body = {"replica_id": -1,
+                    "topics": [{"topic": tpo.topic, "partitions": [
+                        {"partition": tpo.partition,
+                         "timestamp": tpo.offset,
+                         "max_num_offsets": 1}]}
+                        for tpo in tpos]}
+            keys = [(tpo.topic, tpo.partition) for tpo in tpos]
+            b.enqueue_request(Request(ApiKey.ListOffsets, body,
+                                      retries_left=2, cb=make_cb(keys)))
+        while (len(results) < len(partitions)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        out = []
+        for tpo in partitions:
+            key = (tpo.topic, tpo.partition)
+            r = TopicPartition(tpo.topic, tpo.partition,
+                               proto.OFFSET_INVALID)
+            if key not in results:
+                r.error = KafkaError(Err._TIMED_OUT)
+            else:
+                ec, off = results[key]
+                r.offset = off
+                if ec == -1:
+                    r.error = KafkaError(Err._TRANSPORT)
+                elif ec > 0:
+                    r.error = KafkaError(Err.from_wire(ec))
+                # ec == 0 with offset -1 is the legitimate "no offset
+                # at or after this timestamp" result - NOT an error
+            out.append(r)
+        return out
+
     def poll_kafka(self, timeout: float = 0.0) -> int:
         return self._rk.poll(timeout)
 

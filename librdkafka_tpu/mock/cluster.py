@@ -650,8 +650,24 @@ class MockCluster:
                     else:
                         part = self.topics[t["topic"]][p["partition"]]
                         ts = p["timestamp"]
-                        offset = (part.start_offset if ts == proto.OFFSET_BEGINNING
-                                  else part.end_offset)
+                        if ts == proto.OFFSET_BEGINNING:
+                            offset = part.start_offset
+                        elif ts == proto.OFFSET_END:
+                            offset = part.end_offset
+                        else:
+                            # timestamp lookup (offsets_for_times): the
+                            # earliest offset whose batch could contain
+                            # ts, from the stored batch headers
+                            offset = -1
+                            for base, blob in part.log:
+                                if (len(blob) < proto.V2_HEADER_SIZE
+                                        or blob[proto.V2_OF_Magic] != 2):
+                                    continue
+                                max_ts = struct.unpack_from(
+                                    ">q", blob, proto.V2_OF_MaxTimestamp)[0]
+                                if max_ts >= ts:
+                                    offset = base
+                                    break
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
                          "timestamp": -1, "offset": offset,

@@ -1,0 +1,91 @@
+"""offsets_for_times (reference: 0054-offset_time.cpp,
+rd_kafka_offsets_for_times) and pause/resume (0026-era behavior):
+timestamp→offset lookup through ListOffsets, and paused partitions stop
+fetching until resumed with no message loss."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.consumer import TopicPartition
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+def test_offsets_for_times():
+    cluster = MockCluster(num_brokers=1, topics={"oft": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 0})      # one batch per message
+    base_ts = 1_600_000_000_000
+    try:
+        for i in range(5):
+            p.produce("oft", value=b"t%d" % i, partition=0,
+                      timestamp=base_ts + i * 1000)
+            p.flush(10.0)               # separate batches w/ rising ts
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "goft"})
+        # Kafka semantics: EARLIEST offset with timestamp >= target
+        res = c.offsets_for_times(
+            [TopicPartition("oft", 0, base_ts + 1500)], timeout=10)
+        assert res[0].error is None and res[0].offset == 2, res[0]
+        res = c.offsets_for_times(
+            [TopicPartition("oft", 0, base_ts)], timeout=10)
+        assert res[0].offset == 0
+        res = c.offsets_for_times(
+            [TopicPartition("oft", 0, base_ts + 4000)], timeout=10)
+        assert res[0].offset == 4
+        # beyond the last timestamp: no offset
+        res = c.offsets_for_times(
+            [TopicPartition("oft", 0, base_ts + 99_000)], timeout=10)
+        assert res[0].error is not None or res[0].offset < 0
+        c.close()
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_pause_resume_no_loss():
+    cluster = MockCluster(num_brokers=1, topics={"pr": 2})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gpr", "auto.offset.reset": "earliest"})
+    try:
+        for i in range(20):
+            p.produce("pr", value=b"a%02d" % i, partition=i % 2)
+        assert p.flush(10.0) == 0
+        c.subscribe(["pr"])
+        got = []
+        deadline = time.monotonic() + 20
+        while len(got) < 20 and time.monotonic() < deadline:
+            m = c.poll(0.3)
+            if m is not None and m.error is None:
+                got.append(m.value)
+        assert len(got) == 20
+
+        # pause partition 0, produce to both, only partition 1 arrives
+        c.pause([TopicPartition("pr", 0)])
+        time.sleep(0.2)
+        for i in range(10):
+            p.produce("pr", value=b"b%02d" % i, partition=i % 2)
+        assert p.flush(10.0) == 0
+        paused_got = []
+        deadline = time.monotonic() + 4
+        while time.monotonic() < deadline:
+            m = c.poll(0.25)
+            if m is not None and m.error is None:
+                paused_got.append((m.partition, m.value))
+        assert paused_got and all(part == 1 for part, _ in paused_got), \
+            paused_got
+        # resume: partition 0's messages arrive with no loss
+        c.resume([TopicPartition("pr", 0)])
+        resumed = []
+        deadline = time.monotonic() + 15
+        while len(resumed) < 5 and time.monotonic() < deadline:
+            m = c.poll(0.3)
+            if m is not None and m.error is None and m.partition == 0:
+                resumed.append(m.value)
+        assert sorted(resumed) == [b"b%02d" % i for i in range(0, 10, 2)]
+    finally:
+        c.close()
+        p.close()
+        cluster.stop()
