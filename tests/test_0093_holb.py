@@ -1,0 +1,83 @@
+"""Head-of-line blocking tests (reference: 0093-holb.c via sockem): a
+slow broker must not block delivery to other brokers — partition
+batches are independent (the fan-in axis of the TPU-first design), and
+the codec pipeline keeps per-broker reactors isolated."""
+import time
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+def test_slow_broker_does_not_block_fast_broker():
+    cluster = MockCluster(num_brokers=2, topics={"holb": 2})
+    # partition 0 -> broker 1, partition 1 -> broker 2
+    cluster.set_partition_leader("holb", 0, 1)
+    cluster.set_partition_leader("holb", 1, 2)
+    fast_done = []
+    slow_done = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    try:
+        # warm both connections
+        p.produce("holb", value=b"w0", partition=0,
+                  on_delivery=lambda e, m: None)
+        p.produce("holb", value=b"w1", partition=1,
+                  on_delivery=lambda e, m: None)
+        assert p.flush(10.0) == 0
+
+        cluster.set_rtt(1, 2500)          # broker 1 becomes slow
+        t0 = time.monotonic()
+        for i in range(20):
+            p.produce("holb", value=b"s%d" % i, partition=0,
+                      on_delivery=lambda e, m, s=t0: slow_done.append(
+                          time.monotonic() - s))
+            p.produce("holb", value=b"f%d" % i, partition=1,
+                      on_delivery=lambda e, m, s=t0: fast_done.append(
+                          time.monotonic() - s))
+        # the fast broker's deliveries must complete long before the
+        # slow broker's injected RTT elapses
+        deadline = time.monotonic() + 10
+        while len(fast_done) < 20 and time.monotonic() < deadline:
+            p.poll(0.05)
+        assert len(fast_done) == 20, f"fast partition starved: {len(fast_done)}"
+        assert max(fast_done) < 2.0, \
+            f"fast deliveries waited on the slow broker: {max(fast_done):.2f}s"
+        # slow ones do eventually arrive
+        assert p.flush(15.0) == 0
+        deadline = time.monotonic() + 5
+        while len(slow_done) < 20 and time.monotonic() < deadline:
+            p.poll(0.05)
+        assert len(slow_done) == 20
+        assert max(slow_done) >= 2.0   # they really were delayed
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_close_is_idempotent_and_releases():
+    cluster = MockCluster(num_brokers=1, topics={"cl": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    try:
+        p.produce("cl", value=b"x", partition=0)
+        assert p.flush(10.0) == 0
+    finally:
+        p.close()
+        p.close()                         # second close is a no-op
+        cluster.stop()
+
+
+def test_close_with_pending_messages_flushes_first():
+    """Producer.close() flushes outstanding messages (reference
+    rd_kafka_destroy after flush contract)."""
+    cluster = MockCluster(num_brokers=1, topics={"cl2": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 3000})     # would linger past close
+    try:
+        for i in range(10):
+            p.produce("cl2", value=b"p%d" % i, partition=0)
+        p.close()                         # must not abandon the batch
+        assert cluster.partition("cl2", 0).end_offset == 10
+    finally:
+        p.close()
+        cluster.stop()
