@@ -364,12 +364,26 @@ class Consumer:
         return out
 
     def get_watermark_offsets(self, partition: TopicPartition,
-                              timeout: float = 10.0) -> tuple[int, int]:
-        tp = self._rk.get_toppar(partition.topic, partition.partition)
+                              timeout: float = 10.0,
+                              cached: bool = False) -> tuple[int, int]:
+        """Low/high watermarks (reference: rd_kafka_query_watermark_
+        offsets / rd_kafka_get_watermark_offsets). ``cached=True``
+        returns the fetcher's last-known value without a query; the
+        query path is two ListOffsets lookups through the same
+        machinery as offsets_for_times (BEGINNING/END timestamps)."""
+        if cached:
+            tp = self._rk.get_toppar(partition.topic, partition.partition)
+            return (0, tp.hi_offset)
         deadline = time.monotonic() + timeout
-        while tp.hi_offset < 0 and time.monotonic() < deadline:
-            time.sleep(0.01)
-        return (0, tp.hi_offset)
+        out = []
+        for ts in (proto.OFFSET_BEGINNING, proto.OFFSET_END):
+            r = self.offsets_for_times(
+                [TopicPartition(partition.topic, partition.partition, ts)],
+                timeout=max(0.0, deadline - time.monotonic()))[0]
+            if r.error is not None:
+                raise KafkaException(r.error)
+            out.append(r.offset)
+        return (out[0], out[1])
 
     def offsets_for_times(self, partitions: list[TopicPartition],
                           timeout: float = 10.0) -> list[TopicPartition]:
@@ -409,7 +423,6 @@ class Consumer:
                 i += 1
                 time.sleep(0.05)
             by_broker.setdefault(tp.leader_id, []).append(tpo)
-        from .broker import Request
         for leader, tpos in by_broker.items():
             b = rk.brokers.get(leader)
             if b is None:
