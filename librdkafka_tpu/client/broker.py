@@ -201,6 +201,7 @@ class Broker:
         self.fetch_inflight = False
         self._tls_handshaking = False
         self._codec_outstanding = 0     # async codec jobs in flight
+        self._last_throttle = 0         # throttle_cb change detection
         self.toppars: set = set()           # toppars led by this broker
         self._lock = threading.Lock()
         self.ts_connected = 0.0
@@ -655,6 +656,16 @@ class Broker:
         tt = body.get("throttle_time_ms") if isinstance(body, dict) else None
         if tt:
             self.throttle_avg.add(tt)
+        # throttle event on changes (reference rd_kafka_op_throttle —
+        # fires when the broker starts/changes/stops throttling). Only
+        # responses that CARRY a throttle field count: tt is None for
+        # schemas without one (Metadata v2, ApiVersions, ...) and must
+        # not read as "throttling stopped"
+        if tt is not None and tt != self._last_throttle:
+            self._last_throttle = tt
+            if self.rk.conf.get("throttle_cb"):
+                self.rk.rep.push(Op(OpType.THROTTLE,
+                                    payload=(self.name, self.nodeid, tt)))
         if req.cb:
             req.cb(None, body)
 

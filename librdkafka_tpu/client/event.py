@@ -33,6 +33,7 @@ EVENT_FETCH = "FETCH"
 EVENT_REBALANCE = "REBALANCE"
 EVENT_OFFSET_COMMIT = "OFFSET_COMMIT"
 EVENT_OAUTHBEARER_TOKEN_REFRESH = "OAUTHBEARER_TOKEN_REFRESH"
+EVENT_THROTTLE = "THROTTLE"
 
 _OP_TO_EVENT = {
     OpType.DR: EVENT_DR,
@@ -44,6 +45,7 @@ _OP_TO_EVENT = {
     OpType.REBALANCE: EVENT_REBALANCE,
     OpType.OFFSET_COMMIT: EVENT_OFFSET_COMMIT,
     OpType.OAUTHBEARER_REFRESH: EVENT_OAUTHBEARER_TOKEN_REFRESH,
+    OpType.THROTTLE: EVENT_THROTTLE,
 }
 
 
@@ -86,6 +88,12 @@ class Event:
     def log(self) -> Optional[tuple]:
         """LOG: (level, fac, message) (rd_kafka_event_log)."""
         return self.op.payload if self.op.type == OpType.LOG else None
+
+    def throttle(self) -> Optional[tuple]:
+        """THROTTLE: (broker_name, broker_id, throttle_ms)
+        (rd_kafka_event_throttle_time et al.)."""
+        return (self.op.payload if self.op.type == OpType.THROTTLE
+                else None)
 
     def rebalance(self) -> Optional[tuple]:
         """REBALANCE: (err_code, {topic: [partitions]})."""

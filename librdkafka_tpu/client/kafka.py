@@ -648,6 +648,10 @@ class Kafka:
             cb = self.conf.get("error_cb")
             if cb:
                 cb(op.payload)
+        elif op.type == OpType.THROTTLE:
+            cb = self.conf.get("throttle_cb")
+            if cb:
+                cb(*op.payload)       # (broker_name, broker_id, throttle_ms)
         elif op.type == OpType.STATS:
             cb = self.conf.get("stats_cb")
             if cb:

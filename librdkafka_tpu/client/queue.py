@@ -28,6 +28,7 @@ class OpType(enum.Enum):
     LOG = "log"
     REBALANCE = "rebalance"
     OFFSET_COMMIT = "offset_commit"
+    THROTTLE = "throttle"
     PARTITION_JOIN = "partition_join"
     PARTITION_LEAVE = "partition_leave"
     BROKER_WAKEUP = "wakeup"

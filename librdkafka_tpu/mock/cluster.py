@@ -174,6 +174,7 @@ class MockCluster:
         # fault injection
         self._err_stacks: dict[int, deque] = defaultdict(deque)
         self._rtt_ms: dict[int, float] = {}           # broker_id -> delay
+        self._throttle_ms: dict[int, int] = {}        # broker_id -> report
         self._down: set[int] = set()
         self.request_log: list[tuple[int, int]] = []  # (broker_id, api_key)
 
@@ -242,6 +243,12 @@ class MockCluster:
 
     def set_rtt(self, broker_id: int, rtt_ms: float) -> None:
         self._rtt_ms[broker_id] = rtt_ms
+
+    def set_broker_throttle(self, broker_id: int, throttle_ms: int) -> None:
+        """Report this throttle_time in every response from the broker
+        (reference rd_kafka_mock throttle injection)."""
+        with self._lock:
+            self._throttle_ms[broker_id] = throttle_ms
 
     def set_broker_down(self, broker_id: int, down: bool = True) -> None:
         with self._lock:
@@ -451,6 +458,10 @@ class MockCluster:
 
     def _respond(self, conn: _Conn, corrid: int, api: ApiKey, body: dict,
                  version: int | None = None):
+        tt = self._throttle_ms.get(conn.broker_id)
+        if tt and isinstance(body, dict) and "throttle_time_ms" in body:
+            body = dict(body)
+            body["throttle_time_ms"] = tt
         wire = apis.build_response(api, corrid, body, version=version)
         rtt = self._rtt_ms.get(conn.broker_id, 0)
         if rtt > 0:
