@@ -663,9 +663,10 @@ class Broker:
         # not read as "throttling stopped"
         if tt is not None and tt != self._last_throttle:
             self._last_throttle = tt
-            if self.rk.conf.get("throttle_cb"):
-                self.rk.rep.push(Op(OpType.THROTTLE,
-                                    payload=(self.name, self.nodeid, tt)))
+            # unconditional like ERR/STATS: the event-API path consumes
+            # THROTTLE ops without a throttle_cb configured
+            self.rk.rep.push(Op(OpType.THROTTLE,
+                                payload=(self.name, self.nodeid, tt)))
         if req.cb:
             req.cb(None, body)
 

@@ -189,6 +189,14 @@ class Kafka:
             from .broker import CodecWorker
             self.codec_worker = CodecWorker(self)
 
+        # OAUTHBEARER app-supplied token (set_oauthbearer_token; the
+        # refresh flow of rdkafka_sasl_oauthbearer.c's
+        # RD_KAFKA_OP_OAUTHBEARER_REFRESH machinery)
+        self._oauth_token = None      # (token, principal, expiry_unix)
+        self._oauth_failure = None
+        self._oauth_timer = None
+        self._oauth_cb_lock = threading.Lock()
+
         # TLS context — one per instance, shared by all broker threads
         # (reference: rd_kafka_ssl_ctx_init, rdkafka_ssl.c)
         from . import tls as _tls
@@ -992,6 +1000,66 @@ class Kafka:
             self.background.stop()
         if self.codec_worker is not None:
             self.codec_worker.stop()
+
+    # ------------------------------------------------------- oauthbearer --
+    def set_oauthbearer_token(self, token: str, lifetime_ms: int = 0,
+                              principal: str = "") -> None:
+        """App-supplied OAUTHBEARER token (rd_kafka_oauthbearer_set_token).
+        A refresh is scheduled at 80% of the token lifetime, firing the
+        oauthbearer_token_refresh_cb again (the previous schedule is
+        replaced, so proactive re-sets don't accumulate timers)."""
+        expiry = (time.time() + lifetime_ms / 1000.0) if lifetime_ms else 0
+        self._oauth_token = (token, principal, expiry)
+        self._oauth_failure = None
+        if self._oauth_timer is not None:
+            self.timers.stop(self._oauth_timer)
+            self._oauth_timer = None
+        if lifetime_ms > 0 and self.conf.get("oauthbearer_token_refresh_cb"):
+            self._oauth_timer = self.timers.add(
+                max(1.0, lifetime_ms / 1000.0 * 0.8),
+                self._oauth_refresh_fire, once=True)
+
+    def set_oauthbearer_token_failure(self, errstr: str) -> None:
+        """(rd_kafka_oauthbearer_set_token_failure) — the failure stands
+        until the next refresh attempt, which clears it and retries."""
+        self._oauth_failure = errstr
+
+    def _oauth_refresh_fire(self):
+        """Invoke the app's refresh cb. Serialized: concurrent broker
+        reconnects must not fan out duplicate token fetches (the
+        reference guarantees single-threaded cb invocation)."""
+        cb = self.conf.get("oauthbearer_token_refresh_cb")
+        if cb is None or self.terminating:
+            return
+        with self._oauth_cb_lock:
+            if self._oauth_token_fresh():
+                return              # another thread already refreshed
+            self._oauth_failure = None    # each attempt starts clean
+            try:
+                cb(self, self.conf.get("sasl.oauthbearer.config"))
+            except Exception as e:
+                self._oauth_failure = repr(e)
+                self.log("ERROR", f"oauthbearer refresh cb raised: {e!r}")
+
+    def _oauth_token_fresh(self) -> bool:
+        t = self._oauth_token
+        if t is None:
+            return False
+        _tok, _principal, expiry = t
+        return not expiry or time.time() < expiry
+
+    def get_oauthbearer_token(self):
+        """Token for the SASL client: a fresh app-set token, else invoke
+        the refresh callback (which must call set_oauthbearer_token).
+        Returns the (token, principal, expiry) tuple or None — None with
+        a refresh cb configured is an authentication FAILURE, never an
+        unsecured-JWS fallback."""
+        if not self._oauth_token_fresh():
+            if self.conf.get("oauthbearer_token_refresh_cb") is not None:
+                self._oauth_refresh_fire()
+        if self._oauth_failure or not self._oauth_token_fresh():
+            return None
+        return self._oauth_token
 
     # ----------------------------------------------------------- security --
     def ssl_ctx(self):

@@ -52,7 +52,11 @@ def sasl_client_start(rk: "Kafka", broker: "Broker") -> None:
     elif mech in ("SCRAM-SHA-256", "SCRAM-SHA-512"):
         client = ScramClient(rk, mech)
     elif mech == "OAUTHBEARER":
-        client = OauthBearerClient(rk)
+        try:
+            client = OauthBearerClient(rk)
+        except KafkaException as e:
+            broker.sasl_done(e.error)   # clean auth failure + backoff
+            return
     else:
         broker.sasl_done(KafkaError(
             Err._UNSUPPORTED_FEATURE,
@@ -176,8 +180,23 @@ class OauthBearerClient:
                    rk.conf.get("sasl.oauthbearer.config").split(",") if "=" in kv)
         self.principal = cfg.get("principal", rk.conf.get("sasl.username")
                                  or "user")
-        self.token = self._unsecured_jws(self.principal,
-                                         int(cfg.get("lifeSeconds", "3600")))
+        # app-supplied token via set_oauthbearer_token / the refresh
+        # callback takes precedence; with a refresh cb configured, a
+        # missing/failed/expired token FAILS auth — never a silent
+        # unsecured-JWS fallback against a real broker
+        got = rk.get_oauthbearer_token()
+        if got is not None:
+            self.token, principal, _exp = got
+            if principal:
+                self.principal = principal
+        elif rk.conf.get("oauthbearer_token_refresh_cb") is not None:
+            raise KafkaException(
+                Err._AUTHENTICATION,
+                "OAUTHBEARER token unavailable: "
+                + (rk._oauth_failure or "refresh callback set no token"))
+        else:
+            self.token = self._unsecured_jws(
+                self.principal, int(cfg.get("lifeSeconds", "3600")))
 
     @staticmethod
     def _b64url(b: bytes) -> str:
