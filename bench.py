@@ -94,10 +94,13 @@ def codec_offload():
     blocks = [rng.integers(0, 256, blk, dtype=np.uint8).tobytes()
               for _ in range(B)]
 
-    # --- CPU provider ----------------------------------------------------
-    t0 = time.perf_counter()
-    ref = cpu.crc32c_many(blocks)
-    cpu_ms = (time.perf_counter() - t0) * 1000
+    # --- CPU provider (median of 5; same statistic as the TPU side) -----
+    cpu_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ref = cpu.crc32c_many(blocks)
+        cpu_times.append((time.perf_counter() - t0) * 1000)
+    cpu_ms = sorted(cpu_times)[2]
 
     # --- transport probe -------------------------------------------------
     h = np.zeros((4, blk), np.uint8)
@@ -108,34 +111,55 @@ def codec_offload():
                                                  1e-9)
 
     # --- TPU CRC: one-matmul MXU kernel, amortized device time ----------
-    fn = cj._jit_mxu(B)
+    # measure what the provider actually launches: batches pad to the
+    # 128-row MXU tile floor (a 64-row launch leaves the systolic array
+    # half idle and is ~1.6x SLOWER than the padded 128-row one)
+    Bp = max(B, 128)
+    fn = cj._jit_mxu(Bp)
     data, lens = pad_left(blocks, blk)
+    if Bp > B:
+        data = np.concatenate([data, np.zeros((Bp - B, blk), np.uint8)])
+        lens = np.concatenate([lens, np.zeros((Bp - B,), lens.dtype)])
     terms = np.array([cj._term_host(int(n)) for n in lens], dtype=np.uint32)
     d1 = jax.device_put(data)
-    d2 = jax.device_put(data[::-1].copy())
     dtm = jax.device_put(terms)
     out = _sync(fn(d1, dtm))                    # compile + exactness check
-    assert [int(x) for x in out.astype(np.uint32)] == list(ref), \
+    assert [int(x) for x in out.astype(np.uint32)[:B]] == list(ref), \
         "TPU CRC not bit-exact"
     t0 = time.perf_counter()
     _sync(fn(d1, dtm))
     rtt1 = (time.perf_counter() - t0) * 1000     # 1 launch + readback
 
-    def loop_ms(k):
-        t = time.perf_counter()
-        for i in range(k):
-            r = fn(d1 if i % 2 == 0 else d2, dtm)
-        _sync(r)
-        return (time.perf_counter() - t) * 1000
+    # Device time via in-graph repetition: ONE compiled call runs the
+    # kernel R times under lax.fori_loop (xor-accumulated so nothing is
+    # dead-code-eliminated), so the tunnel's per-dispatch cost appears
+    # exactly once per measurement and cancels in the difference
+    # T(R2)-T(R1). Every prior scheme (per-launch loops, two-loop
+    # differencing) swung 5x run-to-run through the shared tunnel.
+    import jax.numpy as jnp
 
-    # per-launch device time by differencing two loop lengths (cancels
-    # the tunnel's constant round-trip term); median of 3 estimates —
-    # single-sample subtraction swings the result by >10x run to run
-    ests = []
-    for _ in range(3):
-        t5, t25 = loop_ms(5), loop_ms(25)
-        ests.append((t25 - t5) / 20.0)
-    tpu_crc_ms = max(sorted(ests)[1], 1e-3)
+    stack = jax.device_put(np.stack([data, data[::-1].copy()] * 5))  # (10,B,N)
+
+    def make_multi(R):
+        def multi(st, terms):
+            def body(i, acc):
+                return acc ^ fn(st[i % 10], terms)
+            return jax.lax.fori_loop(0, R, body,
+                                     jnp.zeros((Bp,), jnp.uint32))
+        return jax.jit(multi, static_argnums=())
+
+    m1, m2 = make_multi(2), make_multi(102)
+    _sync(m1(stack, dtm)); _sync(m2(stack, dtm))     # compile both
+
+    def timed(m):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            _sync(m(stack, dtm))
+            ts.append((time.perf_counter() - t0) * 1000)
+        return sorted(ts)[2]          # median of 5
+
+    tpu_crc_ms = max((timed(m2) - timed(m1)) / 100.0, 1e-3)
 
     # --- TPU lz4 block encoder: one measured launch, 4x64KB -------------
     lz4_ms = None

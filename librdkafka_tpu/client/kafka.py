@@ -1017,22 +1017,24 @@ class Kafka:
         if lifetime_ms > 0 and self.conf.get("oauthbearer_token_refresh_cb"):
             self._oauth_timer = self.timers.add(
                 max(1.0, lifetime_ms / 1000.0 * 0.8),
-                self._oauth_refresh_fire, once=True)
+                lambda: self._oauth_refresh_fire(force=True), once=True)
 
     def set_oauthbearer_token_failure(self, errstr: str) -> None:
         """(rd_kafka_oauthbearer_set_token_failure) — the failure stands
         until the next refresh attempt, which clears it and retries."""
         self._oauth_failure = errstr
 
-    def _oauth_refresh_fire(self):
+    def _oauth_refresh_fire(self, force: bool = False):
         """Invoke the app's refresh cb. Serialized: concurrent broker
         reconnects must not fan out duplicate token fetches (the
-        reference guarantees single-threaded cb invocation)."""
+        reference guarantees single-threaded cb invocation).
+        ``force`` is the proactive 80%-lifetime timer path — the token
+        is still fresh there by construction, that's the point."""
         cb = self.conf.get("oauthbearer_token_refresh_cb")
         if cb is None or self.terminating:
             return
         with self._oauth_cb_lock:
-            if self._oauth_token_fresh():
+            if not force and self._oauth_token_fresh():
                 return              # another thread already refreshed
             self._oauth_failure = None    # each attempt starts clean
             try:

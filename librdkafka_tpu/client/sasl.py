@@ -189,11 +189,15 @@ class OauthBearerClient:
             self.token, principal, _exp = got
             if principal:
                 self.principal = principal
-        elif rk.conf.get("oauthbearer_token_refresh_cb") is not None:
+        elif (rk.conf.get("oauthbearer_token_refresh_cb") is not None
+                or rk._oauth_token is not None):
+            # a configured refresh cb OR a previously app-set (now
+            # expired/failed) token means the app owns credentials —
+            # failing auth beats fabricating an unsecured JWS
             raise KafkaException(
                 Err._AUTHENTICATION,
                 "OAUTHBEARER token unavailable: "
-                + (rk._oauth_failure or "refresh callback set no token"))
+                + (rk._oauth_failure or "token expired or not set"))
         else:
             self.token = self._unsecured_jws(
                 self.principal, int(cfg.get("lifeSeconds", "3600")))

@@ -336,7 +336,14 @@ def crc32c_many_mxu(buffers: list[bytes], *,
     jit = _jit_mxu_pallas if pallas else _jit_mxu
     for start in range(0, len(blocks), _MXU_MAX_B):
         chunk = blocks[start:start + _MXU_MAX_B]
+        # the MXU systolic tile is 128 rows: a 64-row launch leaves the
+        # array half idle and runs slower than a zero-padded 128-row one
+        # (measured: 64x64KB = 0.77ms raw vs 0.48ms padded). Only pad
+        # near the tile size — tiny batches would pay up to 128x in
+        # host->device transfer for zeros
         B = next_pow2(len(chunk))
+        if len(chunk) >= 64:
+            B = max(B, 128)
         data, lens = pad_left(chunk, blk)
         if len(chunk) < B:
             data = np.concatenate(
