@@ -741,7 +741,7 @@ class Broker:
                             break
                         msgs = list(tp.retry_batches.popleft())
                         tp.inflight_msgids.add(msgs[0].msgid)
-                    tp.inflight += 1
+                        tp.inflight += 1
                     ready.append((tp, msgs,
                                   None if legacy else
                                   self._make_writer(tp, msgs, codec)))
@@ -773,11 +773,15 @@ class Broker:
                     q.popleft()
                     msgs.append(m)
                     sz += m.size
+                # pop + in-flight claim are ONE critical section: the
+                # DRAIN rebase observes inflight and the queues under
+                # this same lock, so a popped batch is never invisible
+                # to both
+                if msgs:
+                    tp.inflight_msgids.add(msgs[0].msgid)
+                    tp.inflight += 1
             if not msgs:
                 continue
-            with tp.lock:
-                tp.inflight_msgids.add(msgs[0].msgid)
-            tp.inflight += 1
             ready.append((tp, msgs,
                           None if legacy else
                           self._make_writer(tp, msgs, codec)))

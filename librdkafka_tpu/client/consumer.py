@@ -241,8 +241,14 @@ class Consumer:
         rk = self._rk
         tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
         tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
-        if tp.version != version or (tp.topic, tp.partition) not in \
-                self._assignment and rk.cgrp is not None:
+        # Stale when the partition was seeked/paused since the fetch
+        # (version barrier) OR when it has been revoked from the current
+        # assignment.  The revocation check applies to group and simple
+        # consumers alike — assign()/unassign() maintain _assignment in
+        # both modes (reference: rd_kafka_op_version_outdated plus the
+        # fetchq disconnect on rd_kafka_toppar_fetch_stop).
+        if (tp.version != version
+                or (tp.topic, tp.partition) not in self._assignment):
             return None     # stale: accounting released above
         tp.app_offset = msg.offset + 1
         if self._auto_store:

@@ -129,6 +129,9 @@ class BackgroundThread:
                 self.event_cb(Event(op))
             except Exception as e:
                 self.rk.log("ERROR", f"background_event_cb raised: {e!r}")
+            finally:
+                if op.type == OpType.DR:
+                    self.rk._dr_served(len(op.payload))
 
     def stop(self):
         self._stop.set()

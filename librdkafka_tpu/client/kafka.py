@@ -67,16 +67,22 @@ class IdempotenceManager:
                 # DRAIN_BUMP → REQ_PID, rdkafka_idempotence.c:374-440)
                 with self.rk._toppars_lock:
                     tps = list(self.rk._toppars.values())
-                if any(t.inflight > 0 for t in tps):
-                    return
                 for t in tps:
                     with t.lock:
+                        # inflight must be observed atomically with the
+                        # queue scan: broker threads pop a batch and
+                        # claim inflight under this same lock, so per
+                        # toppar either the pop already happened
+                        # (inflight > 0 → wait) or the batch is still
+                        # queued and counted in `pending` below
+                        if t.inflight > 0:
+                            return
                         pending = [m.msgid
                                    for b in t.retry_batches for m in b]
                         pending += [m.msgid for m in t.xmit_msgq]
                         pending += [m.msgid for m in t.msgq]
-                    t.epoch_base_msgid = (min(pending, default=t.next_msgid)
-                                          - 1)
+                        t.epoch_base_msgid = (
+                            min(pending, default=t.next_msgid) - 1)
                 self.state = "INIT"
             if self.state in ("INIT", "RETRY"):
                 broker = self.rk.any_up_broker()
@@ -145,6 +151,13 @@ class Kafka:
         self.fatal_error: Optional[KafkaError] = None
         self.msg_cnt = 0                       # queue.buffering.max.messages
         self.msg_bytes = 0                     # queue.buffering.max.kbytes
+        # DR ops pushed to the reply queue but not yet served to the app.
+        # flush() must wait on msg_cnt + dr_cnt, like the reference's
+        # rd_kafka_outq_len which counts undelivered DR ops
+        # (rdkafka.c:3905) — otherwise flush() can return between the
+        # msg_cnt decrement and the DR callback, losing the report to a
+        # post-flush close.
+        self.dr_cnt = 0
         self._msg_cnt_lock = threading.Lock()
         self._max_msgs = conf.get("queue.buffering.max.messages")
         self._max_msg_bytes = conf.get("queue.buffering.max.kbytes") * 1024
@@ -604,15 +617,13 @@ class Kafka:
     def dr_msgq(self, msgs: list[Message], err: Optional[KafkaError]):
         """Queue delivery reports (reference: rd_kafka_dr_msgq,
         rdkafka_broker.c:2432)."""
-        with self._msg_cnt_lock:
-            self.msg_cnt -= len(msgs)
-            self.msg_bytes -= sum(m.size for m in msgs)
         if err is not None:
             for m in msgs:
                 m.error = err
         if self.interceptors:
             for m in msgs:
                 self.interceptors.on_acknowledgement(m)
+        out = []
         if (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
                 or "dr" in self.conf.get("enabled_events")
                 or self.background is not None
@@ -620,9 +631,16 @@ class Kafka:
             only_err = self.conf.get("delivery.report.only.error")
             out = msgs if (err or not only_err) else \
                 [m for m in msgs if m.error]
-            if out:
-                # one DR op per batch, not per message (queue-push overhead)
-                self.rep.push(Op(OpType.DR, payload=out))
+        # msg_cnt release and dr_cnt claim must be ONE atomic step:
+        # a flush() reading between them would see outstanding == 0 and
+        # return before the DR reaches the app
+        with self._msg_cnt_lock:
+            self.msg_cnt -= len(msgs)
+            self.msg_bytes -= sum(m.size for m in msgs)
+            self.dr_cnt += len(out)
+        if out:
+            # one DR op per batch, not per message (queue-push overhead)
+            self.rep.push(Op(OpType.DR, payload=out))
 
     def poll(self, timeout: float = 0.0) -> int:
         """Serve the app reply queue: DRs, errors, stats, logs
@@ -643,15 +661,25 @@ class Kafka:
         callback dispatch of poll()."""
         from .event import Event
         op = self.rep.pop(timeout)
+        if op is not None and op.type == OpType.DR:
+            self._dr_served(len(op.payload))
         return Event(op) if op is not None else None
+
+    def _dr_served(self, n: int) -> None:
+        """A DR op reached the app (callback fired / event popped)."""
+        with self._msg_cnt_lock:
+            self.dr_cnt -= n
 
     def _serve_rep_op(self, op: Op):
         if op.type == OpType.DR:
             cb = self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
-            for m in op.payload:
-                mcb = m.on_delivery or cb
-                if mcb:
-                    mcb(m.error, m)
+            try:
+                for m in op.payload:
+                    mcb = m.on_delivery or cb
+                    if mcb:
+                        mcb(m.error, m)
+            finally:
+                self._dr_served(len(op.payload))
         elif op.type == OpType.ERR:
             cb = self.conf.get("error_cb")
             if cb:
@@ -670,6 +698,12 @@ class Kafka:
         elif op.cb:
             op.cb(op)
 
+    @property
+    def outq_len(self) -> int:
+        """rd_kafka_outq_len: unacked messages + undelivered DR ops."""
+        with self._msg_cnt_lock:
+            return self.msg_cnt + self.dr_cnt
+
     def op_err(self, err: KafkaError):
         self.rep.push(Op(OpType.ERR, payload=err))
 
@@ -684,17 +718,31 @@ class Kafka:
         """Wait for all outstanding messages; returns count still queued
         (reference: rd_kafka_flush, rdkafka.c:3905)."""
         self.flushing = True
+        # DR-mode split (reference rk_drmode, rd_kafka_flush): with a dr
+        # callback, flush serves the reply queue itself; in event mode
+        # (enabled_events has "dr", no callback) it must NOT consume DR
+        # events destined for the app's queue_poll — it only waits for
+        # another thread (or the background thread) to drain them.
+        dr_event_mode = (
+            not (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb"))
+            and "dr" in self.conf.get("enabled_events")
+            and self.background is None)
         try:
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
                 with self._msg_cnt_lock:
-                    n = self.msg_cnt
+                    # undelivered DR ops count toward the outstanding
+                    # total (reference rd_kafka_outq_len, rdkafka.c:3905)
+                    n = self.msg_cnt + self.dr_cnt
                 if n == 0:
                     return 0
                 self._wake_all_brokers()
-                self.poll(0.01)
+                if dr_event_mode:
+                    time.sleep(0.01)
+                else:
+                    self.poll(0.01)
             with self._msg_cnt_lock:
-                return self.msg_cnt
+                return self.msg_cnt + self.dr_cnt
         finally:
             self.flushing = False
 

@@ -102,8 +102,8 @@ class Toppar:
         the requeue-or-DR decision (the DRAIN rebase on the main thread
         keys off inflight==0 — releasing early lets it rebase past
         messages still owned by a broker/codec thread)."""
-        self.inflight -= 1
         with self.lock:
+            self.inflight -= 1
             self.inflight_msgids.discard(msgs[0].msgid)
 
     def enqueue_retry_batch(self, msgs: list[Message]) -> None:

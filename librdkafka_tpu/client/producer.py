@@ -55,7 +55,11 @@ class Producer:
         self._rk.purge(in_queue, in_flight)
 
     def __len__(self) -> int:
-        return self._rk.msg_cnt
+        # rd_kafka_outq_len semantics: unacked messages PLUS undelivered
+        # delivery-report ops (rdkafka.c:3905) — the documented
+        # `while len(p): p.poll(...)` drain pattern must not exit while
+        # DR callbacks are still queued
+        return self._rk.outq_len
 
     def close(self, timeout: float = 5.0):
         self._rk.close(timeout)

@@ -1,0 +1,116 @@
+"""flush()/DR delivery contract and Consumer._deliver staleness.
+
+The reference's ``rd_kafka_flush`` waits on ``rd_kafka_outq_len``
+(rdkafka.c:3905), which counts *undelivered delivery-report ops* — not
+just unacked messages.  flush() returning before the DR callback fires
+loses the report to a post-flush close; these tests pin the contract.
+
+``Consumer._deliver`` must drop a message when the partition was
+seeked/paused since the fetch (version barrier) OR revoked from the
+assignment — on group AND simple consumers alike (reference:
+rd_kafka_op_version_outdated + fetchq disconnect on fetch_stop).
+"""
+import time
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.consumer import TopicPartition
+from librdkafka_tpu.client.msg import Message
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+def test_flush_waits_for_dr_delivery():
+    """Every DR callback must have fired by the time flush() returns 0."""
+    cluster = MockCluster(num_brokers=1, topics={"fdr": 1})
+    delivered = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 0,
+                  "dr_msg_cb": lambda err, m: delivered.append(m)})
+    try:
+        # many small rounds: the race window is between the msg_cnt
+        # decrement and the DR op being served
+        for round_i in range(20):
+            sent = 5
+            for i in range(sent):
+                p.produce("fdr", value=b"x%d.%d" % (round_i, i), partition=0)
+            rem = p.flush(10.0)
+            assert rem == 0, f"round {round_i}: {rem} outstanding"
+            assert len(delivered) == (round_i + 1) * sent, \
+                (f"round {round_i}: flush returned before DRs delivered "
+                 f"({len(delivered)} != {(round_i + 1) * sent})")
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_deliver_version_stale_simple_consumer():
+    """A version-stale message on a simple (group-less) consumer is
+    dropped even though the partition is still assigned."""
+    cluster = MockCluster(num_brokers=1, topics={"st": 1})
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers()})
+    try:
+        assert c._rk.cgrp is None
+        c.assign([TopicPartition("st", 0)])
+        tp = c._assignment[("st", 0)]
+        fresh = Message("st", value=b"v", partition=0)
+        fresh.offset = 7
+        assert c._deliver(tp, fresh, tp.version) is fresh
+        stale = Message("st", value=b"v", partition=0)
+        stale.offset = 8
+        assert c._deliver(tp, stale, tp.version - 1) is None
+        # the stale drop must not advance the app offset
+        assert tp.app_offset == 8
+    finally:
+        c.close()
+        cluster.stop()
+
+
+def test_deliver_revoked_partition_dropped():
+    """A message from a revoked partition is dropped — with and without
+    a consumer group."""
+    cluster = MockCluster(num_brokers=1, topics={"rv": 1})
+    for conf in ({"bootstrap.servers": cluster.bootstrap_servers()},
+                 {"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "grv"}):
+        c = Consumer(dict(conf))
+        try:
+            c.assign([TopicPartition("rv", 0)])
+            tp = c._assignment[("rv", 0)]
+            ver = tp.version
+            m = Message("rv", value=b"v", partition=0)
+            m.offset = 0
+            assert c._deliver(tp, m, ver) is m
+            c.unassign()
+            late = Message("rv", value=b"v", partition=0)
+            late.offset = 1
+            assert c._deliver(tp, late, ver) is None
+        finally:
+            c.close()
+    cluster.stop()
+
+
+def test_flush_with_event_api_accounts_drs():
+    """With no dr callback but DR events enabled, flush() still waits
+    for the DR ops to be consumable and queue_poll drains them."""
+    cluster = MockCluster(num_brokers=1, topics={"fev": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 0, "enabled_events": "dr"})
+    rk = p._rk
+    try:
+        for i in range(3):
+            p.produce("fev", value=b"e%d" % i, partition=0)
+        # event mode: flush() must NOT consume the DR events itself —
+        # with nothing draining the queue it times out with them intact
+        assert p.flush(0.5) > 0
+        deadline = time.monotonic() + 10
+        got = 0
+        while got < 3 and time.monotonic() < deadline:
+            ev = rk.queue_poll(0.1)
+            if ev is not None and ev.type == "DR":
+                got += len(ev.messages())
+        assert got == 3
+        with rk._msg_cnt_lock:
+            assert rk.dr_cnt == 0 and rk.msg_cnt == 0
+        assert p.flush(5.0) == 0
+    finally:
+        p.close()
+        cluster.stop()
