@@ -220,8 +220,23 @@ PROPERTIES: list[Prop] = [
        "the broker thread (pipeline overlap of batch build vs codec).",
        vmin=0, vmax=64, app=P),
     _p("tpu.mesh.devices", GLOBAL, "int", 0,
-       "Number of devices to shard codec launches over (0 = all local).",
+       "Number of devices to shard the DEVICE lz4 encoder's block "
+       "compression over (0 = all local). Only reachable with "
+       "tpu.lz4.force=true — default routing runs lz4 on CPU.",
        vmin=0, vmax=8192),
+    _p("tpu.transport.min.mb.s", GLOBAL, "int", 100,
+       "Adaptive offload gate: minimum measured host->device bandwidth "
+       "(MB/s) for CRC32C launches to leave the host. Below it (e.g. a "
+       "slow dev tunnel) every launch costs more in transfer than the "
+       "whole CPU checksum, so the provider self-routes to CPU. "
+       "0 disables the gate.", vmin=0, vmax=1_000_000),
+    _p("tpu.lz4.force", GLOBAL, "bool", False,
+       "Route lz4 block compression to the device encoder even though it "
+       "is slower than the native CPU path (PERF.md: LZ4's match search "
+       "is gather/sort-bound, ~3 orders of magnitude off CPU on TPU "
+       "vector units). Default off: backend=tpu runs lz4 on CPU and only "
+       "CRC32C on the MXU, so the TPU backend is never slower than cpu.",
+       app=P),
     # ---- callbacks / opaque ----
     _p("error_cb", GLOBAL, "ptr", None, "Error callback."),
     _p("throttle_cb", GLOBAL, "ptr", None, "Throttle callback."),

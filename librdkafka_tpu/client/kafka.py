@@ -185,7 +185,9 @@ class Kafka:
             from ..ops.tpu import TpuCodecProvider
             self.codec_provider = TpuCodecProvider(
                 min_batches=conf.get("tpu.launch.min.batches"),
-                mesh_devices=conf.get("tpu.mesh.devices"))
+                mesh_devices=conf.get("tpu.mesh.devices"),
+                lz4_force=conf.get("tpu.lz4.force"),
+                min_transport_mb_s=conf.get("tpu.transport.min.mb.s"))
         else:
             from ..ops.cpu import CpuCodecProvider
             self.codec_provider = CpuCodecProvider()

@@ -46,15 +46,30 @@ class TpuCodecProvider:
     name = "tpu"
 
     def __init__(self, min_batches: int = 4, warmup: bool = True,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0, lz4_force: bool = False,
+                 min_transport_mb_s: float = 100.0):
         # below this many independent buffers a launch isn't worth it;
         # fall back to the CPU provider (identical bytes either way).
         self.min_batches = max(1, int(min_batches))
         # tpu.mesh.devices: >1 shards block compression over a 1-D
         # jax.sharding.Mesh (parallel/mesh.py shard_map scale-out)
         self.mesh_devices = int(mesh_devices or 0)
+        # tpu.lz4.force: the device lz4 encoder is measured ~3 orders of
+        # magnitude slower than the native CPU path (PERF.md §3 —
+        # gather/sort-bound match search), so backend=tpu routes lz4 to
+        # CPU and keeps only CRC32C on the MXU unless explicitly forced
+        self.lz4_force = bool(lz4_force)
+        # Adaptive offload gate: CRC offload only pays when host<->device
+        # bandwidth beats the CPU provider's ~1 GB/s CRC rate by enough
+        # margin.  On a real TPU VM PCIe measures GB/s and the gate stays
+        # open; behind a slow dev tunnel (MB/s) every launch would cost
+        # more in transfer than the whole CPU checksum, so the provider
+        # self-routes to CPU.  0 disables the gate (always offload).
+        self.min_transport_mb_s = float(min_transport_mb_s)
+        self.transport_mb_s: float | None = None      # measured by probe
         self._mesh = None
         self._cpu = _cpu.CpuCodecProvider()
+        self._warmup_thread = None
         if warmup:
             # compile the fixed-shape kernels off the critical path (the
             # 64KB lz4 block kernel costs ~20 s of XLA compile; the CRC
@@ -62,19 +77,27 @@ class TpuCodecProvider:
             import threading
 
             def _warm():
-                # shapes must match real traffic: the lz4 kernel caches
+                # probe transport FIRST: when the gate is closed every
+                # launch self-routes to CPU, so the (expensive, GIL-
+                # chewing) XLA compiles would never be used — skip them.
+                # Shapes must match real traffic: the lz4 kernel caches
                 # per next_pow2(block len) — 64KB is the production
                 # block size — and the CRC matmul caches per pow2 batch
                 # bucket, so warm the full-chunk bucket too
                 try:
+                    if not self._offload_pays() and not self.lz4_force:
+                        return
                     blk = b"\x00" * LZ4F_BLOCKSIZE
-                    lz4_block_compress_many([blk])
-                    _crc32c_many_mxu([blk] * self.min_batches)
+                    if self.lz4_force:
+                        lz4_block_compress_many([blk])
+                    if self._offload_pays():
+                        _crc32c_many_mxu([blk] * self.min_batches)
                 except Exception:
                     pass
 
-            threading.Thread(target=_warm, daemon=True,
-                             name="tpu-codec-warmup").start()
+            self._warmup_thread = threading.Thread(
+                target=_warm, daemon=True, name="tpu-codec-warmup")
+            self._warmup_thread.start()
 
     # -------------------------------------------------------------- lz4 --
 
@@ -113,6 +136,44 @@ class TpuCodecProvider:
             out.append(b"".join(parts))
         return out
 
+    def wait_warm(self, timeout: float = 120.0) -> None:
+        """Block until the async warmup (probe + kernel compiles) ends."""
+        t = getattr(self, "_warmup_thread", None)
+        if t is not None:
+            t.join(timeout)
+
+    def _probe_transport(self) -> float:
+        """Measure host<->device bandwidth once (warm path, 256KB).
+
+        The probe is a full round trip (device_put + host readback) —
+        the only sync that is reliable on every platform (a tunneled
+        device can return from block_until_ready before bytes land) —
+        so the rate counts the bytes moved in BOTH directions.  A probe
+        failure is cached as 0.0: a broken device must not re-raise
+        inside the broker serve loop on every batch."""
+        if self.transport_mb_s is None:
+            try:
+                import time
+
+                import jax
+
+                h = np.zeros((4, LZ4F_BLOCKSIZE), np.uint8)
+                np.asarray(jax.device_put(h))         # warm the path
+                t0 = time.perf_counter()
+                np.asarray(jax.device_put(h))
+                dt = max(time.perf_counter() - t0, 1e-9)
+                self.transport_mb_s = (2 * h.nbytes / (1 << 20)) / dt
+            except Exception:
+                self.transport_mb_s = 0.0
+        return self.transport_mb_s
+
+    def _offload_pays(self) -> bool:
+        """True when the measured transport clears the gate (or the gate
+        is disabled).  Probes lazily if the warmup thread hasn't yet."""
+        if self.min_transport_mb_s <= 0:
+            return True
+        return self._probe_transport() >= self.min_transport_mb_s
+
     def _get_mesh(self):
         if self._mesh is None and self.mesh_devices > 1:
             import jax
@@ -126,7 +187,11 @@ class TpuCodecProvider:
 
     def compress_many(self, codec: str, bufs: list[bytes], level: int = -1
                       ) -> list[bytes]:
-        if codec == "lz4" and len(bufs) >= self.min_batches:
+        # lz4 compresses on the native CPU path unless tpu.lz4.force:
+        # wire bytes are identical either way, and the device encoder
+        # only exists to prove bit-exactness, not to win (PERF.md §3)
+        if (codec == "lz4" and self.lz4_force
+                and len(bufs) >= self.min_batches):
             return self._lz4f_compress_many(bufs)
         return self._cpu.compress_many(codec, bufs, level)
 
@@ -135,8 +200,8 @@ class TpuCodecProvider:
         return self._cpu.decompress_many(codec, bufs, size_hints)
 
     def crc32c_many(self, bufs: list[bytes]) -> list[int]:
-        if len(bufs) >= self.min_batches:
+        if len(bufs) >= self.min_batches and self._offload_pays():
             # ONE GF(2) matmul per 64KB block on the MXU (crc32c_jax.py;
-            # 3.9x native CPU at 64x64KB in device time on v5e-1)
+            # 8.5x native CPU at 128x64KB in device time on v5e-1)
             return [int(x) for x in _crc32c_many_mxu(bufs)]
         return self._cpu.crc32c_many(bufs)

@@ -20,7 +20,65 @@ from test_0017_codecs import CORPORA, IDS
 
 @pytest.fixture(scope="module")
 def tpu_provider():
-    return TpuCodecProvider(min_batches=1)
+    # lz4_force=True: this suite exists to prove the DEVICE lz4 encoder
+    # is bit-exact.  Production routing (tpu.lz4.force=false, default)
+    # keeps lz4 on the native CPU path — see test_lz4_routes_to_cpu.
+    # min_transport_mb_s=0: the gate must not silently route these
+    # equivalence tests to the CPU provider on slow transport.
+    return TpuCodecProvider(min_batches=1, lz4_force=True,
+                            min_transport_mb_s=0)
+
+
+def test_lz4_routes_to_cpu_by_default(monkeypatch):
+    """backend=tpu must never be slower than cpu: without tpu.lz4.force
+    the provider compresses lz4 on the native CPU path (identical
+    bytes) and only CRC32C rides the MXU (PERF.md §3 conclusion)."""
+    prov = TpuCodecProvider(min_batches=1, warmup=False)
+
+    def boom(bufs):
+        raise AssertionError("device lz4 ran without tpu.lz4.force")
+
+    monkeypatch.setattr(prov, "_lz4f_compress_many", boom)
+    bufs = [CORPORA["json_like"], CORPORA["near_64k"], b"tiny"]
+    assert (prov.compress_many("lz4", bufs)
+            == cpu.CpuCodecProvider().compress_many("lz4", bufs))
+    # conf plumbing: tpu.lz4.force reaches the provider
+    from librdkafka_tpu.client.conf import Conf
+    c = Conf()
+    c.update({"tpu.lz4.force": True})
+    assert c.get("tpu.lz4.force") is True
+    assert TpuCodecProvider(min_batches=1, warmup=False,
+                            lz4_force=c.get("tpu.lz4.force")).lz4_force
+
+
+def test_crc_transport_gate(monkeypatch):
+    """The adaptive offload gate routes CRC to CPU when the measured
+    host->device bandwidth is below tpu.transport.min.mb.s, and keeps
+    the device path when it clears (values bit-identical either way)."""
+    bufs = [CORPORA["semi"], CORPORA["random_1k"], b"", b"q"]
+    want = [crc32c(b) for b in bufs]
+
+    slow = TpuCodecProvider(min_batches=1, warmup=False,
+                            min_transport_mb_s=100.0)
+    slow.transport_mb_s = 2.0                     # a dev-tunnel reading
+    import librdkafka_tpu.ops.tpu as tpu_mod
+    monkeypatch.setattr(
+        tpu_mod, "_crc32c_many_mxu",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("device CRC ran below the transport gate")))
+    assert slow.crc32c_many(bufs) == want
+
+    fast = TpuCodecProvider(min_batches=1, warmup=False,
+                            min_transport_mb_s=100.0)
+    fast.transport_mb_s = 10_000.0                # PCIe-class reading
+    monkeypatch.setattr(tpu_mod, "_crc32c_many_mxu",
+                        crc32c_jax.crc32c_many_mxu)
+    assert fast.crc32c_many(bufs) == want
+    # gate disabled: offloads regardless of measured transport
+    off = TpuCodecProvider(min_batches=1, warmup=False,
+                           min_transport_mb_s=0)
+    off.transport_mb_s = 2.0
+    assert off.crc32c_many(bufs) == want
 
 
 # ------------------------------------------------------------------ crc32c --
