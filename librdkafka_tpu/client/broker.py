@@ -36,6 +36,7 @@ from ..protocol.proto import ApiKey
 from .errors import Err, KafkaError, KafkaException
 from .feature import (MSGVER1, MSGVER2, fallback_api_versions,
                       features_from_api_versions, pick_version)
+from .arena import ArenaBatch, batch_head_msgid
 from .msg import Message, MsgStatus
 from .queue import Op, OpQueue, OpType
 
@@ -185,6 +186,7 @@ class Broker:
         self._corrid = 0
         self._rbuf = bytearray()
         self._wbuf = bytearray()
+        self._wbuf_off = 0              # consumed prefix (offset send)
         self._wakeup_r, self._wakeup_w = socket.socketpair()
         self._wakeup_r.setblocking(False)
         # non-blocking: a full pipe must drop the wakeup byte (reader is
@@ -495,6 +497,7 @@ class Broker:
             self.sock = None
         self._rbuf.clear()
         self._wbuf.clear()
+        self._wbuf_off = 0
         self.fetch_inflight = False
         self._tls_handshaking = False
         # fail all in-flight + queued requests (callers decide on retry)
@@ -549,18 +552,48 @@ class Broker:
         self._flush_wbuf()
 
     def _flush_wbuf(self):
-        if not self.sock or not self._wbuf:
+        # offset-based consumption: `del wbuf[:n]` memmoves the whole
+        # remaining buffer per send() — with 1MB batches draining in
+        # ~64KB socket chunks that is ~16MB of GIL-held shifting per
+        # batch, felt by every other thread as produce latency
+        if not self.sock or self._wbuf_off >= len(self._wbuf):
             return
+        off = self._wbuf_off
+        err = None
+        mv = memoryview(self._wbuf)
         try:
-            while self._wbuf:
-                n = self.sock.send(self._wbuf)
-                del self._wbuf[:n]
-        except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+            total = len(mv)
+            while off < total:
+                # the chunk view is released explicitly: a raising
+                # send() pins the traceback (and with it any live
+                # buffer export), which would make the wbuf clear()
+                # below raise BufferError
+                chunk = mv[off:]
+                try:
+                    off += self.sock.send(chunk)
+                except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError,
+                        BlockingIOError, InterruptedError):
+                    break
+                except OSError as e:
+                    err = KafkaError(Err._TRANSPORT, f"send failed: {e}")
+                    break
+                finally:
+                    chunk.release()
+        finally:
+            mv.release()
+        if err is not None:
+            self._disconnect(err)
             return
-        except (BlockingIOError, InterruptedError):
-            return
-        except OSError as e:
-            self._disconnect(KafkaError(Err._TRANSPORT, f"send failed: {e}"))
+        if off >= len(self._wbuf):
+            self._wbuf.clear()
+            self._wbuf_off = 0
+        elif off >= (1 << 20):
+            # sustained backpressure: reclaim the consumed prefix so the
+            # buffer tracks OUTSTANDING bytes, not total-ever-sent
+            del self._wbuf[:off]
+            self._wbuf_off = 0
+        else:
+            self._wbuf_off = off
 
     def _io_serve(self, timeout: float = 0.005):
         """select() over socket + wakeup pipe
@@ -576,7 +609,7 @@ class Broker:
             if self.sock is None:    # _recv may have disconnected
                 return
             rlist.append(self.sock)
-            if self._wbuf:
+            if len(self._wbuf) > self._wbuf_off:
                 wlist.append(self.sock)
         try:
             r, w, _ = select.select(rlist, wlist, [], timeout)
@@ -625,17 +658,27 @@ class Broker:
         if not got:
             return
         self.c_rx_bytes += got
-        while len(self._rbuf) >= 4:
-            (n,) = struct.unpack(">i", self._rbuf[:4])
-            if n < 0 or n > self.rk.conf.get("receive.message.max.bytes"):
+        # offset-based frame walk: ONE buffer compaction per recv burst
+        # instead of a memmove per response
+        buf = self._rbuf
+        off = 0
+        blen = len(buf)
+        max_bytes = self.rk.conf.get("receive.message.max.bytes")
+        while blen - off >= 4:
+            (n,) = struct.unpack_from(">i", buf, off)
+            if n < 0 or n > max_bytes:
                 self._disconnect(KafkaError(Err._BAD_MSG,
                                             f"invalid frame size {n}"))
                 return
-            if len(self._rbuf) < 4 + n:
-                return
-            payload = bytes(self._rbuf[4:4 + n])
-            del self._rbuf[:4 + n]
+            if blen - off < 4 + n:
+                break
+            payload = bytes(buf[off + 4:off + 4 + n])
+            off += 4 + n
             self._handle_response(payload)
+            if self.sock is None:           # handler disconnected us
+                return                      # (_disconnect cleared _rbuf)
+        if off:
+            del buf[:off]
 
     def _handle_response(self, payload: bytes):
         (corrid,) = struct.unpack(">i", payload[:4])
@@ -739,14 +782,49 @@ class Broker:
                         # clears retry_batches from the app thread
                         if not tp.retry_batches:
                             break
-                        msgs = list(tp.retry_batches.popleft())
-                        tp.inflight_msgids.add(msgs[0].msgid)
+                        msgs = tp.retry_batches.popleft()
+                        if not isinstance(msgs, ArenaBatch):
+                            msgs = list(msgs)
+                        tp.inflight_msgids.add(batch_head_msgid(msgs))
                         tp.inflight += 1
                     ready.append((tp, msgs,
                                   None if legacy else
                                   self._make_writer(tp, msgs, codec)))
             if tp.retry_batches or tp.inflight >= max_inflight:
                 continue
+            # ---- native enqueue fast lane: form an ArenaBatch ----------
+            if tp.arena is not None and len(tp.arena):
+                if not tp.arena_ok:
+                    # records appended concurrently with a demotion:
+                    # convert them so the Message path below carries them
+                    rk._demote(tp)
+                    tp.xmit_move()
+                elif not tp.xmit_msgq:
+                    if now < tp.retry_backoff_until:
+                        continue
+                    first_us = tp.arena.first_enq_us()
+                    full = len(tp.arena) >= batch_max
+                    lingered = (first_us >= 0
+                                and now - first_us / 1e6 >= linger)
+                    if not (full or lingered or rk.flushing):
+                        continue
+                    with tp.lock:
+                        run = tp.arena.take(
+                            batch_max, rk.conf.get("message.max.bytes"))
+                        if run is None:
+                            continue
+                        b = ArenaBatch(*run)
+                        # batch msgid assignment: takes are FIFO and
+                        # exclusive under tp.lock, so sequence numbering
+                        # is identical to per-enqueue assignment
+                        b.msgid_base = tp.next_msgid
+                        tp.next_msgid += b.count
+                        tp.inflight_msgids.add(b.msgid_base)
+                        tp.inflight += 1
+                    ready.append((tp, b,
+                                  None if legacy else
+                                  self._make_writer(tp, b, codec)))
+                    continue
             if not tp.xmit_msgq or now < tp.retry_backoff_until:
                 continue
             # linger gate (rdkafka_broker.c:3453-3470)
@@ -794,10 +872,17 @@ class Broker:
         # batch's oldest+newest bound the window at 2 adds/batch instead
         # of N)
         for tp, msgs, _w in ready:
-            self.rk.stats.int_latency.add((now - msgs[0].enq_time) * 1e6)
-            if len(msgs) > 1:
+            if isinstance(msgs, ArenaBatch):
+                self.rk.stats.int_latency.add((now - msgs.enq_first) * 1e6)
+                if msgs.count > 1:
+                    self.rk.stats.int_latency.add(
+                        (now - msgs.enq_last) * 1e6)
+            else:
                 self.rk.stats.int_latency.add(
-                    (now - msgs[-1].enq_time) * 1e6)
+                    (now - msgs[0].enq_time) * 1e6)
+                if len(msgs) > 1:
+                    self.rk.stats.int_latency.add(
+                        (now - msgs[-1].enq_time) * 1e6)
         ts_codec = time.monotonic()
 
         # legacy broker (no MSGVER2): magic 0/1 messagesets via the v01
@@ -866,19 +951,25 @@ class Broker:
         self.rk.dr_msgq(msgs, KafkaError(Err._FAIL,
                                          f"batch codec failed: {exc!r}"))
 
-    def _make_writer(self, tp, msgs: list[Message], codec: str) -> MsgsetWriterV2:
+    def _make_writer(self, tp, msgs, codec: str) -> MsgsetWriterV2:
         rk = self.rk
         pid, epoch = (-1, -1)
         base_seq = -1
         if rk.idemp:
             pid, epoch = rk.idemp.pid, rk.idemp.epoch
-            base_seq = (msgs[0].msgid - 1 - tp.epoch_base_msgid) & 0x7FFFFFFF
+            base_seq = (batch_head_msgid(msgs) - 1
+                        - tp.epoch_base_msgid) & 0x7FFFFFFF
         w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
                            base_sequence=base_seq,
                            codec=None if codec == "none" else codec)
-        # Message duck-types Record (key/value/headers/timestamp) — no
-        # per-message conversion on the hot path
-        w.build(msgs, int(time.time() * 1000))
+        now_ms = int(time.time() * 1000)
+        if isinstance(msgs, ArenaBatch):
+            # fast lane: ONE native call straight off the arena buffers
+            w.build_arena(msgs, now_ms)
+        else:
+            # Message duck-types Record (key/value/headers/timestamp) —
+            # no per-message conversion on the hot path
+            w.build(msgs, now_ms)
         return w
 
     def _produce_legacy(self, ready: list, codec: str, now: float):
@@ -892,6 +983,10 @@ class Broker:
         provider = rk.codec_provider
         now_ms = int(time.time() * 1000)
         for tp, msgs, _writer in ready:
+            if isinstance(msgs, ArenaBatch):
+                # legacy brokers are off the fast path: materialize
+                # Messages (rare — pre-0.11 cluster)
+                msgs = msgs.to_messages(tp.topic)
             try:
                 compress_fn = None
                 use_codec = None if codec == "none" else codec
@@ -907,16 +1002,19 @@ class Broker:
                 continue
             self._send_produce(tp, msgs, wire, now, version=ver)
 
-    def _send_produce(self, tp, msgs: list[Message], wire: bytes, now: float,
+    def _send_produce(self, tp, msgs, wire: bytes, now: float,
                       version: Optional[int] = None):
         rk = self.rk
         tconf = rk.topic_conf_for(tp.topic)
         acks = tconf.get("request.required.acks")
         # NOTE: tp.inflight / inflight_msgids were accounted at batch
         # formation time in _producer_serve (DRAIN-rebase atomicity)
-        for m in msgs:
-            m.status = MsgStatus.POSSIBLY_PERSISTED
-            m.latency_us = int((now - m.enq_time) * 1e6)
+        if isinstance(msgs, ArenaBatch):
+            msgs.possibly_persisted = True
+        else:
+            for m in msgs:
+                m.status = MsgStatus.POSSIBLY_PERSISTED
+                m.latency_us = int((now - m.enq_time) * 1e6)
         req = Request(
             ApiKey.Produce,
             {"transactional_id": None, "acks": acks,
@@ -930,8 +1028,9 @@ class Broker:
         self._xmit(req)
         if acks == 0:
             tp.release_inflight(msgs)
-            for m in msgs:
-                m.offset = -1
+            if not isinstance(msgs, ArenaBatch):
+                for m in msgs:
+                    m.offset = -1
             rk.dr_msgq(msgs, None)
 
     def _handle_produce(self, tp, msgs: list[Message], err, resp):
@@ -964,14 +1063,16 @@ class Broker:
 
     def _handle_produce0(self, tp, msgs: list[Message], err, resp):
         rk = self.rk
+        fast = isinstance(msgs, ArenaBatch)
         if err is None:
             pres = resp["topics"][0]["partitions"][0]
             ec = Err.from_wire(pres["error_code"])
             if ec == Err.NO_ERROR:
                 base = pres["base_offset"]
-                if (rk.interceptors or rk.conf.get("dr_msg_cb")
-                        or rk.conf.get("dr_cb")
-                        or any(m.on_delivery is not None for m in msgs)):
+                if not fast and (rk.interceptors or rk.conf.get("dr_msg_cb")
+                                 or rk.conf.get("dr_cb")
+                                 or any(m.on_delivery is not None
+                                        for m in msgs)):
                     for i, m in enumerate(msgs):
                         m.offset = base + i if base >= 0 else -1
                         m.status = MsgStatus.PERSISTED
@@ -984,8 +1085,9 @@ class Broker:
         # error path
         if kerr.code in (Err.DUPLICATE_SEQUENCE_NUMBER,):
             # benign: broker already has these (idempotent dedup)
-            for m in msgs:
-                m.status = MsgStatus.PERSISTED
+            if not fast:
+                for m in msgs:
+                    m.status = MsgStatus.PERSISTED
             rk.dr_msgq(msgs, None)
             return
         if rk.idemp and kerr.code == Err.OUT_OF_ORDER_SEQUENCE_NUMBER:
@@ -997,13 +1099,13 @@ class Broker:
             # POSSIBLY_PERSISTED and resending under a fresh PID would
             # bypass broker dedup, so it is FATAL (reference:
             # rd_kafka_handle_Produce_error, rdkafka_request.c:2173 r==0).
+            head = batch_head_msgid(msgs)
             with tp.lock:
                 pending_earlier = (
-                    any(m.msgid < msgs[0].msgid for m in tp.xmit_msgq)
-                    or any(b[0].msgid < msgs[0].msgid
+                    any(m.msgid < head for m in tp.xmit_msgq)
+                    or any(batch_head_msgid(b) < head
                            for b in tp.retry_batches)
-                    or any(mid < msgs[0].msgid
-                           for mid in tp.inflight_msgids))
+                    or any(mid < head for mid in tp.inflight_msgids))
             if pending_earlier:
                 tp.enqueue_retry_batch(msgs)
                 tp.retry_backoff_until = time.monotonic() + \
@@ -1024,13 +1126,19 @@ class Broker:
                              Err.LEADER_NOT_AVAILABLE,
                              Err.UNKNOWN_TOPIC_OR_PART):
                 rk.metadata_refresh(reason=f"produce error {kerr.code.name}")
-            if rk.idemp:
+            if rk.idemp or fast:
                 # keep the batch frozen: membership must survive the retry
                 # for (BaseSequence, count) dup detection; budget is judged
-                # on the batch head
-                if msgs[0].retries < max_retries:
-                    for m in msgs:
-                        m.retries += 1
+                # on the batch head (fast-lane batches always travel
+                # whole — their records share one retry budget)
+                batch_retries = (msgs.retries if fast
+                                 else msgs[0].retries)
+                if batch_retries < max_retries:
+                    if fast:
+                        msgs.retries += 1
+                    else:
+                        for m in msgs:
+                            m.retries += 1
                     tp.enqueue_retry_batch(msgs)
                     tp.retry_backoff_until = time.monotonic() + \
                         rk.conf.get("retry.backoff.ms") / 1000.0

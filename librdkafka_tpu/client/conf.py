@@ -322,6 +322,19 @@ class _ConfBase:
             return self.set(prop.alias, value)
         self._values[prop.name] = self._coerce(prop, value)
         self._explicit.add(prop.name)
+        # mutation counter + listeners: cached eligibility decisions
+        # (e.g. the produce fast lane keyed on dr callbacks) revalidate
+        # on change
+        self.version = getattr(self, "version", 0) + 1
+        for cb in getattr(self, "_listeners", ()):
+            cb()
+
+    def add_listener(self, cb) -> None:
+        """Invoke ``cb()`` after every set() (post-creation conf
+        mutations must invalidate cached eligibility decisions)."""
+        if not hasattr(self, "_listeners"):
+            self._listeners = []
+        self._listeners.append(cb)
 
     def get(self, name: str) -> Any:
         prop = _BY_NAME.get(name)

@@ -21,6 +21,7 @@ from ..protocol import apis, proto
 from ..protocol.msgset import (iter_batches, parse_msgset_v01,
                                parse_records_v2, verify_crc_v2)
 from ..protocol.proto import ApiKey
+from .arena import ArenaBatch, arena_new, batch_msgids, lane_new
 from .broker import Broker, Request
 from .conf import Conf, TopicConf
 from .errors import Err, KafkaError, KafkaException
@@ -74,11 +75,16 @@ class IdempotenceManager:
                         # claim inflight under this same lock, so per
                         # toppar either the pop already happened
                         # (inflight > 0 → wait) or the batch is still
-                        # queued and counted in `pending` below
+                        # queued and counted in `pending` below.
+                        # Fast-lane arena records hold NO msgids yet
+                        # (assigned at take()): they will draw ids from
+                        # next_msgid onward, which the default already
+                        # rebases to.
                         if t.inflight > 0:
                             return
-                        pending = [m.msgid
-                                   for b in t.retry_batches for m in b]
+                        pending = []
+                        for b in t.retry_batches:
+                            pending += batch_msgids(b)
                         pending += [m.msgid for m in t.xmit_msgq]
                         pending += [m.msgid for m in t.msgq]
                         t.epoch_base_msgid = (
@@ -149,8 +155,11 @@ class Kafka:
         self.flushing = False
         self.terminating = False
         self.fatal_error: Optional[KafkaError] = None
-        self.msg_cnt = 0                       # queue.buffering.max.messages
-        self.msg_bytes = 0                     # queue.buffering.max.kbytes
+        # Queue accounting lives in the enqueue lane (native when the
+        # extension builds): C produce() updates the counters atomically
+        # under the GIL; Python paths go through lane.acct().  msg_cnt /
+        # msg_bytes remain readable as properties.
+        self._lane = lane_new()
         # DR ops pushed to the reply queue but not yet served to the app.
         # flush() must wait on msg_cnt + dr_cnt, like the reference's
         # rd_kafka_outq_len which counts undelivered DR ops
@@ -158,9 +167,9 @@ class Kafka:
         # msg_cnt decrement and the DR callback, losing the report to a
         # post-flush close.
         self.dr_cnt = 0
+        # serializes COMPOUND transitions (msg_cnt release + dr_cnt
+        # claim) against flush()'s combined read
         self._msg_cnt_lock = threading.Lock()
-        self._max_msgs = conf.get("queue.buffering.max.messages")
-        self._max_msg_bytes = conf.get("queue.buffering.max.kbytes") * 1024
         self.cgrp = None                       # set by Consumer
         self.consumer = None                   # back-ref set by Consumer
         self.interceptors = conf.get("interceptors") or None
@@ -178,6 +187,28 @@ class Kafka:
         self._blacklist = [_re.compile(pat if pat.startswith("^") else
                                        "^" + _re.escape(pat) + "$")
                            for pat in conf.get("topic.blacklist")]
+
+        # native enqueue fast lane (client/arena.py): engaged per call
+        # when there are no DR consumers or interceptors — produce()
+        # then marshals key/value into a per-toppar native arena in one
+        # C call instead of building a Message object (the app-thread
+        # GIL ceiling; reference zero-allocation enqueue rdkafka_msg.c)
+        self._fast_lane_ver = -1          # recompute on conf mutation
+        self._fast_lane = False
+        # validated (topic, partition) -> Toppar with a live arena; one
+        # dict hit replaces topic lookup + partition check + toppar
+        # lookup on the produce hot path
+        self._fast_tp: dict = {}
+        # the lane's C produce() is the public entry point: eligible
+        # records never touch a Python frame; everything else tails into
+        # _produce_slow (the Message pipeline + first-sight setup)
+        self._lane.configure(
+            self._produce_slow, self._wake_leader,
+            conf.get("queue.buffering.max.messages"),
+            conf.get("queue.buffering.max.kbytes") * 1024)
+        self.produce = self._lane.produce
+        conf.add_listener(self._recompute_fast_lane)
+        self._recompute_fast_lane()
 
         # codec provider selection (compression.backend; SURVEY.md §7 st.5)
         backend = conf.get("compression.backend")
@@ -485,7 +516,10 @@ class Kafka:
             tps = [tp for (t, p), tp in self._toppars.items()
                    if t == topic and p >= cnt]
         for tp in tps:
+            self._fast_tp.pop((tp.topic, tp.partition), None)
+            self._lane.map.pop((tp.topic, tp.partition), None)
             failed: list[Message] = []
+            fast_cnt = fast_bytes = 0
             with tp.lock:
                 failed.extend(tp.msgq)
                 tp.msgq.clear()
@@ -493,8 +527,18 @@ class Kafka:
                 failed.extend(tp.xmit_msgq)
                 tp.xmit_msgq.clear()
                 for b in tp.retry_batches:
-                    failed.extend(b)
+                    if isinstance(b, ArenaBatch):
+                        fast_cnt += b.count
+                        fast_bytes += b.nbytes
+                    else:
+                        failed.extend(b)
                 tp.retry_batches.clear()
+                if tp.arena is not None:
+                    c, nb = tp.arena.clear()
+                    fast_cnt += c
+                    fast_bytes += nb
+            if fast_cnt:
+                self._lane.acct(-fast_cnt, -fast_bytes)
             if failed:
                 self.dr_msgq(failed, KafkaError(
                     Err._UNKNOWN_PARTITION,
@@ -545,8 +589,21 @@ class Kafka:
             return tp
 
     # ------------------------------------------------------------ produce --
-    def produce(self, topic: str, value=None, key=None, partition=PARTITION_UA,
-                on_delivery=None, timestamp=0, headers=(), opaque=None) -> None:
+    @property
+    def msg_cnt(self) -> int:
+        return self._lane.msg_cnt
+
+    @property
+    def msg_bytes(self) -> int:
+        return self._lane.msg_bytes
+
+    def _produce_slow(self, topic: str, value=None, key=None,
+                      partition=PARTITION_UA, on_delivery=None, timestamp=0,
+                      headers=(), opaque=None) -> None:
+        """The Message-path produce (and the fast lane's first-sight
+        setup).  The PUBLIC entry point is ``self.produce`` — the native
+        Lane.produce (enqlane.cpp), which handles every eligible record
+        in one C call and tail-calls here for the rest."""
         # positional order matches the confluent-style public API
         # (topic, value, key, partition, on_delivery, timestamp, headers)
         if isinstance(value, str):
@@ -556,13 +613,23 @@ class Kafka:
         if self.fatal_error:
             raise KafkaException(self.fatal_error)
         sz = (len(value) if value else 0) + (len(key) if key else 0)
+        # lock keeps check+claim atomic on this Python path (the C lane
+        # does both inside one GIL-atomic call)
         with self._msg_cnt_lock:
-            if (self.msg_cnt >= self._max_msgs
-                    or self.msg_bytes + sz > self._max_msg_bytes):
+            if self._lane.full(sz):
                 raise KafkaException(Err._QUEUE_FULL,
                                      "producer queue is full")
-            self.msg_cnt += 1
-            self.msg_bytes += sz
+            self._lane.acct(1, sz)
+        # native enqueue fast lane: no Message object, one C call into
+        # the per-toppar arena (queue accounting above is shared)
+        if self._fast_lane_ver != getattr(self.conf, "version", 0):
+            self._recompute_fast_lane()
+        if (self._fast_lane and partition >= 0 and not headers
+                and on_delivery is None and opaque is None and not timestamp
+                and (value is None or type(value) is bytes)
+                and (key is None or type(key) is bytes)
+                and self._produce_fast(topic, key, value, partition, sz)):
+            return
         m = Message(topic, value=value, key=key, partition=partition,
                     headers=headers, timestamp=timestamp, opaque=opaque)
         if on_delivery is not None:
@@ -585,17 +652,81 @@ class Kafka:
             if 0 < cnt <= partition:
                 # known-invalid partition fails at produce() time
                 # (reference: rd_kafka_msg_partitioner → UNKNOWN_PARTITION)
-                with self._msg_cnt_lock:
-                    self.msg_cnt -= 1
-                    self.msg_bytes -= sz
+                self._lane.acct(-1, -sz)
                 raise KafkaException(
                     Err._UNKNOWN_PARTITION,
                     f"{topic}[{partition}]: partition does not exist")
             tp = self._toppars.get((topic, partition))
             if tp is None:
                 tp = self.get_toppar(topic, partition)
+            if tp.arena_ok:
+                self._demote(tp)    # Message path claims this toppar
             if tp.enq_msg(m):
                 self._wake_leader(tp)
+
+    def _recompute_fast_lane(self) -> None:
+        conf = self.conf
+        self._fast_lane = (
+            self.is_producer
+            and not self.interceptors
+            and not conf.get("dr_msg_cb") and not conf.get("dr_cb")
+            and "dr" not in conf.get("enabled_events")
+            and conf.get("background_event_cb") is None)
+        self._fast_lane_ver = getattr(conf, "version", 0)
+        # the C entry consults this flag before touching an arena; a
+        # conf.set that adds a DR consumer flips it via the listener
+        try:
+            self._lane.enabled = 1 if self._fast_lane else 0
+        except AttributeError:
+            pass                        # lane not constructed yet
+
+    def _produce_fast(self, topic: str, key, value, partition: int,
+                      sz: int) -> bool:
+        """Fast-lane enqueue; False = caller falls back to the Message
+        path (queue accounting stays — both paths share it)."""
+        tp = self._fast_tp.get((topic, partition))
+        if tp is not None:
+            if not tp.arena_ok:         # demoted since caching
+                return False
+            if tp.arena.append(key, value) == 1:
+                self._wake_leader(tp)   # wake on empty→non-empty only
+            return True
+        # ---- first sight: validate, create the arena, cache ------------
+        t = self.topics.get(topic)
+        if t is None:
+            t = self.get_topic(topic)
+        cnt = t.partition_cnt
+        if 0 < cnt <= partition:
+            self._lane.acct(-1, -sz)
+            raise KafkaException(
+                Err._UNKNOWN_PARTITION,
+                f"{topic}[{partition}]: partition does not exist")
+        tp = self._toppars.get((topic, partition))
+        if tp is None:
+            tp = self.get_toppar(topic, partition)
+        if not tp.arena_ok:
+            # cache the demoted toppar too: the next eligible produce
+            # short-circuits on one dict hit instead of re-running the
+            # topic/partition/toppar lookups before falling back
+            self._fast_tp[(topic, partition)] = tp
+            return False
+        a = tp.arena
+        if a is None:
+            with tp.lock:
+                if tp.arena is None and tp.arena_ok:
+                    tp.arena = arena_new()
+                a = tp.arena
+            if a is None:               # extension unavailable: demote
+                tp.arena_ok = False
+                self._fast_tp[(topic, partition)] = tp
+                return False
+        self._fast_tp[(topic, partition)] = tp
+        # register with the C entry point: subsequent produces for this
+        # toppar never enter a Python frame
+        self._lane.map[(topic, partition)] = (a, tp)
+        if a.append(key, value) == 1:
+            self._wake_leader(tp)
+        return True
 
     def _partition_and_enq(self, topic: Topic, m: Message):
         pcb = topic.conf.get("partitioner_cb")
@@ -606,8 +737,19 @@ class Kafka:
         tp = self._toppars.get((topic.name, m.partition))
         if tp is None:
             tp = self.get_toppar(topic.name, m.partition)
+        if tp.arena_ok:
+            self._demote(tp)        # Message path claims this toppar
         if tp.enq_msg(m):
             self._wake_leader(tp)
+
+    def _demote(self, tp: Toppar) -> None:
+        """Permanently route a toppar through the Message path: remove
+        it from the C entry's map FIRST so no new fast-lane records land
+        while the arena drains into the msgq (FIFO preserved)."""
+        key = (tp.topic, tp.partition)
+        self._lane.map.pop(key, None)
+        self._fast_tp.pop(key, None)
+        tp.demote_arena()
 
     def _wake_leader(self, tp: Toppar):
         with self._brokers_lock:
@@ -616,9 +758,15 @@ class Kafka:
             b.ops.push(Op(OpType.BROKER_WAKEUP))
 
     # ------------------------------------------------------------ DR path --
-    def dr_msgq(self, msgs: list[Message], err: Optional[KafkaError]):
+    def dr_msgq(self, msgs, err: Optional[KafkaError]):
         """Queue delivery reports (reference: rd_kafka_dr_msgq,
-        rdkafka_broker.c:2432)."""
+        rdkafka_broker.c:2432).  Accepts list[Message] or a fast-lane
+        ArenaBatch — the lane is only engaged when there are no DR
+        consumers, so an ArenaBatch resolves to pure queue accounting."""
+        if isinstance(msgs, ArenaBatch):
+            with self._msg_cnt_lock:
+                self._lane.acct(-msgs.count, -msgs.nbytes)
+            return
         if err is not None:
             for m in msgs:
                 m.error = err
@@ -637,8 +785,7 @@ class Kafka:
         # a flush() reading between them would see outstanding == 0 and
         # return before the DR reaches the app
         with self._msg_cnt_lock:
-            self.msg_cnt -= len(msgs)
-            self.msg_bytes -= sum(m.size for m in msgs)
+            self._lane.acct(-len(msgs), -sum(m.size for m in msgs))
             self.dr_cnt += len(out)
         if out:
             # one DR op per batch, not per message (queue-push overhead)
@@ -713,6 +860,7 @@ class Kafka:
         err.fatal = True
         if self.fatal_error is None:
             self.fatal_error = err
+            self._lane.fatal = 1        # C produce must reject now
             self.op_err(err)
 
     # -------------------------------------------------------------- flush --
@@ -756,6 +904,7 @@ class Kafka:
         broker threads and their messages get _PURGE_INFLIGHT DRs (any
         late broker response is dropped by the corrid filter)."""
         purged = []
+        fast_cnt = fast_bytes = 0
         with self._toppars_lock:
             tps = list(self._toppars.values())
         for tp in tps:
@@ -767,14 +916,24 @@ class Kafka:
                     purged.extend(tp.xmit_msgq)
                     tp.xmit_msgq.clear()
                     for batch in tp.retry_batches:
-                        purged.extend(batch)
+                        if isinstance(batch, ArenaBatch):
+                            fast_cnt += batch.count
+                            fast_bytes += batch.nbytes
+                        else:
+                            purged.extend(batch)
                     tp.retry_batches.clear()
+                    if tp.arena is not None:
+                        c, nb = tp.arena.clear()
+                        fast_cnt += c
+                        fast_bytes += nb
         with self._topics_lock:
             for t in self.topics.values():
                 with t.lock:
                     if in_queue:
                         purged.extend(t.ua_msgq)
                         t.ua_msgq.clear()
+        if fast_cnt:
+            self._lane.acct(-fast_cnt, -fast_bytes)
         if purged:
             self.dr_msgq(purged, KafkaError(Err._PURGE_QUEUE, "purged"))
         if in_flight:
@@ -786,7 +945,7 @@ class Kafka:
                 brokers = list(self.brokers.values())
             for b in brokers:
                 b.ops.push(Op(OpType.PURGE))
-        if self.idemp and (purged or in_flight):
+        if self.idemp and (purged or fast_cnt or in_flight):
             # purged messages consumed msgids: the sequence chain has a
             # gap the broker would reject — resync PID/epoch (the DRAIN
             # rebase recomputes the base from what is still pending)
@@ -825,16 +984,45 @@ class Kafka:
             if tmo <= 0:
                 continue
             expired = []
+            fast_cnt = fast_bytes = 0
+            fast_pp = False
             with tp.lock:
+                if tp.arena is not None and len(tp.arena):
+                    # fast-lane records carry a native monotonic µs stamp
+                    c, nb = tp.arena.expire(int((now - tmo) * 1e6))
+                    fast_cnt += c
+                    fast_bytes += nb
                 for q in (tp.msgq, tp.xmit_msgq):
                     while q and now - q[0].enq_time > tmo:
                         expired.append(q.popleft())
                 # frozen retry batches expire whole (membership must stay
                 # intact); a batch expires when its head message has
                 # (reference scans all queues, rdkafka_broker.c:3093)
-                while (tp.retry_batches
-                       and now - tp.retry_batches[0][0].enq_time > tmo):
-                    expired.extend(tp.retry_batches.popleft())
+                while tp.retry_batches:
+                    b = tp.retry_batches[0]
+                    head_enq = (b.enq_first if isinstance(b, ArenaBatch)
+                                else b[0].enq_time)
+                    if now - head_enq <= tmo:
+                        break
+                    tp.retry_batches.popleft()
+                    if isinstance(b, ArenaBatch):
+                        fast_cnt += b.count
+                        fast_bytes += b.nbytes
+                        fast_pp = fast_pp or b.possibly_persisted
+                    else:
+                        expired.extend(b)
+            if fast_cnt:
+                any_expired = True
+                any_possibly_persisted = any_possibly_persisted or fast_pp
+                self._lane.acct(-fast_cnt, -fast_bytes)
+                if (self.idemp and fast_pp
+                        and self.conf.get("enable.gapless.guarantee")):
+                    # an expired SENT fast-lane batch leaves a sequence
+                    # gap, same as the Message path below
+                    self.set_fatal_error(KafkaError(
+                        Err._GAPLESS_GUARANTEE,
+                        f"{tp}: message timed out with "
+                        "enable.gapless.guarantee set"))
             if expired:
                 any_expired = True
                 if any(m.status == MsgStatus.POSSIBLY_PERSISTED

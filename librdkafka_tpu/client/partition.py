@@ -41,6 +41,12 @@ class Toppar:
         self.msgq: deque[Message] = deque()        # app → (lock) → broker
         self.xmit_msgq: deque[Message] = deque()   # broker-thread owned
         self.msgq_bytes = 0
+        # native enqueue fast lane (client/arena.py): created on first
+        # eligible produce; permanently demoted (arena_ok=False) the
+        # moment a Message-path record targets this toppar so FIFO order
+        # can never interleave between the two lanes
+        self.arena = None
+        self.arena_ok = True
         self.next_msgid = 1
         self.epoch_base_msgid = 0                  # idempotence seq base
         self.inflight = 0                          # in-flight ProduceRequests
@@ -102,21 +108,43 @@ class Toppar:
         the requeue-or-DR decision (the DRAIN rebase on the main thread
         keys off inflight==0 — releasing early lets it rebase past
         messages still owned by a broker/codec thread)."""
+        from .arena import batch_head_msgid
         with self.lock:
             self.inflight -= 1
-            self.inflight_msgids.discard(msgs[0].msgid)
+            self.inflight_msgids.discard(batch_head_msgid(msgs))
 
-    def enqueue_retry_batch(self, msgs: list[Message]) -> None:
+    def enqueue_retry_batch(self, msgs) -> None:
         """Requeue a failed produce batch FROZEN — original membership and
         order — so a resend carries the same (BaseSequence, record_count)
         and broker-side idempotent dup detection stays sound.  The
         reference likewise never re-slices a retried batch (the msgset is
-        rebuilt from the same message run, rdkafka_msgset_writer.c)."""
+        rebuilt from the same message run, rdkafka_msgset_writer.c).
+        Accepts list[Message] or a fast-lane ArenaBatch."""
+        from .arena import ArenaBatch, batch_head_msgid
         with self.lock:
-            self.retry_batches.append(list(msgs))
+            self.retry_batches.append(
+                msgs if isinstance(msgs, ArenaBatch) else list(msgs))
             if len(self.retry_batches) > 1:
                 self.retry_batches = deque(
-                    sorted(self.retry_batches, key=lambda b: b[0].msgid))
+                    sorted(self.retry_batches, key=batch_head_msgid))
+
+    def demote_arena(self) -> None:
+        """Permanently route this toppar through the Message path; any
+        arena content is converted to Messages FIRST so produce order is
+        preserved exactly.  Caller must hold neither lock."""
+        from .msg import Message
+        with self.lock:
+            self.arena_ok = False
+            if self.arena is None or len(self.arena) == 0:
+                return
+            recs = self.arena.drain_records()
+            for k, v in recs:
+                m = Message(self.topic, value=v, key=k,
+                            partition=self.partition)
+                m.msgid = self.next_msgid
+                self.next_msgid += 1
+                self.msgq.append(m)
+                self.msgq_bytes += m.size
 
     def total_queued(self) -> int:
         with self.lock:

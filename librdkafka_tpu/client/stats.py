@@ -86,7 +86,10 @@ class StatsCollector:
             topics.setdefault(t, {"topic": t, "partitions": {}})
             topics[t]["partitions"][str(p)] = {
                 "partition": p, "leader": tp.leader_id,
-                "msgq_cnt": len(tp.msgq), "xmit_msgq_cnt": len(tp.xmit_msgq),
+                "msgq_cnt": (len(tp.msgq)
+                             + (len(tp.arena) if tp.arena is not None
+                                else 0)),
+                "xmit_msgq_cnt": len(tp.xmit_msgq),
                 "fetchq_cnt": tp.fetchq_cnt,
                 "fetch_state": tp.fetch_state.value,
                 "app_offset": tp.app_offset,

@@ -202,6 +202,28 @@ def frame_v2(base: bytes, klens: list[int], vlens: list[int],
     return buf.raw[:r]
 
 
+def frame_v2_raw(base: bytes, klens: bytes, vlens: bytes,
+                 count: int) -> bytes:
+    """frame_v2 for the native enqueue lane: klens/vlens arrive as raw
+    int32 arrays straight from the arena (no per-record Python work) and
+    all timestamp deltas are zero (fast-lane records carry timestamp=0 =
+    batch build time)."""
+    L = lib()
+    zeros = np.zeros(count, dtype=np.int64)
+    cap = L.tk_frame_v2_bound(len(base), count)
+    buf, p = _outbuf(cap)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    ka = np.frombuffer(klens, dtype=np.int32)
+    va = np.frombuffer(vlens, dtype=np.int32)
+    r = L.tk_frame_v2(base, ka.ctypes.data_as(i32p),
+                      va.ctypes.data_as(i32p), zeros.ctypes.data_as(i64p),
+                      count, p, cap)
+    if r < 0:
+        raise ValueError("tk_frame_v2 capacity shortfall")
+    return buf.raw[:r]
+
+
 # ------------------------------------------------------------- gzip/zstd ---
 
 def gzip_compress(data: bytes, level: int = -1) -> bytes:

@@ -1,25 +1,62 @@
-"""Build the native codec library (g++ → _codec.so), cached by mtime."""
+"""Build the native libraries (g++ → .so), cached by mtime.
+
+Two artifacts:
+  _codec.so     — plain shared library reached via ctypes (codec.cpp)
+  tk_enqlane.so — CPython extension module (enqlane.cpp; ctypes call
+                  overhead would eat the enqueue lane's win)
+"""
 from __future__ import annotations
 
 import os
 import subprocess
+import sysconfig
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "codec.cpp")
 SO = os.path.join(_DIR, "_codec.so")
+ENQ_SRC = os.path.join(_DIR, "enqlane.cpp")
+ENQ_SO = os.path.join(_DIR, "tk_enqlane.so")
 _lock = threading.Lock()
+
+
+def _compile(src: str, so: str, extra: list[str]) -> str:
+    if (os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(src)):
+        return so
+    tmp = so + ".tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           *extra, "-o", tmp, src]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so)
+    return so
 
 
 def build(force: bool = False) -> str:
     """Compile codec.cpp to a shared library if stale; returns the .so path."""
     with _lock:
-        if (not force and os.path.exists(SO)
-                and os.path.getmtime(SO) >= os.path.getmtime(SRC)):
-            return SO
-        tmp = SO + ".tmp"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               "-fvisibility=hidden", "-o", tmp, SRC]
-        subprocess.run(cmd, check=True, capture_output=True)
-        os.replace(tmp, SO)
-        return SO
+        if force and os.path.exists(SO):
+            os.remove(SO)
+        return _compile(SRC, SO, ["-fvisibility=hidden"])
+
+
+def build_enqlane(force: bool = False) -> str:
+    """Compile the tk_enqlane CPython extension if stale; returns path."""
+    with _lock:
+        if force and os.path.exists(ENQ_SO):
+            os.remove(ENQ_SO)
+        inc = sysconfig.get_paths()["include"]
+        return _compile(ENQ_SRC, ENQ_SO, ["-I" + inc])
+
+
+def load_enqlane():
+    """Import the tk_enqlane extension module (building if stale)."""
+    import importlib.machinery
+    import importlib.util
+
+    path = build_enqlane()
+    loader = importlib.machinery.ExtensionFileLoader("tk_enqlane", path)
+    spec = importlib.util.spec_from_loader("tk_enqlane", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
