@@ -31,6 +31,7 @@ from typing import Callable, Optional, TYPE_CHECKING
 
 from ..protocol import apis, proto
 from ..protocol.apis import APIS
+from ..utils import sockbuf
 from ..protocol.msgset import MsgsetWriterV2
 from ..protocol.proto import ApiKey
 from .errors import Err, KafkaError, KafkaException
@@ -200,7 +201,7 @@ class Broker:
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
         self._next_connect = 0.0
         self.terminate = False
-        self.fetch_inflight = False
+        self.fetch_inflight_cnt = 0     # outstanding FetchRequests
         self._tls_handshaking = False
         self._codec_outstanding = 0     # async codec jobs in flight
         self._last_throttle = 0         # throttle_cb change detection
@@ -498,7 +499,7 @@ class Broker:
         self._rbuf.clear()
         self._wbuf.clear()
         self._wbuf_off = 0
-        self.fetch_inflight = False
+        self.fetch_inflight_cnt = 0
         self._tls_handshaking = False
         # fail all in-flight + queued requests (callers decide on retry)
         for req in list(self.waitresp.values()):
@@ -558,42 +559,13 @@ class Broker:
         # batch, felt by every other thread as produce latency
         if not self.sock or self._wbuf_off >= len(self._wbuf):
             return
-        off = self._wbuf_off
-        err = None
-        mv = memoryview(self._wbuf)
-        try:
-            total = len(mv)
-            while off < total:
-                # the chunk view is released explicitly: a raising
-                # send() pins the traceback (and with it any live
-                # buffer export), which would make the wbuf clear()
-                # below raise BufferError
-                chunk = mv[off:]
-                try:
-                    off += self.sock.send(chunk)
-                except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError,
-                        BlockingIOError, InterruptedError):
-                    break
-                except OSError as e:
-                    err = KafkaError(Err._TRANSPORT, f"send failed: {e}")
-                    break
-                finally:
-                    chunk.release()
-        finally:
-            mv.release()
+        off, _blocked, err = sockbuf.send_from(self.sock, self._wbuf,
+                                               self._wbuf_off)
         if err is not None:
-            self._disconnect(err)
+            self._disconnect(KafkaError(Err._TRANSPORT,
+                                        f"send failed: {err}"))
             return
-        if off >= len(self._wbuf):
-            self._wbuf.clear()
-            self._wbuf_off = 0
-        elif off >= (1 << 20):
-            # sustained backpressure: reclaim the consumed prefix so the
-            # buffer tracks OUTSTANDING bytes, not total-ever-sent
-            del self._wbuf[:off]
-            self._wbuf_off = 0
-        else:
-            self._wbuf_off = off
+        self._wbuf_off = sockbuf.compact_consumed(self._wbuf, off)
 
     def _io_serve(self, timeout: float = 0.005):
         """select() over socket + wakeup pipe
@@ -660,25 +632,15 @@ class Broker:
         self.c_rx_bytes += got
         # offset-based frame walk: ONE buffer compaction per recv burst
         # instead of a memmove per response
-        buf = self._rbuf
-        off = 0
-        blen = len(buf)
-        max_bytes = self.rk.conf.get("receive.message.max.bytes")
-        while blen - off >= 4:
-            (n,) = struct.unpack_from(">i", buf, off)
-            if n < 0 or n > max_bytes:
-                self._disconnect(KafkaError(Err._BAD_MSG,
-                                            f"invalid frame size {n}"))
-                return
-            if blen - off < 4 + n:
-                break
-            payload = bytes(buf[off + 4:off + 4 + n])
-            off += 4 + n
+        frames, bad = sockbuf.extract_frames(
+            self._rbuf, self.rk.conf.get("receive.message.max.bytes"))
+        for payload in frames:
             self._handle_response(payload)
             if self.sock is None:           # handler disconnected us
-                return                      # (_disconnect cleared _rbuf)
-        if off:
-            del buf[:off]
+                return
+        if bad is not None:
+            self._disconnect(KafkaError(Err._BAD_MSG,
+                                        f"invalid frame size {bad}"))
 
     def _handle_response(self, payload: bytes):
         (corrid,) = struct.unpack(">i", payload[:4])
@@ -1162,14 +1124,22 @@ class Broker:
     # =================================================== CONSUMER SERVE ===
     def _consumer_serve(self, now: float):
         """(reference: rd_kafka_broker_consumer_serve, rdkafka_broker.c:4489
-        → rd_kafka_broker_fetch_toppars :4279)"""
-        if self.fetch_inflight:
-            return
+        → rd_kafka_broker_fetch_toppars :4279)
+
+        Fetch pipelining: up to ``fetch.num.inflight`` FetchRequests may
+        be outstanding per broker, over DISJOINT partition sets (each
+        toppar is in at most one outstanding Fetch) — the reference
+        keeps the fetch pipe full the same way instead of serializing
+        one Fetch per broker round trip."""
         rk = self.rk
+        if self.fetch_inflight_cnt >= rk.conf.get("fetch.num.inflight"):
+            return
         from .partition import FetchState
         fetch_parts = []
         for tp in list(self.toppars):
             if tp.leader_id != self.nodeid or tp.paused:
+                continue
+            if tp.fetch_in_flight:
                 continue
             if tp.fetch_state == FetchState.OFFSET_QUERY:
                 self._offset_query(tp)
@@ -1202,12 +1172,14 @@ class Broker:
                 {"partition": tp.partition, "fetch_offset": tp.fetch_offset,
                  "max_bytes": rk.conf.get("fetch.message.max.bytes")}
                 for tp in tps]} for t, tps in by_topic.items()]}
-        self.fetch_inflight = True
+        self.fetch_inflight_cnt += 1
+        for tp in fetch_parts:
+            tp.fetch_in_flight = True
         versions = {(tp.topic, tp.partition): tp.version for tp in fetch_parts}
         fetch_ver = pick_version(self.api_versions, ApiKey.Fetch, 4)
         self._xmit(Request(ApiKey.Fetch, body, version=fetch_ver,
-                           cb=lambda err, resp: self._handle_fetch(
-                               err, resp, versions)))
+                           cb=lambda err, resp, parts=fetch_parts:
+                           self._handle_fetch(err, resp, versions, parts)))
 
     def _offset_query(self, tp):
         """Logical offset (BEGINNING/END) → ListOffsets
@@ -1257,8 +1229,10 @@ class Broker:
         tp.fetch_state = FetchState.ACTIVE
         self.rk.dbg("fetch", f"{tp}: offset query -> {tp.fetch_offset}")
 
-    def _handle_fetch(self, err, resp, versions):
-        self.fetch_inflight = False
+    def _handle_fetch(self, err, resp, versions, parts):
+        self.fetch_inflight_cnt = max(0, self.fetch_inflight_cnt - 1)
+        for tp in parts:
+            tp.fetch_in_flight = False
         if err is not None:
             return
         rk = self.rk

@@ -168,6 +168,11 @@ PROPERTIES: list[Prop] = [
        alias="fetch.message.max.bytes"),
     _p("fetch.max.bytes", GLOBAL, "int", 52428800, "Max bytes per fetch request.", app=C,
        vmin=0, vmax=2147483135),
+    _p("fetch.num.inflight", GLOBAL, "int", 4,
+       "Max outstanding FetchRequests per broker, over disjoint "
+       "partition sets (the reference keeps the fetch pipe full instead "
+       "of serializing one Fetch per round trip, rdkafka_broker.c:4279).",
+       app=C, vmin=1, vmax=64),
     _p("fetch.min.bytes", GLOBAL, "int", 1, "Min bytes broker should accumulate.", app=C,
        vmin=1, vmax=100000000),
     _p("fetch.error.backoff.ms", GLOBAL, "int", 500, "Backoff on fetch error.", app=C,

@@ -621,9 +621,8 @@ class Kafka:
                                      "producer queue is full")
             self._lane.acct(1, sz)
         # native enqueue fast lane: no Message object, one C call into
-        # the per-toppar arena (queue accounting above is shared)
-        if self._fast_lane_ver != getattr(self.conf, "version", 0):
-            self._recompute_fast_lane()
+        # the per-toppar arena (queue accounting above is shared;
+        # _fast_lane stays fresh via the conf.add_listener hook)
         if (self._fast_lane and partition >= 0 and not headers
                 and on_delivery is None and opaque is None and not timestamp
                 and (value is None or type(value) is bytes)

@@ -67,6 +67,7 @@ class Toppar:
         self.ls_offset: int = proto.OFFSET_INVALID      # last stable
         self.paused = False
         self.fetch_backoff_until = 0.0
+        self.fetch_in_flight = False   # included in an outstanding Fetch
         self.fetchq_cnt = 0        # msgs sitting in fetchq (queued.min)
         self.fetchq_bytes = 0      # queued.max.messages.kbytes accounting
         self.eof_reported_at = proto.OFFSET_INVALID

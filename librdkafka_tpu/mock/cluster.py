@@ -27,6 +27,7 @@ from ..client.errors import Err
 from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..protocol.msgset import read_batch_header
+from ..utils import sockbuf
 from ..protocol.proto import ApiKey
 from ..utils.buf import Slice
 
@@ -370,19 +371,13 @@ class MockCluster:
         # offset-based frame walk: one compaction per recv burst instead
         # of a memmove per request (1MB Produce requests arrive in ~64KB
         # chunks; per-frame `del` shifted the tail every time)
-        buf = conn.rbuf
-        off = 0
-        while len(buf) - off >= 4:
-            (n,) = struct.unpack_from(">i", buf, off)
-            if len(buf) - off < 4 + n:
-                break
-            payload = bytes(buf[off + 4:off + 4 + n])
-            off += 4 + n
+        frames, bad = sockbuf.extract_frames(conn.rbuf)
+        for payload in frames:
             self._handle(conn, payload)
             if conn.closed:
                 return
-        if off:
-            del buf[:off]
+        if bad is not None:
+            self._close(conn)
 
     def _close(self, conn: _Conn):
         if conn.closed:
@@ -411,42 +406,19 @@ class MockCluster:
         if conn.handshaking:
             self._hs_serve(conn)
             return
-        try:
-            # offset send: no per-chunk memmove of the remaining buffer.
-            # Chunk views are released explicitly — a raising send()
-            # pins the traceback and with it any live buffer export,
-            # which would make wbuf.clear() raise BufferError.
-            off = conn.wbuf_off
-            mv = memoryview(conn.wbuf)
-            try:
-                total = len(mv)
-                while off < total:
-                    chunk = mv[off:]
-                    try:
-                        off += conn.sock.send(chunk)
-                    finally:
-                        chunk.release()
-            finally:
-                mv.release()
-                if off >= len(conn.wbuf):
-                    conn.wbuf.clear()
-                    conn.wbuf_off = 0
-                elif off >= (1 << 20):
-                    # backpressure: reclaim the consumed prefix
-                    del conn.wbuf[:off]
-                    conn.wbuf_off = 0
-                else:
-                    conn.wbuf_off = off
-        except (BlockingIOError, _ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+        off, blocked, err = sockbuf.send_from(conn.sock, conn.wbuf,
+                                              conn.wbuf_off)
+        conn.wbuf_off = sockbuf.compact_consumed(conn.wbuf, off)
+        if err is not None:
+            self._close(conn)
+            return
+        if blocked:
             try:
                 self._sel.modify(conn.sock,
                                  selectors.EVENT_READ | selectors.EVENT_WRITE,
                                  ("conn", conn))
             except (KeyError, ValueError):
                 pass
-            return
-        except OSError:
-            self._close(conn)
             return
         try:
             self._sel.modify(conn.sock, selectors.EVENT_READ, ("conn", conn))

@@ -86,6 +86,49 @@ static void arena_reset(Arena *a) {
     a->boff[0] = 0;
 }
 
+// Reclaim the consumed prefix: partial takes leave [0, boff[start])
+// garbage that would otherwise grow with cumulative produced volume
+// under sustained production (the arena never fully drains when
+// records arrive faster than the per-batch take cap).
+static void arena_compact(Arena *a) {
+    int32_t live = a->count - a->start;
+    int64_t base = a->boff[a->start];
+    if (live > 0) {
+        memmove(a->buf, a->buf + base, (size_t)(a->len - base));
+        memmove(a->klens, a->klens + a->start, (size_t)live * 4);
+        memmove(a->vlens, a->vlens + a->start, (size_t)live * 4);
+        memmove(a->enq, a->enq + a->start, (size_t)live * 8);
+        for (int32_t i = 0; i <= live; i++)
+            a->boff[i] = a->boff[a->start + i] - base;
+        a->len -= base;
+    } else {
+        a->len = 0;
+        a->boff[0] = 0;
+    }
+    a->count = live;
+    a->start = 0;
+}
+
+// Shared append body (arena_append + lane_produce): grow, compact a
+// large consumed prefix, copy payloads, stamp the record.
+static int arena_do_append(Arena *a, const char *kp, int64_t kl,
+                           const char *vp, int64_t vl) {
+    int64_t need = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
+    if (a->start > 0
+        && (a->boff[a->start] >= (1 << 20) || a->start >= 8192))
+        arena_compact(a);
+    if (arena_grow_buf(a, need) < 0 || arena_grow_recs(a) < 0) return -1;
+    if (kl > 0) { memcpy(a->buf + a->len, kp, kl); a->len += kl; }
+    if (vl > 0) { memcpy(a->buf + a->len, vp, vl); a->len += vl; }
+    int32_t i = a->count;
+    a->klens[i] = (int32_t)kl;
+    a->vlens[i] = (int32_t)vl;
+    a->enq[i] = now_us();
+    a->count = i + 1;
+    a->boff[a->count] = a->len;
+    return 0;
+}
+
 // append(key: bytes|None, value: bytes|None) -> remaining count
 static PyObject *arena_append(Arena *a, PyObject *const *args,
                               Py_ssize_t nargs) {
@@ -112,16 +155,7 @@ static PyObject *arena_append(Arena *a, PyObject *const *args,
         vl = PyBytes_GET_SIZE(val);
         vp = PyBytes_AS_STRING(val);
     }
-    int64_t need = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
-    if (arena_grow_buf(a, need) < 0 || arena_grow_recs(a) < 0) return NULL;
-    if (kl > 0) { memcpy(a->buf + a->len, kp, kl); a->len += kl; }
-    if (vl > 0) { memcpy(a->buf + a->len, vp, vl); a->len += vl; }
-    int32_t i = a->count;
-    a->klens[i] = (int32_t)kl;
-    a->vlens[i] = (int32_t)vl;
-    a->enq[i] = now_us();
-    a->count = i + 1;
-    a->boff[a->count] = a->len;
+    if (arena_do_append(a, kp, kl, vp, vl) < 0) return NULL;
     return PyLong_FromLong(a->count - a->start);
 }
 
@@ -382,11 +416,18 @@ static PyObject *lane_produce(Lane *l, PyObject *const *args,
                 if (name == lane_kw_interned[j]
                     || PyObject_RichCompareBool(name, lane_kw_interned[j],
                                                 Py_EQ) == 1) {
+                    if (j < npos) {
+                        // duplicate positional+keyword: route to the
+                        // Python fallback for the proper TypeError
+                        eligible_kw = 0;
+                        break;
+                    }
                     argv[j] = args[nargs + i];
                     hit = 1;
                     break;
                 }
             }
+            if (!eligible_kw) break;
             if (!hit) { eligible_kw = 0; argv[0] = NULL; break; }
         }
     }
@@ -423,22 +464,10 @@ static PyObject *lane_produce(Lane *l, PyObject *const *args,
                 if (l->msg_cnt >= l->max_msgs
                     || l->msg_bytes + sz > l->max_bytes)
                     goto fallback;      // slow path raises _QUEUE_FULL
-                if (arena_grow_buf(a, sz) < 0 || arena_grow_recs(a) < 0)
+                if (arena_do_append(
+                        a, kl >= 0 ? PyBytes_AS_STRING(key) : NULL, kl,
+                        vl >= 0 ? PyBytes_AS_STRING(value) : NULL, vl) < 0)
                     return NULL;
-                if (kl > 0) {
-                    memcpy(a->buf + a->len, PyBytes_AS_STRING(key), kl);
-                    a->len += kl;
-                }
-                if (vl > 0) {
-                    memcpy(a->buf + a->len, PyBytes_AS_STRING(value), vl);
-                    a->len += vl;
-                }
-                int32_t i = a->count;
-                a->klens[i] = (int32_t)kl;
-                a->vlens[i] = (int32_t)vl;
-                a->enq[i] = now_us();
-                a->count = i + 1;
-                a->boff[a->count] = a->len;
                 l->msg_cnt += 1;
                 l->msg_bytes += sz;
                 if (a->count - a->start == 1 && l->wake) {
@@ -454,6 +483,9 @@ static PyObject *lane_produce(Lane *l, PyObject *const *args,
     }
     // slow path: the Python Message pipeline (also first-sight setup)
 fallback:
+    // eligibility parsing may have left an OverflowError pending (e.g.
+    // partition or timestamp outside int64) — clear before calling out
+    if (PyErr_Occurred()) PyErr_Clear();
     if (!l->fallback) {
         PyErr_SetString(PyExc_RuntimeError, "lane fallback not set");
         return NULL;
