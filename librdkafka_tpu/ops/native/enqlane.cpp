@@ -404,7 +404,14 @@ static PyObject *lane_kw_interned[8];
 static PyObject *lane_produce(Lane *l, PyObject *const *args,
                               Py_ssize_t nargs, PyObject *kwnames) {
     PyObject *argv[8] = {NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL};
-    Py_ssize_t npos = nargs > 8 ? 8 : nargs;
+    if (nargs > 8) { // >8 positionals: fallback raises the proper TypeError
+        if (!l->fallback) {
+            PyErr_SetString(PyExc_RuntimeError, "lane fallback not set");
+            return NULL;
+        }
+        return PyObject_Vectorcall(l->fallback, args, nargs, kwnames);
+    }
+    Py_ssize_t npos = nargs;
     for (Py_ssize_t i = 0; i < npos; i++) argv[i] = args[i];
     int eligible_kw = 1;
     if (kwnames) {

@@ -247,7 +247,10 @@ class MsgsetWriterV2:
             self.base_offset,
             (proto.V2_HEADER_SIZE - proto.V2_OF_PartitionLeaderEpoch)
             + len(payload),                              # Length
-            -1, 2, 0, attrs, self.record_count - 1,
+            # PartitionLeaderEpoch=0 exactly like the reference writer
+            # (rdkafka_msgset_writer.c:368, KIP-101) — producers don't
+            # know the epoch; 0 keeps wire bytes bit-identical to it.
+            0, 2, 0, attrs, self.record_count - 1,
             self.first_timestamp, self.max_timestamp, self.producer_id,
             self.producer_epoch, self.base_sequence, self.record_count))
         wire += payload

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Build the reference librdkafka (from /root/reference, read-only) into
+# the gitignored .refbuild/ tree so the interop tier
+# (tests/test_0200_interop.py) can run against the real C client.
+set -e
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+REF="${REFERENCE_DIR:-/root/reference}"
+DST="$REPO/.refbuild"
+
+if [ -e "$DST/src/librdkafka.so.1" ]; then
+    echo "reference already built at $DST"
+    exit 0
+fi
+mkdir -p "$DST"
+cp -r "$REF"/* "$DST"/
+cd "$DST"
+./configure
+make -j"$(nproc)" libs
+make -C examples rdkafka_performance
+echo "reference built: $DST/src/librdkafka.so.1"

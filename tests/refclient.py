@@ -1,0 +1,207 @@
+"""ctypes binding to the *reference* librdkafka.so, for interop tests only.
+
+The reference tree is compiled into ``.refbuild/`` (gitignored) by
+``tests/build_reference.sh`` (or manually: ``configure && make libs`` in a
+copy of ``/root/reference``).  When the shared object is absent every
+interop test skips cleanly.
+
+This is deliberately a minimal surface — enough to (a) produce records
+with pinned timestamps/keys/values through the real C client
+(rd_kafka_producev, /root/reference/src/rdkafka.h:1145) and (b) consume
+them back with the legacy simple-consumer API (rd_kafka_consume_batch,
+rdkafka.h:3097), so tests can prove that wire bytes produced by the
+reference are readable by our client and vice versa.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from ctypes import (POINTER, Structure, byref, c_char_p, c_int, c_int32,
+                    c_int64, c_size_t, c_ssize_t, c_void_p, create_string_buffer)
+
+REFBUILD = os.path.join(os.path.dirname(__file__), "..", ".refbuild")
+SO_PATH = os.path.abspath(os.path.join(REFBUILD, "src", "librdkafka.so.1"))
+PERF_BIN = os.path.abspath(
+    os.path.join(REFBUILD, "examples", "rdkafka_performance"))
+
+
+def available() -> bool:
+    return os.path.exists(SO_PATH)
+
+
+class rd_kafka_message_t(Structure):
+    _fields_ = [
+        ("err", c_int),
+        ("rkt", c_void_p),
+        ("partition", c_int32),
+        ("payload", c_void_p),
+        ("len", c_size_t),
+        ("key", c_void_p),
+        ("key_len", c_size_t),
+        ("offset", c_int64),
+        ("_private", c_void_p),
+    ]
+
+
+_lib = None
+
+# rd_kafka_vtype_t (rdkafka.h:937-953)
+VTYPE_END = 0
+VTYPE_TOPIC = 1
+VTYPE_PARTITION = 3
+VTYPE_VALUE = 4
+VTYPE_KEY = 5
+VTYPE_MSGFLAGS = 7
+VTYPE_TIMESTAMP = 8
+
+MSG_F_COPY = 0x2
+
+RD_KAFKA_PRODUCER = 0
+RD_KAFKA_CONSUMER = 1
+
+PARTITION_UA = -1
+OFFSET_BEGINNING = -2
+
+
+def lib():
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(SO_PATH)
+        _lib.rd_kafka_conf_new.restype = c_void_p
+        _lib.rd_kafka_conf_set.argtypes = [c_void_p, c_char_p, c_char_p,
+                                           c_char_p, c_size_t]
+        _lib.rd_kafka_new.restype = c_void_p
+        _lib.rd_kafka_new.argtypes = [c_int, c_void_p, c_char_p, c_size_t]
+        _lib.rd_kafka_producev.restype = c_int
+        _lib.rd_kafka_flush.argtypes = [c_void_p, c_int]
+        _lib.rd_kafka_flush.restype = c_int
+        _lib.rd_kafka_poll.argtypes = [c_void_p, c_int]
+        _lib.rd_kafka_destroy.argtypes = [c_void_p]
+        _lib.rd_kafka_topic_new.restype = c_void_p
+        _lib.rd_kafka_topic_new.argtypes = [c_void_p, c_char_p, c_void_p]
+        _lib.rd_kafka_topic_destroy.argtypes = [c_void_p]
+        _lib.rd_kafka_consume_start.argtypes = [c_void_p, c_int32, c_int64]
+        _lib.rd_kafka_consume_start.restype = c_int
+        _lib.rd_kafka_consume_stop.argtypes = [c_void_p, c_int32]
+        _lib.rd_kafka_consume_batch.argtypes = [
+            c_void_p, c_int32, c_int, POINTER(POINTER(rd_kafka_message_t)),
+            c_size_t]
+        _lib.rd_kafka_consume_batch.restype = c_ssize_t
+        _lib.rd_kafka_message_destroy.argtypes = [
+            POINTER(rd_kafka_message_t)]
+        _lib.rd_kafka_message_timestamp.argtypes = [
+            POINTER(rd_kafka_message_t), POINTER(c_int)]
+        _lib.rd_kafka_message_timestamp.restype = c_int64
+        _lib.rd_kafka_err2str.restype = c_char_p
+        _lib.rd_kafka_last_error.restype = c_int
+    return _lib
+
+
+def _mk_handle(ctype: int, conf: dict[str, str]) -> c_void_p:
+    L = lib()
+    c = L.rd_kafka_conf_new()
+    errstr = create_string_buffer(512)
+    for k, v in conf.items():
+        res = L.rd_kafka_conf_set(c, k.encode(), str(v).encode(),
+                                  errstr, 512)
+        if res != 0:
+            raise RuntimeError(f"conf_set {k}: {errstr.value.decode()}")
+    rk = L.rd_kafka_new(ctype, c, errstr, 512)
+    if not rk:
+        raise RuntimeError(f"rd_kafka_new: {errstr.value.decode()}")
+    return rk
+
+
+class RefProducer:
+    """The real librdkafka producer, driven via ctypes."""
+
+    def __init__(self, bootstrap: str, **extra_conf: str):
+        conf = {"bootstrap.servers": bootstrap,
+                "socket.timeout.ms": "5000",
+                "message.timeout.ms": "10000",
+                **extra_conf}
+        self.rk = _mk_handle(RD_KAFKA_PRODUCER, conf)
+
+    def produce(self, topic: str, partition: int, value: bytes,
+                key: bytes | None = None, timestamp_ms: int | None = None):
+        L = lib()
+        args: list = [
+            c_int(VTYPE_TOPIC), c_char_p(topic.encode()),
+            c_int(VTYPE_PARTITION), c_int32(partition),
+            c_int(VTYPE_MSGFLAGS), c_int(MSG_F_COPY),
+            c_int(VTYPE_VALUE), c_char_p(value), c_size_t(len(value)),
+        ]
+        if key is not None:
+            args += [c_int(VTYPE_KEY), c_char_p(key), c_size_t(len(key))]
+        if timestamp_ms is not None:
+            args += [c_int(VTYPE_TIMESTAMP), c_int64(timestamp_ms)]
+        args += [c_int(VTYPE_END)]
+        err = L.rd_kafka_producev(c_void_p(self.rk), *args)
+        if err != 0:
+            raise RuntimeError(
+                f"producev: {L.rd_kafka_err2str(err).decode()}")
+
+    def flush(self, timeout_ms: int = 10000) -> int:
+        return lib().rd_kafka_flush(c_void_p(self.rk), timeout_ms)
+
+    def close(self):
+        if self.rk:
+            lib().rd_kafka_destroy(c_void_p(self.rk))
+            self.rk = None
+
+
+class RefConsumer:
+    """The real librdkafka simple consumer (consume_start/consume_batch)."""
+
+    def __init__(self, bootstrap: str, topic: str, **extra_conf: str):
+        conf = {"bootstrap.servers": bootstrap,
+                "socket.timeout.ms": "5000",
+                **extra_conf}
+        self.rk = _mk_handle(RD_KAFKA_CONSUMER, conf)
+        self.rkt = lib().rd_kafka_topic_new(
+            c_void_p(self.rk), topic.encode(), None)
+        self._started: set[int] = set()
+
+    def consume(self, partition: int, n: int, timeout_ms: int = 10000):
+        """Consume up to n messages; returns list of
+        (partition, offset, key|None, value, timestamp_ms)."""
+        import time
+        L = lib()
+        if partition not in self._started:
+            if L.rd_kafka_consume_start(c_void_p(self.rkt), partition,
+                                        OFFSET_BEGINNING) == -1:
+                err = L.rd_kafka_last_error()
+                raise RuntimeError(
+                    f"consume_start: {L.rd_kafka_err2str(err).decode()}")
+            self._started.add(partition)
+        out = []
+        msgs = (POINTER(rd_kafka_message_t) * n)()
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while len(out) < n and time.monotonic() < deadline:
+            cnt = L.rd_kafka_consume_batch(
+                c_void_p(self.rkt), partition, 1000,
+                ctypes.cast(msgs, POINTER(POINTER(rd_kafka_message_t))),
+                n - len(out))
+            for i in range(max(cnt, 0)):
+                m = msgs[i].contents
+                if m.err == 0:
+                    key = (ctypes.string_at(m.key, m.key_len)
+                           if m.key else None)
+                    val = (ctypes.string_at(m.payload, m.len)
+                           if m.payload else b"")
+                    tstype = c_int(0)
+                    ts = L.rd_kafka_message_timestamp(msgs[i], byref(tstype))
+                    out.append((partition, m.offset, key, val, ts))
+                L.rd_kafka_message_destroy(msgs[i])
+        return out
+
+    def close(self):
+        L = lib()
+        for p in self._started:
+            L.rd_kafka_consume_stop(c_void_p(self.rkt), p)
+        if self.rkt:
+            L.rd_kafka_topic_destroy(c_void_p(self.rkt))
+            self.rkt = None
+        if self.rk:
+            L.rd_kafka_destroy(c_void_p(self.rk))
+            self.rk = None

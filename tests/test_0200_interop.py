@@ -1,0 +1,171 @@
+"""Interop proof against the REAL reference librdkafka.
+
+The strongest correctness evidence available: the reference C client
+(compiled from /root/reference into .refbuild/, see tests/refclient.py)
+talks to OUR mock cluster, and OUR client consumes what IT produced —
+and vice versa — across every compression codec.  Plus a bit-identical
+wire-byte comparison of an uncompressed v2 RecordBatch built from the
+same records by both writers (the v2 format pins every byte when
+timestamps are pinned; reference writer:
+/root/reference/src/rdkafka_msgset_writer.c:653-1288).
+
+All tests skip cleanly when the reference build is absent.
+Build it with:  tests/build_reference.sh
+"""
+import struct
+import subprocess
+import time
+
+import pytest
+
+import refclient
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.msg import Message
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.protocol import proto
+from librdkafka_tpu.protocol.msgset import MsgsetWriterV2
+
+pytestmark = pytest.mark.skipif(
+    not refclient.available(),
+    reason="reference librdkafka not built (.refbuild/; run "
+           "tests/build_reference.sh)")
+
+CODECS = ["none", "gzip", "snappy", "lz4", "zstd"]
+BASE_TS = 1_690_000_000_000
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"interop": 2})
+    yield c
+    c.stop()
+
+
+def _our_consume(cluster, topic, n, timeout=25.0, check_crcs=True):
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "ginterop", "auto.offset.reset": "earliest",
+                  "check.crcs": check_crcs})
+    c.subscribe([topic])
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    c.close()
+    return got
+
+
+def test_ref_perf_producer_to_our_consumer(cluster):
+    """(a) reference rdkafka_performance -P → our mock → our Consumer."""
+    p = subprocess.run(
+        [refclient.PERF_BIN, "-P", "-t", "interop", "-s", "100",
+         "-c", "1000", "-b", cluster.bootstrap_servers(),
+         "-X", "socket.timeout.ms=3000", "-X", "message.timeout.ms=8000"],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr[-1000:]
+    got = _our_consume(cluster, "interop", 1000)
+    assert len(got) == 1000
+    assert all(len(m.value) == 100 for m in got)
+
+
+@pytest.mark.parametrize("codec", CODECS)
+def test_ref_producer_codecs_to_our_consumer(cluster, codec):
+    """Reference producer (each codec) → our consumer content equality.
+
+    This validates our msgset reader + decompressors against compressed
+    bytes emitted by the real liblz4/snappy/zlib/zstd paths in the
+    reference (rdkafka_msgset_writer.c:943-1108)."""
+    rp = refclient.RefProducer(
+        cluster.bootstrap_servers(),
+        **{"compression.codec": codec, "linger.ms": "30",
+           "batch.num.messages": "1000"})
+    want = []
+    for i in range(200):
+        key = b"k%03d" % i
+        val = (b"ref-interop-%03d-" % i) * 8
+        rp.produce("interop", i % 2, val, key=key,
+                   timestamp_ms=BASE_TS + i)
+        want.append((i % 2, key, val, BASE_TS + i))
+    assert rp.flush() == 0
+    rp.close()
+
+    got = _our_consume(cluster, "interop", 200)
+    assert len(got) == 200
+    got_set = {(m.partition, m.key, m.value, m.timestamp) for m in got}
+    assert got_set == set(want)
+    # per-partition offset order must be contiguous from 0
+    for part in (0, 1):
+        offs = [m.offset for m in got if m.partition == part]
+        assert offs == sorted(offs)
+        assert offs[0] == 0
+
+
+@pytest.mark.parametrize("codec", CODECS)
+def test_our_producer_to_ref_consumer(cluster, codec):
+    """(b) our Producer (each codec) → mock → REAL librdkafka consumer.
+
+    The reference's reader (rdkafka_msgset_reader.c:258-530 decompress,
+    :982 CRC verify with check.crcs) accepting our wire bytes proves our
+    writer + compressors emit spec-conformant MessageSets."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 20, "compression.codec": codec,
+                  "batch.num.messages": 500})
+    want = []
+    for i in range(200):
+        key = b"o%03d" % i
+        val = (b"our-interop-%03d-" % i) * 8
+        p.produce("interop", value=val, key=key, partition=i % 2,
+                  timestamp=BASE_TS + i)
+        want.append((i % 2, key, val, BASE_TS + i))
+    assert p.flush(15.0) == 0
+    p.close()
+
+    rc = refclient.RefConsumer(cluster.bootstrap_servers(), "interop",
+                               **{"check.crcs": "true"})
+    got = []
+    for part in (0, 1):
+        got += rc.consume(part, sum(1 for w in want if w[0] == part))
+    rc.close()
+    assert len(got) == 200
+    got_set = {(part, key, val, ts) for part, off, key, val, ts in got}
+    assert got_set == set(want)
+
+
+def test_uncompressed_wire_bytes_bit_identical(cluster):
+    """(c) For pinned inputs the v2 RecordBatch is fully determined by
+    the spec — the reference writer's bytes and ours must be IDENTICAL
+    (reference: rdkafka_msgset_writer.c:1230-1288 finalize/CRC)."""
+    rp = refclient.RefProducer(
+        cluster.bootstrap_servers(),
+        **{"linger.ms": "200", "batch.num.messages": "1000"})
+    msgs = []
+    for i in range(50):
+        key = b"key-%02d" % i
+        val = b"value-%03d" % i * 3
+        rp.produce("interop", 0, val, key=key, timestamp_ms=BASE_TS + 7 * i)
+        msgs.append(Message(topic="interop", value=val, key=key,
+                            partition=0, timestamp=BASE_TS + 7 * i))
+    assert rp.flush() == 0
+    rp.close()
+
+    # The reference may split the run into >1 batch (e.g. a first batch
+    # dispatched as the broker comes up); mirror its split — each batch
+    # is [base, base+count) of our pinned record list and must match
+    # byte for byte.
+    log = cluster.partition("interop", 0).log
+    assert log, "reference produced nothing"
+    total = 0
+    for base, ref_blob in log:
+        count = struct.unpack_from(">i", ref_blob,
+                                   proto.V2_OF_RecordCount)[0]
+        run = msgs[base:base + count]
+        assert run, "batch outside produced range"
+        ours = MsgsetWriterV2(base_offset=base).build(
+            run, now_ms=BASE_TS).finalize()
+        assert ours == ref_blob, (
+            "wire bytes differ for batch base=%d count=%d: "
+            "ours=%d bytes ref=%d bytes" %
+            (base, count, len(ours), len(ref_blob)))
+        total += count
+    assert total == len(msgs)
