@@ -57,7 +57,11 @@ class _PyLane:
         self._fallback = None
         self._lock = threading.Lock()
 
-    def configure(self, fallback, wake, max_msgs, max_bytes):
+    def configure(self, fallback, wake, max_msgs, max_bytes,
+                  copy_max=None):
+        # copy_max (message.copy.max.bytes) is irrelevant here: this
+        # stand-in never copies into an arena — everything already takes
+        # the reference-holding Message path
         self._fallback = fallback
         self.max_msgs = max_msgs
         self.max_bytes = max_bytes

@@ -210,7 +210,12 @@ class Broker:
         self.ts_connected = 0.0
         # stats
         self.c_tx = self.c_rx = self.c_tx_bytes = self.c_rx_bytes = 0
+        self.c_connects = 0             # connection attempts (stats)
         self.c_req_timeouts = 0
+        # consecutive request timeouts since the last good response;
+        # socket.max.fails of these mark the connection broken
+        # (reference: rkb_req_timeouts, rdkafka_broker.c timeout scan)
+        self._req_timeouts_pending = 0
         # latency decomposition (reference: rkb_avg_rtt/outbuf_latency/
         # throttle, rdkafka_broker.h; emitted rdkafka.c:1582-1630)
         from .stats import Avg
@@ -335,6 +340,7 @@ class Broker:
     # ------------------------------------------------------ connect logic --
     def _try_connect(self):
         self._set_state(BrokerState.TRY_CONNECT)
+        self.c_connects += 1
         try:
             self.sock = self.rk.connect_cb(self.host, self.port,
                                            self.rk.conf.get(
@@ -484,6 +490,9 @@ class Broker:
         self.rk.broker_down(self, KafkaError(Err._TRANSPORT, reason))
 
     def _disconnect(self, err: KafkaError, quiet: bool = False):
+        # consecutive-timeout accounting is per-connection (reference
+        # resets rkb_req_timeouts in rd_kafka_broker_fail)
+        self._req_timeouts_pending = 0
         if quiet:
             # log.connection.close=false: idle disconnects are expected
             # (broker idle reaper); reconnect with a debug line only
@@ -649,6 +658,7 @@ class Broker:
             self.rk.dbg("broker", f"{self.name}: unknown corrid {corrid}")
             return
         self.c_rx += 1
+        self._req_timeouts_pending = 0  # connection is alive
         if req.ts_sent:
             self.rtt_avg.add((time.monotonic() - req.ts_sent) * 1e6)
         try:
@@ -702,8 +712,20 @@ class Broker:
         for c in timed_out:
             req = self.waitresp.pop(c)
             self.c_req_timeouts += 1
+            self._req_timeouts_pending += 1
             self._req_fail(req, KafkaError(Err._TIMED_OUT,
                                            f"{req.api.name} timed out"))
+        # socket.max.fails consecutive timeouts with no response in
+        # between: the connection is dead — force a reconnect cycle
+        # (reference: rd_kafka_broker_timeout_scan's rkb_req_timeouts
+        # accounting; 0 disables)
+        max_fails = self.rk.conf.get("socket.max.fails")
+        if max_fails and self._req_timeouts_pending >= max_fails:
+            consec = self._req_timeouts_pending
+            self._disconnect(KafkaError(
+                Err._TIMED_OUT,
+                f"{consec} consecutive request(s) timed out: "
+                f"disconnect (socket.max.fails={max_fails})"))
 
     # =================================================== PRODUCER SERVE ===
     def _producer_serve(self, now: float):
@@ -720,6 +742,14 @@ class Broker:
         # flight; messages keep accumulating in xmit_msgq meanwhile
         if (rk.codec_worker is not None
                 and self._codec_outstanding >= rk.codec_pipeline_depth):
+            return
+        # queue.buffering.backpressure.threshold: with this many built-
+        # but-untransmitted requests already queued on the socket, hold
+        # off forming new MessageSets — messages keep accumulating into
+        # bigger batches instead (reference: rd_kafka_toppar_producer_
+        # serve's outbuf backpressure, rdkafka_broker.c:3262)
+        if len(self.outq) >= rk.conf.get(
+                "queue.buffering.backpressure.threshold"):
             return
         ready: list[tuple] = []   # (toppar, msgs, writer|None-when-legacy)
 

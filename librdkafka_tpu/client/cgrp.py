@@ -215,7 +215,7 @@ class ConsumerGroup:
              # KIP-345 static membership (JoinGroup v5+)
              "group_instance_id":
                  self.rk.conf.get("group.instance.id") or None,
-             "protocol_type": "consumer",
+             "protocol_type": self.rk.conf.get("group.protocol.type"),
              "protocols": [{"name": n.strip(), "metadata": meta}
                            for n in names if n.strip()]},
             cb=self._handle_join,

@@ -264,7 +264,6 @@ PROPERTIES: list[Prop] = [
     _p("test.mock.num.brokers", GLOBAL, "int", 0,
        "Create an in-process mock cluster with this many brokers "
        "(reference: rdkafka_mock.c via rdkafka_conf.c).", vmin=0, vmax=10000),
-    _p("enable.mock.fast.clock", GLOBAL, "bool", False, "Speed up mock timeouts (tests)."),
     _p("test.mock.default.partitions", GLOBAL, "int", 4,
        "Partition count for topics auto-created by the mock cluster.",
        vmin=1, vmax=10000),

@@ -205,7 +205,8 @@ class Kafka:
         self._lane.configure(
             self._produce_slow, self._wake_leader,
             conf.get("queue.buffering.max.messages"),
-            conf.get("queue.buffering.max.kbytes") * 1024)
+            conf.get("queue.buffering.max.kbytes") * 1024,
+            conf.get("message.copy.max.bytes"))
         self.produce = self._lane.produce
         conf.add_listener(self._recompute_fast_lane)
         self._recompute_fast_lane()
@@ -331,6 +332,17 @@ class Kafka:
         # numeric syslog-style filter (reference log_level, default 6)
         if self._LOG_LEVELS.get(level, 6) > self._log_level:
             return
+        # log.thread.name: tag messages with the emitting thread exactly
+        # like the reference's "[thrd:...]" prefix (rdlog.c)
+        if self.conf.get("log.thread.name"):
+            msg = f"[thrd:{threading.current_thread().name}] {msg}"
+        # log.queue: logs become LOG events served from the app-facing
+        # queue (poll/queue_poll) instead of synchronous output — the
+        # log_cb then fires on the POLLING thread (reference
+        # rd_kafka_conf "log.queue" + rd_kafka_set_log_queue)
+        if self.conf.get("log.queue"):
+            self.rep.push(Op(OpType.LOG, payload=(level, "rdkafka", msg)))
+            return
         if self.log_cb:
             self.log_cb(level, "rdkafka", msg)
         elif level in ("ERROR", "WARN"):
@@ -408,7 +420,17 @@ class Kafka:
         self.dbg("metadata", f"refresh ({reason}) via {b.name}")
         full = not names        # None or [] → broker enumerates all topics
         b.enqueue_request(Request(
-            ApiKey.Metadata, {"topics": names}, retries_left=2,
+            ApiKey.Metadata,
+            # v4+ carries the auto-creation flag: producers may trigger
+            # broker-side topic creation, consumers only when
+            # allow.auto.create.topics (KIP-204; reference
+            # rd_kafka_MetadataRequest). Older negotiated versions
+            # simply don't serialize the key.
+            {"topics": names,
+             "allow_auto_topic_creation":
+                 self.is_producer or
+                 bool(self.conf.get("allow.auto.create.topics"))},
+            retries_left=2,
             abs_timeout=time.monotonic() +
             self.conf.get("metadata.request.timeout.ms") / 1000.0,
             cb=lambda e, r: self._handle_metadata(e, r, full=full)))

@@ -491,9 +491,12 @@ class MockCluster:
     def _h_Metadata(self, conn, corrid, hdr, body, inject):
         with self._lock:
             names = body["topics"]
+            # v4+ request flag (KIP-204): a False flag suppresses broker
+            # auto-creation even when the cluster allows it
+            allow = body.get("allow_auto_topic_creation", True)
             if names is None or len(names) == 0:
                 names = list(self.topics)
-            elif self.auto_create_topics:
+            elif self.auto_create_topics and allow:
                 for t in names:
                     if t not in self.topics:
                         self.create_topic(t)
@@ -514,7 +517,8 @@ class MockCluster:
             brokers = [{"node_id": b, "host": "127.0.0.1",
                         "port": self._ports[b], "rack": None}
                        for b in self._ports if b not in self._down]
-        return {"brokers": brokers, "cluster_id": self.cluster_id,
+        return {"throttle_time_ms": 0,   # serialized for v3+ only
+                "brokers": brokers, "cluster_id": self.cluster_id,
                 "controller_id": self.controller_id, "topics": topics}
 
     def _h_Produce(self, conn, corrid, hdr, body, inject):

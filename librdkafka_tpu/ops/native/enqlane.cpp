@@ -309,6 +309,9 @@ typedef struct {
     PyObject *wake;       // rk._wake_fast(toppar) on empty->non-empty
     int64_t msg_cnt, msg_bytes;
     int64_t max_msgs, max_bytes;
+    int64_t copy_max;     // message.copy.max.bytes: larger values keep a
+                          // Python reference (Message path) instead of
+                          // being copied into the arena
     int enabled;          // conf-level eligibility (no DR consumers)
     int fatal;            // set_fatal_error happened: produce must raise
 } Lane;
@@ -323,6 +326,7 @@ static PyObject *lane_new(PyTypeObject *type, PyObject *args,
     l->wake = NULL;
     l->msg_cnt = 0; l->msg_bytes = 0;
     l->max_msgs = 100000; l->max_bytes = 1LL << 30;
+    l->copy_max = 65535;
     l->enabled = 0; l->fatal = 0;
     return (PyObject *)l;
 }
@@ -350,18 +354,20 @@ static void lane_dealloc(Lane *l) {
     Py_TYPE(l)->tp_free((PyObject *)l);
 }
 
-// configure(fallback, wake, max_msgs, max_bytes)
+// configure(fallback, wake, max_msgs, max_bytes[, copy_max])
 static PyObject *lane_configure(Lane *l, PyObject *const *args,
                                 Py_ssize_t nargs) {
-    if (nargs != 4) {
-        PyErr_SetString(PyExc_TypeError,
-                        "configure(fallback, wake, max_msgs, max_bytes)");
+    if (nargs != 4 && nargs != 5) {
+        PyErr_SetString(
+            PyExc_TypeError,
+            "configure(fallback, wake, max_msgs, max_bytes[, copy_max])");
         return NULL;
     }
     Py_INCREF(args[0]); Py_XSETREF(l->fallback, args[0]);
     Py_INCREF(args[1]); Py_XSETREF(l->wake, args[1]);
     l->max_msgs = PyLong_AsLongLong(args[2]);
     l->max_bytes = PyLong_AsLongLong(args[3]);
+    if (nargs == 5) l->copy_max = PyLong_AsLongLong(args[4]);
     if (PyErr_Occurred()) return NULL;
     Py_RETURN_NONE;
 }
@@ -468,6 +474,10 @@ static PyObject *lane_produce(Lane *l, PyObject *const *args,
                 int64_t vl = (value && value != Py_None)
                                  ? PyBytes_GET_SIZE(value) : -1;
                 int64_t sz = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
+                if (vl > l->copy_max || kl > l->copy_max)
+                    goto fallback;      // message.copy.max.bytes: keep a
+                                        // reference (Message path), don't
+                                        // copy into the arena
                 if (l->msg_cnt >= l->max_msgs
                     || l->msg_bytes + sz > l->max_bytes)
                     goto fallback;      // slow path raises _QUEUE_FULL

@@ -32,6 +32,12 @@ APIVERSIONS_V0_RESP = Schema(
 
 # -------------------------------------------------------------- Metadata --
 METADATA_V2_REQ = Schema(("topics", Array(String)))  # null array = all topics
+# v4 (KIP-204): producer metadata may auto-create, consumer only when
+# allow.auto.create.topics (reference: rd_kafka_MetadataRequest's
+# allow_auto_topic_creation flag, rdkafka_request.c)
+METADATA_V4_REQ = Schema(("topics", Array(String)),
+                         ("allow_auto_topic_creation", Boolean),
+                         defaults={"allow_auto_topic_creation": True})
 METADATA_V2_RESP = Schema(
     ("brokers", Array(Schema(
         ("node_id", Int32), ("host", String), ("port", Int32),
@@ -43,6 +49,9 @@ METADATA_V2_RESP = Schema(
         ("partitions", Array(Schema(
             ("error_code", Int16), ("partition", Int32), ("leader", Int32),
             ("replicas", Array(Int32)), ("isr", Array(Int32)))))))))
+METADATA_V3_RESP = Schema(("throttle_time_ms", Int32),
+                          *METADATA_V2_RESP.fields)
+METADATA_V4_RESP = METADATA_V3_RESP       # v4 only adds the request flag
 
 # --------------------------------------------------------------- Produce --
 # Legacy versions for pre-0.11 brokers (broker.version.fallback;
@@ -333,7 +342,7 @@ DELETEGROUPS_V0_RESP = Schema(
 #: this client emits per API (negotiation picks min(ours, broker's)).
 APIS: dict[ApiKey, tuple[int, Schema, Schema]] = {
     ApiKey.ApiVersions: (0, APIVERSIONS_V0_REQ, APIVERSIONS_V0_RESP),
-    ApiKey.Metadata: (2, METADATA_V2_REQ, METADATA_V2_RESP),
+    ApiKey.Metadata: (4, METADATA_V4_REQ, METADATA_V4_RESP),
     ApiKey.Produce: (3, PRODUCE_V3_REQ, PRODUCE_V3_RESP),
     ApiKey.Fetch: (4, FETCH_V4_REQ, FETCH_V4_RESP),
     ApiKey.ListOffsets: (1, LISTOFFSETS_V1_REQ, LISTOFFSETS_V1_RESP),
@@ -462,6 +471,8 @@ METADATA_V1_RESP = Schema(
             ("replicas", Array(Int32)), ("isr", Array(Int32)))))))))
 VERSIONED[(ApiKey.Metadata, 0)] = (METADATA_V2_REQ, METADATA_V0_RESP)
 VERSIONED[(ApiKey.Metadata, 1)] = (METADATA_V2_REQ, METADATA_V1_RESP)
+VERSIONED[(ApiKey.Metadata, 2)] = (METADATA_V2_REQ, METADATA_V2_RESP)
+VERSIONED[(ApiKey.Metadata, 3)] = (METADATA_V2_REQ, METADATA_V3_RESP)
 
 # OffsetCommit v0/v1 (pre-0.9 brokers)
 OFFSETCOMMIT_V0_REQ = Schema(

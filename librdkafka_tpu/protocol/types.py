@@ -134,14 +134,21 @@ class Array:
 
 
 class Schema:
-    """Named-field record; values are plain dicts."""
+    """Named-field record; values are plain dicts. ``defaults`` supplies
+    values for fields a caller may omit (e.g. flags added by a later
+    protocol version, so version-agnostic request bodies keep working)."""
 
-    def __init__(self, *fields: tuple[str, Any]):
+    def __init__(self, *fields: tuple[str, Any],
+                 defaults: dict | None = None):
         self.fields = fields
+        self.defaults = defaults or {}
 
     def write(self, buf, val: dict):
         for name, typ in self.fields:
-            typ.write(buf, val[name])
+            if name in val:
+                typ.write(buf, val[name])
+            else:                   # KeyError unless a default exists
+                typ.write(buf, self.defaults[name])
 
     def read(self, sl) -> dict:
         return {name: typ.read(sl) for name, typ in self.fields}

@@ -17,16 +17,19 @@ def frame_strip(b: bytes) -> bytes:
 def test_request_header_roundtrip():
     wire = apis.build_request(ApiKey.Metadata, 77, "cid", {"topics": None})
     hdr, body = apis.parse_request(frame_strip(wire))
-    assert hdr == {"api_key": 3, "api_version": 2, "correlation_id": 77,
+    assert hdr == {"api_key": 3, "api_version": 4, "correlation_id": 77,
                    "client_id": "cid"}
-    assert body == {"topics": None}
+    # v4: the omitted KIP-204 flag serializes via the schema default
+    assert body == {"topics": None, "allow_auto_topic_creation": True}
 
 
 SAMPLES = {
     ApiKey.ApiVersions: ({}, {
         "error_code": 0,
         "api_versions": [{"api_key": 0, "min_version": 0, "max_version": 7}]}),
-    ApiKey.Metadata: ({"topics": ["t1", "t2"]}, {
+    ApiKey.Metadata: ({"topics": ["t1", "t2"],
+                       "allow_auto_topic_creation": False}, {
+        "throttle_time_ms": 0,
         "brokers": [{"node_id": 1, "host": "localhost", "port": 9092,
                      "rack": None}],
         "cluster_id": "mockCluster", "controller_id": 1,

@@ -1,0 +1,148 @@
+"""SASL GSSAPI provider tests (reference: rdkafka_sasl_cyrus.c:1-645).
+
+No KDC is available here, so the GSS *mechanism* is a scripted context
+injected through GssapiClient's ctx_factory; what these tests pin down
+is everything the client itself owns: the RFC 4752 token relay, the
+security-layer negotiation bytes, the authzid from sasl.kerberos.*
+conf, the hostbased service name, and the fail-fast gate when
+python-gssapi is absent.
+"""
+import struct
+
+import pytest
+
+from librdkafka_tpu.client.conf import Conf
+from librdkafka_tpu.client.errors import KafkaException
+from librdkafka_tpu.client.sasl import (GssapiClient, gssapi_available,
+                                        validate_mechanism)
+
+# Recorded-shape vectors: opaque context tokens (contents arbitrary —
+# GSS tokens are opaque to SASL; the framing around them is what must
+# be exact).
+TOK_AP_REQ = b"\x60\x82\x01\x23APREQ-token-bytes"
+TOK_AP_REP = b"\x6f\x81\x99APREP-token-bytes"
+SSF_NONE_1MB = bytes([0x01, 0x10, 0x00, 0x00])   # layer NONE, max 1MB
+
+
+class ScriptedCtx:
+    """Stand-in GSS security context with the python-gssapi surface the
+    provider uses: step/complete/unwrap/wrap."""
+
+    class _Wrapped:
+        def __init__(self, message):
+            self.message = message
+
+    def __init__(self, service, host, ssf_plain=SSF_NONE_1MB):
+        self.service = service
+        self.host = host
+        self.ssf_plain = ssf_plain
+        self.complete = False
+        self.steps = 0
+        self.wrapped_out = None
+
+    def step(self, tok):
+        self.steps += 1
+        if self.steps == 1:
+            assert tok is None
+            return TOK_AP_REQ
+        # second step consumes AP-REP, completes, no output token
+        assert tok == TOK_AP_REP
+        self.complete = True
+        return None
+
+    def unwrap(self, data):
+        assert data == b"WRAPPED[" + self.ssf_plain + b"]"
+        return self._Wrapped(self.ssf_plain)
+
+    def wrap(self, data, encrypt):
+        assert encrypt is False
+        self.wrapped_out = data
+        return self._Wrapped(b"WRAPPED[" + data + b"]")
+
+
+class _RkStub:
+    def __init__(self, **conf):
+        self.conf = Conf()
+        self.conf.update({"security.protocol": "sasl_plaintext",
+                          "sasl.mechanisms": "PLAIN", **conf})
+
+
+def make_client(**conf):
+    rk = _RkStub(**conf)
+    ctxs = []
+
+    def factory(service, host):
+        c = ScriptedCtx(service, host)
+        ctxs.append(c)
+        return c
+
+    cli = GssapiClient(rk, "broker1.example.com", ctx_factory=factory)
+    return cli, ctxs[0]
+
+
+def test_token_relay_and_security_layer_exchange():
+    cli, ctx = make_client(
+        **{"sasl.kerberos.principal": "client@EXAMPLE.COM"})
+    # phase 1: context establishment
+    assert cli.first_message() == TOK_AP_REQ
+    assert cli.step(TOK_AP_REP) == b""       # AP-REP consumed, no token
+    assert ctx.complete
+    # phase 2: server's wrapped [bitmask|max]; client answers wrapped
+    # [LAYER_NONE << 24 | authzid]
+    out = cli.step(b"WRAPPED[" + SSF_NONE_1MB + b"]")
+    assert out == b"WRAPPED[" + struct.pack(">I", 0x01000000) \
+        + b"client@EXAMPLE.COM]"
+    assert ctx.wrapped_out[:4] == struct.pack(">I", 0x01000000)
+    # phase 3: done — outcome arrives via error_code
+    assert cli.step(b"") is None
+
+
+def test_hostbased_service_name_from_conf():
+    cli, ctx = make_client(
+        **{"sasl.kerberos.service.name": "brokersvc"})
+    assert ctx.service == "brokersvc"
+    assert ctx.host == "broker1.example.com"
+
+
+def test_default_service_name_is_kafka():
+    cli, ctx = make_client()
+    assert ctx.service == "kafka"
+
+
+def test_server_without_layer_none_is_rejected():
+    rk = _RkStub()
+    ctx_holder = []
+
+    def factory(service, host):
+        c = ScriptedCtx(service, host,
+                        ssf_plain=bytes([0x04, 0, 0x40, 0]))  # conf only
+        ctx_holder.append(c)
+        return c
+
+    cli = GssapiClient(rk, "h", ctx_factory=factory)
+    cli.first_message()
+    cli.step(TOK_AP_REP)
+    with pytest.raises(KafkaException, match="security layer"):
+        cli.step(b"WRAPPED[" + bytes([0x04, 0, 0x40, 0]) + b"]")
+
+
+def test_malformed_ssf_token_is_rejected():
+    cli, ctx = make_client()
+    cli.first_message()
+    cli.step(TOK_AP_REP)
+    ctx.ssf_plain = b"\x01\x00"          # 2 bytes, want 4
+    with pytest.raises(KafkaException, match="malformed"):
+        cli.step(b"WRAPPED[" + b"\x01\x00" + b"]")
+
+
+@pytest.mark.skipif(gssapi_available(),
+                    reason="python-gssapi installed: gate inactive")
+def test_fail_fast_without_python_gssapi():
+    """Without the gssapi package, selecting GSSAPI must fail at client
+    creation (reference: a build without WITH_SASL_CYRUS rejects it in
+    rd_kafka_sasl_select_provider)."""
+    conf = Conf()
+    conf.update({"security.protocol": "sasl_plaintext",
+                 "sasl.mechanisms": "GSSAPI"})
+    with pytest.raises(KafkaException, match="python-gssapi"):
+        validate_mechanism(conf)

@@ -1,0 +1,66 @@
+"""OAUTHBEARER end-to-end against the mock cluster (reference:
+rdkafka_sasl_oauthbearer.c — unsecured-JWS builtin handler, app token
+via rd_kafka_oauthbearer_set_token, refresh callback flow)."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"auth": 1})
+    yield c
+    c.stop()
+
+
+def _conf(cluster, **extra):
+    return {"bootstrap.servers": cluster.bootstrap_servers(),
+            "security.protocol": "sasl_plaintext",
+            "sasl.mechanisms": "OAUTHBEARER", **extra}
+
+
+def test_unsecured_jws_builtin_handler(cluster):
+    """enable.sasl.oauthbearer.unsecure.jwt=true: the builtin handler
+    fabricates an unsecured JWS and auth succeeds."""
+    p = Producer(_conf(cluster, **{
+        "enable.sasl.oauthbearer.unsecure.jwt": True,
+        "sasl.oauthbearer.config": "principal=tester"}))
+    p.produce("auth", value=b"jws-ok", partition=0)
+    assert p.flush(15.0) == 0
+    p.close()
+
+
+def test_refresh_cb_supplies_token(cluster):
+    """The refresh callback path: no unsecured-JWS handler, the app cb
+    sets the token (rd_kafka_oauthbearer_set_token)."""
+    calls = []
+
+    def refresh(rk_handle, cfg):
+        calls.append(cfg)
+        rk_handle.set_oauthbearer_token(
+            "eyJhbGciOiJub25lIn0.eyJzdWIiOiJ0In0.",
+            lifetime_ms=int((time.time() + 300) * 1000),
+            principal="t")
+
+    p = Producer(_conf(cluster, **{
+        "oauthbearer_token_refresh_cb": refresh}))
+    p.produce("auth", value=b"refresh-ok", partition=0)
+    assert p.flush(15.0) == 0
+    assert calls, "refresh callback never invoked"
+    p.close()
+
+
+def test_no_token_and_handler_disabled_fails_auth(cluster):
+    """Default enable.sasl.oauthbearer.unsecure.jwt=false and no app
+    token: auth must FAIL (never a silent unsecured-JWS fallback)."""
+    drs = []
+    p = Producer(_conf(cluster, **{
+        "message.timeout.ms": 1500,
+        "dr_msg_cb": lambda e, m: drs.append(e)}))
+    p.produce("auth", value=b"denied", partition=0)
+    assert p.flush(10.0) == 0
+    assert len(drs) == 1 and drs[0] is not None
+    p.close()
