@@ -1,0 +1,32 @@
+"""librdkafka_tpu — a TPU-native Apache Kafka client framework.
+
+A brand-new implementation with the capabilities of librdkafka v1.3.0
+(reference: /root/reference, see SURVEY.md): producer (batched, compressed,
+idempotent), simple + balanced consumers, admin client, statistics,
+interceptors, and an in-process mock broker cluster — with the hot
+MessageSet v2 codec path (per-batch compression + CRC32C) offloadable to
+TPU via a JAX/Pallas sidecar selected by ``compression.backend=tpu``.
+
+Layering (bottom → top), mirroring the reference's layer map (SURVEY.md §1):
+
+- ``utils``    — L0/L1: segmented zero-copy buffers, varint, CRC32C, murmur2
+- ``ops``      — codec providers: native C++ (ctypes) CPU path, JAX/Pallas TPU path
+- ``protocol`` — L4/L6: Kafka wire protocol, MessageSet v2 writer/reader
+- ``client``   — L5/L7/L8: broker engine, producer/consumer/admin, config, stats
+- ``mock``     — in-process mock broker cluster (brokerless testing)
+- ``parallel`` — multi-chip sharded codec offload over a jax.sharding.Mesh
+- ``models``   — the flagship batched-codec pipeline (entry point for jit)
+"""
+
+__version__ = "0.1.0"
+# Wire-compatible with the reference's feature level (rdkafka.h:151,
+# RD_KAFKA_VERSION 0x010300ff == v1.3.0).
+REFERENCE_VERSION = "1.3.0"
+
+from .client.errors import KafkaError, KafkaException  # noqa: F401
+from .client.conf import Conf, TopicConf  # noqa: F401
+from .client.producer import Producer  # noqa: F401
+from .client.consumer import Consumer  # noqa: F401
+from .client.admin import (AdminClient, ConfigEntry, ConfigResource,  # noqa: F401
+                           NewPartitions, NewTopic)
+from .client.event import Event  # noqa: F401

@@ -1,0 +1,163 @@
+"""Fast-lane produce batches backed by the native enqueue arena.
+
+The reference enqueues produce()d records with zero per-record
+allocations (rd_kafka_toppar_enq_msg, rdkafka_msg.c:241); the Python
+client's per-record ``Message`` object was the GIL ceiling on the app
+thread (~7 µs/record).  The fast lane appends key/value straight into a
+per-toppar native arena (ops/native/enqlane.cpp) and the broker thread
+take()s contiguous runs that the native framer consumes directly —
+``ArenaBatch`` is that run flowing through the same produce pipeline as
+a ``list[Message]`` batch (codec phase → send → response → retry/DR).
+
+Eligibility (checked in Kafka.produce): no DR consumers (no dr
+callbacks, no "dr" events, no background thread), no interceptors,
+explicit partition, bytes/None key+value, no headers/on_delivery/
+opaque/timestamp.  Anything else falls back to the Message path; a
+toppar that sees a fallback message is permanently demoted (arena
+drained into Messages first — FIFO order is preserved exactly).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+_enqlane = None
+_enqlane_err = False
+
+
+def _mod():
+    global _enqlane, _enqlane_err
+    if _enqlane is None and not _enqlane_err:
+        try:
+            from ..ops.native.build import load_enqlane
+            _enqlane = load_enqlane()
+        except Exception:
+            _enqlane_err = True
+    return _enqlane
+
+
+def arena_new():
+    """A new native Arena, or None when the extension can't build."""
+    m = _mod()
+    return m.Arena() if m else None
+
+
+class _PyLane:
+    """Pure-Python Lane stand-in when the C extension is unavailable:
+    same interface, always routes produce() to the fallback."""
+
+    def __init__(self):
+        import threading
+        self.map: dict = {}
+        self.enabled = 0
+        self.fatal = 0
+        self.msg_cnt = 0
+        self.msg_bytes = 0
+        self.max_msgs = 100000
+        self.max_bytes = 1 << 30
+        self._fallback = None
+        self._lock = threading.Lock()
+
+    def configure(self, fallback, wake, max_msgs, max_bytes,
+                  copy_max=None):
+        # copy_max (message.copy.max.bytes) is irrelevant here: this
+        # stand-in never copies into an arena — everything already takes
+        # the reference-holding Message path
+        self._fallback = fallback
+        self.max_msgs = max_msgs
+        self.max_bytes = max_bytes
+
+    def acct(self, dn: int, dbytes: int):
+        with self._lock:
+            self.msg_cnt += dn
+            self.msg_bytes += dbytes
+            return (self.msg_cnt, self.msg_bytes)
+
+    def full(self, sz: int = 0) -> bool:
+        return (self.msg_cnt >= self.max_msgs
+                or self.msg_bytes + sz > self.max_bytes)
+
+    def produce(self, *args, **kwargs):
+        return self._fallback(*args, **kwargs)
+
+
+def lane_new():
+    """A native Lane (C produce entry point + shared counters), or the
+    Python stand-in."""
+    m = _mod()
+    return m.Lane() if m else _PyLane()
+
+
+class ArenaBatch:
+    """One taken arena run: the fast-lane analog of list[Message].
+
+    ``base`` is the concatenated key||value payload bytes; ``klens`` /
+    ``vlens`` are raw little-endian int32 arrays (-1 = null) that
+    tk_frame_v2 reads in place.  msgid_base is assigned at take() time
+    under the toppar lock — idempotent sequence numbering is identical
+    to the Message path's per-enqueue assignment because takes are
+    FIFO and exclusive."""
+
+    __slots__ = ("base", "klens", "vlens", "count", "nbytes",
+                 "msgid_base", "enq_first", "enq_last", "retries",
+                 "possibly_persisted")
+
+    def __init__(self, base: bytes, klens: bytes, vlens: bytes,
+                 count: int, nbytes: int, enq_first_us: int,
+                 enq_last_us: int):
+        self.base = base
+        self.klens = klens
+        self.vlens = vlens
+        self.count = count
+        self.nbytes = nbytes
+        self.enq_first = enq_first_us / 1e6     # time.monotonic() seconds
+        self.enq_last = enq_last_us / 1e6
+        self.msgid_base = 0
+        self.retries = 0
+        self.possibly_persisted = False
+
+    def __len__(self) -> int:
+        return self.count
+
+    def to_messages(self, topic: str = "") -> list:
+        """Materialize per-record Message objects (rare paths only:
+        legacy MsgVer0/1 brokers)."""
+        import numpy as np
+
+        from .msg import Message
+
+        kl = np.frombuffer(self.klens, np.int32)
+        vl = np.frombuffer(self.vlens, np.int32)
+        out = []
+        off = 0
+        for i in range(self.count):
+            k = v = None
+            if kl[i] >= 0:
+                k = self.base[off:off + kl[i]]
+                off += int(kl[i])
+            if vl[i] >= 0:
+                v = self.base[off:off + vl[i]]
+                off += int(vl[i])
+            m = Message(topic, value=v, key=k)
+            m.msgid = self.msgid_base + i
+            m.enq_time = self.enq_first
+            m.retries = self.retries
+            out.append(m)
+        return out
+
+    def __repr__(self):
+        return (f"ArenaBatch(n={self.count}, bytes={self.nbytes}, "
+                f"msgid_base={self.msgid_base})")
+
+
+def batch_head_msgid(batch) -> int:
+    """First msgid of a produce batch (list[Message] | ArenaBatch)."""
+    if isinstance(batch, ArenaBatch):
+        return batch.msgid_base
+    return batch[0].msgid
+
+
+def batch_msgids(batch) -> list:
+    """All msgids of a batch — the DRAIN rebase's pending scan."""
+    if isinstance(batch, ArenaBatch):
+        return [batch.msgid_base + i for i in range(batch.count)]
+    return [m.msgid for m in batch]

@@ -1,0 +1,108 @@
+"""Partition assignors + consumer-protocol metadata marshalling.
+
+Reference: src/rdkafka_assignor.c (pluggable partition.assignment.strategy,
+protocol metadata wire format) with the builtin range
+(rdkafka_range_assignor.c) and roundrobin (rdkafka_roundrobin_assignor.c)
+strategies; rd_kafka_assignor_run (:283) executes on the elected leader.
+
+Wire formats are the public Kafka "consumer" embedded protocol:
+  Subscription: Version i16, Topics [String], UserData Bytes
+  Assignment:   Version i16, [Topic String, Partitions [Int32]], UserData
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from ..protocol.types import Array, Bytes, Int16, Int32, Schema, String
+from ..utils.buf import SegBuf, Slice
+
+SUBSCRIPTION_SCHEMA = Schema(
+    ("version", Int16), ("topics", Array(String)), ("user_data", Bytes))
+ASSIGNMENT_SCHEMA = Schema(
+    ("version", Int16),
+    ("topics", Array(Schema(("topic", String),
+                            ("partitions", Array(Int32))))),
+    ("user_data", Bytes))
+
+
+def subscription_encode(topics: list[str], user_data: bytes = b"") -> bytes:
+    buf = SegBuf()
+    SUBSCRIPTION_SCHEMA.write(buf, {"version": 0, "topics": sorted(topics),
+                                    "user_data": user_data})
+    return buf.as_bytes()
+
+
+def subscription_decode(data: bytes) -> dict:
+    return SUBSCRIPTION_SCHEMA.read(Slice(data))
+
+
+def assignment_encode(assignment: dict[str, list[int]],
+                      user_data: bytes = b"") -> bytes:
+    buf = SegBuf()
+    ASSIGNMENT_SCHEMA.write(buf, {
+        "version": 0,
+        "topics": [{"topic": t, "partitions": sorted(ps)}
+                   for t, ps in sorted(assignment.items())],
+        "user_data": user_data})
+    return buf.as_bytes()
+
+
+def assignment_decode(data: bytes) -> dict[str, list[int]]:
+    if not data:
+        return {}
+    parsed = ASSIGNMENT_SCHEMA.read(Slice(data))
+    return {t["topic"]: t["partitions"] for t in parsed["topics"]}
+
+
+def range_assignor(members: dict[str, list[str]],
+                   partitions: dict[str, int]) -> dict[str, dict[str, list[int]]]:
+    """Per-topic contiguous ranges (Java RangeAssignor semantics):
+    for each topic, sort consumers; first (n_parts % n_consumers) consumers
+    get one extra partition."""
+    out: dict[str, dict[str, list[int]]] = {m: {} for m in members}
+    topics: dict[str, list[str]] = {}
+    for member, subscribed in members.items():
+        for t in subscribed:
+            topics.setdefault(t, []).append(member)
+    for topic, consumers in topics.items():
+        nparts = partitions.get(topic, 0)
+        if nparts <= 0:
+            continue
+        consumers = sorted(consumers)
+        n = len(consumers)
+        per, extra = divmod(nparts, n)
+        start = 0
+        for i, c in enumerate(consumers):
+            cnt = per + (1 if i < extra else 0)
+            if cnt:
+                out[c][topic] = list(range(start, start + cnt))
+            start += cnt
+    return out
+
+
+def roundrobin_assignor(members: dict[str, list[str]],
+                        partitions: dict[str, int]) -> dict[str, dict[str, list[int]]]:
+    """All (topic, partition) pairs sorted, dealt round-robin to the sorted
+    eligible consumers (Java RoundRobinAssignor semantics)."""
+    out: dict[str, dict[str, list[int]]] = {m: {} for m in members}
+    pairs = []
+    for t in sorted(partitions):
+        for p in range(partitions[t]):
+            pairs.append((t, p))
+    consumers = sorted(members)
+    i = 0
+    for t, p in pairs:
+        # find next consumer subscribed to t
+        for _ in range(len(consumers)):
+            c = consumers[i % len(consumers)]
+            i += 1
+            if t in members[c]:
+                out[c].setdefault(t, []).append(p)
+                break
+    return out
+
+
+ASSIGNORS: dict[str, Callable] = {
+    "range": range_assignor,
+    "roundrobin": roundrobin_assignor,
+}

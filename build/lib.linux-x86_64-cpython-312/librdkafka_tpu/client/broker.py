@@ -188,14 +188,6 @@ class Broker:
         self._rbuf = bytearray()
         self._wbuf = bytearray()
         self._wbuf_off = 0              # consumed prefix (offset send)
-        # built-but-untransmitted request accounting for
-        # queue.buffering.backpressure.threshold (reference: rkb_outbufs
-        # count, rdkafka_broker.c:3262). Monotonic byte totals survive
-        # wbuf compaction; the deque holds each queued request's end
-        # position in queued-bytes space.
-        self._wbuf_queued_total = 0
-        self._wbuf_sent_total = 0
-        self._unsent_req_ends: deque[int] = deque()
         self._wakeup_r, self._wakeup_w = socket.socketpair()
         self._wakeup_r.setblocking(False)
         # non-blocking: a full pipe must drop the wakeup byte (reader is
@@ -516,9 +508,6 @@ class Broker:
         self._rbuf.clear()
         self._wbuf.clear()
         self._wbuf_off = 0
-        self._wbuf_queued_total = 0
-        self._wbuf_sent_total = 0
-        self._unsent_req_ends.clear()
         self.fetch_inflight_cnt = 0
         self._tls_handshaking = False
         # fail all in-flight + queued requests (callers decide on retry)
@@ -557,8 +546,6 @@ class Broker:
                                   self.rk.conf.get("client.id"), req.body,
                                   version=ver)
         self._wbuf += wire
-        self._wbuf_queued_total += len(wire)
-        self._unsent_req_ends.append(self._wbuf_queued_total)
         self.c_tx += 1
         self.c_tx_bytes += len(wire)
         req.ts_sent = time.monotonic()
@@ -587,10 +574,6 @@ class Broker:
             self._disconnect(KafkaError(Err._TRANSPORT,
                                         f"send failed: {err}"))
             return
-        self._wbuf_sent_total += off - self._wbuf_off
-        while (self._unsent_req_ends
-               and self._unsent_req_ends[0] <= self._wbuf_sent_total):
-            self._unsent_req_ends.popleft()
         self._wbuf_off = sockbuf.compact_consumed(self._wbuf, off)
 
     def _io_serve(self, timeout: float = 0.005):
@@ -761,12 +744,11 @@ class Broker:
                 and self._codec_outstanding >= rk.codec_pipeline_depth):
             return
         # queue.buffering.backpressure.threshold: with this many built-
-        # but-untransmitted requests still sitting in the socket write
-        # buffer, hold off forming new MessageSets — messages keep
-        # accumulating into bigger batches instead (reference:
-        # rd_kafka_toppar_producer_serve's outbuf backpressure,
-        # rdkafka_broker.c:3262)
-        if len(self._unsent_req_ends) >= rk.conf.get(
+        # but-untransmitted requests already queued on the socket, hold
+        # off forming new MessageSets — messages keep accumulating into
+        # bigger batches instead (reference: rd_kafka_toppar_producer_
+        # serve's outbuf backpressure, rdkafka_broker.c:3262)
+        if len(self.outq) >= rk.conf.get(
                 "queue.buffering.backpressure.threshold"):
             return
         ready: list[tuple] = []   # (toppar, msgs, writer|None-when-legacy)

@@ -1,0 +1,543 @@
+"""Consumer group coordinator ("cgrp") state machine.
+
+Reference: src/rdkafka_cgrp.c (3547 LoC) — two nested FSMs driven from the
+main thread via serve() (rd_kafka_cgrp_serve, :3231): the coordinator
+query/connect FSM (states rdkafka_cgrp.h:61-79) and the join FSM
+(WAIT_JOIN → WAIT_SYNC → WAIT_ASSIGN_REBALANCE_CB → STARTED,
+rdkafka_cgrp.h:86-111). The elected leader runs the assignor
+(handle_JoinGroup :894 → assignor_run). Heartbeats (:1469) detect
+generation changes; max.poll.interval.ms is enforced here (:2742).
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional, TYPE_CHECKING
+
+from ..protocol.proto import ApiKey
+from .assignor import (ASSIGNORS, assignment_decode, assignment_encode,
+                       subscription_decode, subscription_encode)
+from .broker import Request
+from .errors import Err, KafkaError
+from .queue import Op, OpType
+
+if TYPE_CHECKING:
+    from .kafka import Kafka
+
+
+class ConsumerGroup:
+    def __init__(self, rk: "Kafka", group_id: str):
+        self.rk = rk
+        self.group_id = group_id
+        self.state = "init"            # coordinator FSM
+        self.join_state = "init"       # join FSM
+        self.coord_id = -1
+        self.member_id = ""
+        self.generation = -1
+        self.protocol = ""
+        self.subscription: list[str] = []
+        self.patterns: list = []            # compiled ^regex subscriptions
+        self._matched: set[str] = set()     # topics currently matching
+        # bumped by rejoin(); a JoinGroup begun under an older version is
+        # abandoned on response instead of syncing a stale subscription
+        self.sub_version = 0
+        self._join_version = 0
+        self.assignment: dict[str, list[int]] = {}
+        self.rebalance_cnt = 0
+        self.last_heartbeat = 0.0
+        self.last_coord_query = 0.0
+        self.last_poll = time.monotonic()
+        self.max_poll_exceeded = False
+        self._pending = False          # a request is in flight
+        self._wait_rebalance_cb = False
+        self._auto_commit_next = 0.0
+        self.terminated = False
+
+    # ------------------------------------------------------------ public --
+    def subscribe(self, topics: list[str]):
+        """Topics starting with ``^`` are regex patterns matched against
+        the full cluster topic list (reference: rdkafka_pattern.c topic
+        pattern lists; the ``^`` prefix is part of the regex, matched
+        with search semantics like the reference's regexec).
+
+        All patterns are validated before any state changes (like the
+        reference, a bad pattern fails the whole subscribe atomically)."""
+        pats = []
+        for t in topics:
+            if t.startswith("^"):
+                try:
+                    pats.append(re.compile(t))
+                except re.error as e:
+                    from .errors import KafkaException
+                    raise KafkaException(Err._INVALID_ARG,
+                                         f"bad subscription regex {t!r}: {e}")
+        self.subscription = list(topics)
+        self.patterns = pats
+        self._matched = set()
+        # literals after patterns are installed: their metadata_refresh
+        # must request the FULL topic list for pattern discovery
+        for t in topics:
+            if not t.startswith("^"):
+                self.rk.get_topic(t)
+        if self.patterns:
+            self.rk.metadata_refresh("regex subscription")
+        self.rejoin("subscribe")
+
+    def effective_subscription(self) -> list[str]:
+        """Literal topics + current regex matches."""
+        lits = [t for t in self.subscription if not t.startswith("^")]
+        return sorted(set(lits) | self._matched)
+
+    def metadata_update(self, topic_names) -> None:
+        """Re-evaluate regex patterns against a fresh full topic list
+        (reference: rd_kafka_cgrp_metadata_update_check); rejoin when the
+        matched set changes so the group rebalances onto new topics."""
+        if not self.patterns:
+            return
+        matched = {t for t in topic_names
+                   if not self.rk.blacklisted(t)
+                   and any(p.search(t) for p in self.patterns)}
+        if matched == self._matched:
+            return
+        added = matched - self._matched
+        self._matched = matched
+        for t in added:
+            self.rk.get_topic(t)
+        self.rejoin(f"regex match changed (+{sorted(added)})")
+
+    def unsubscribe(self):
+        self.subscription = []
+        self.patterns = []
+        self._matched = set()
+        self.sub_version += 1    # abandon any JoinGroup in flight
+        self._leave()
+
+    def poll_tick(self):
+        self.last_poll = time.monotonic()
+        self.max_poll_exceeded = False
+
+    def rejoin(self, reason: str):
+        self.rk.dbg("cgrp", f"rejoin: {reason}")
+        self.sub_version += 1
+        if self.join_state in ("started", "steady"):
+            self._trigger_rebalance_revoke()
+        self.join_state = "init"
+
+    # ------------------------------------------------------------- serve --
+    def serve(self):
+        """Called from the main thread loop (rd_kafka_cgrp_serve)."""
+        if self.terminated or not self.subscription:
+            return
+        now = time.monotonic()
+        # max.poll.interval.ms enforcement (reference :2742)
+        mpi = self.rk.conf.get("max.poll.interval.ms") / 1000.0
+        if (self.join_state == "steady" and not self.max_poll_exceeded
+                and now - self.last_poll > mpi):
+            self.max_poll_exceeded = True
+            self.rk.op_err(KafkaError(
+                Err._MAX_POLL_EXCEEDED,
+                f"application maximum poll interval ({int(mpi * 1000)}ms) "
+                "exceeded"))
+            self._leave()
+            return
+        if self.state != "up":
+            self._coord_query(now)
+            return
+        if self._pending:
+            return
+        if self.join_state == "init":
+            self._join()
+        elif self.join_state == "steady":
+            hb = self.rk.conf.get("heartbeat.interval.ms") / 1000.0
+            if now - self.last_heartbeat >= hb:
+                self._heartbeat()
+            self._serve_auto_commit(now)
+
+    # ------------------------------------------------- coordinator query --
+    def _coord_query(self, now: float):
+        # fast 1s retry while the coordinator is unknown, capped by
+        # coordinator.query.interval.ms (reference coord_query_intvl)
+        ivl = min(1.0,
+                  self.rk.conf.get("coordinator.query.interval.ms") / 1e3)
+        if self._pending or now - self.last_coord_query < ivl:
+            return
+        b = self.rk.any_up_broker()
+        if b is None:
+            return
+        self.last_coord_query = now
+        self._pending = True
+        self.state = "query-coord"
+        b.enqueue_request(Request(
+            ApiKey.FindCoordinator, {"key": self.group_id, "key_type": 0},
+            cb=self._handle_coord))
+
+    def _handle_coord(self, err, resp):
+        self._pending = False
+        if err is not None or resp["error_code"] != 0:
+            self.state = "init"
+            return
+        self.coord_id = resp["node_id"]
+        with self.rk._brokers_lock:
+            known = self.coord_id in self.rk.brokers
+        if not known:
+            self.rk.metadata_refresh("coordinator unknown")
+            self.state = "init"
+            return
+        self.state = "up"
+        self.rk.dbg("cgrp", f"coordinator is broker {self.coord_id}")
+
+    def _coord_broker(self):
+        with self.rk._brokers_lock:
+            b = self.rk.brokers.get(self.coord_id)
+        if b is None or not b.is_up():
+            self.state = "init"
+            return None
+        return b
+
+    # --------------------------------------------------------------- join --
+    def _join(self):
+        b = self._coord_broker()
+        if b is None:
+            return
+        self._pending = True
+        self.join_state = "wait-join"
+        self._join_version = self.sub_version
+        names = self.rk.conf.get("partition.assignment.strategy").split(",")
+        meta = subscription_encode(self.effective_subscription())
+        self.rk.dbg("cgrp", f"joining group {self.group_id!r} "
+                            f"member={self.member_id!r}")
+        b.enqueue_request(Request(
+            ApiKey.JoinGroup,
+            {"group_id": self.group_id,
+             "session_timeout": self.rk.conf.get("session.timeout.ms"),
+             "rebalance_timeout": self.rk.conf.get("max.poll.interval.ms"),
+             "member_id": self.member_id,
+             # KIP-345 static membership (JoinGroup v5+)
+             "group_instance_id":
+                 self.rk.conf.get("group.instance.id") or None,
+             "protocol_type": self.rk.conf.get("group.protocol.type"),
+             "protocols": [{"name": n.strip(), "metadata": meta}
+                           for n in names if n.strip()]},
+            cb=self._handle_join,
+            abs_timeout=time.monotonic() +
+            self.rk.conf.get("max.poll.interval.ms") / 1000.0 + 5))
+
+    def _handle_join(self, err, resp):
+        self._pending = False
+        if self.sub_version != self._join_version:
+            # subscription changed while the JoinGroup was in flight
+            # (e.g. a regex matched new topics): abandon and rejoin with
+            # the fresh effective subscription. Keep the broker-assigned
+            # member_id — rejoining with it replaces our slot instead of
+            # leaving a ghost member that stalls the group's rebalance
+            if err is None and resp.get("member_id"):
+                self.member_id = resp["member_id"]
+            self.join_state = "init"
+            return
+        if err is not None:
+            self.join_state = "init"
+            return
+        ec = Err.from_wire(resp["error_code"])
+        if ec == Err.MEMBER_ID_REQUIRED:
+            self.member_id = resp["member_id"]
+            self.join_state = "init"
+            return
+        if ec in (Err.UNKNOWN_MEMBER_ID, Err.ILLEGAL_GENERATION):
+            self.member_id = ""
+            self.join_state = "init"
+            return
+        if ec == Err.NOT_COORDINATOR or ec == Err.COORDINATOR_NOT_AVAILABLE:
+            self.state = "init"
+            self.join_state = "init"
+            return
+        if ec != Err.NO_ERROR:
+            self.join_state = "init"
+            return
+        self.member_id = resp["member_id"]
+        self.generation = resp["generation_id"]
+        self.protocol = resp["protocol"]
+        is_leader = resp["leader_id"] == self.member_id
+        self.rk.dbg("cgrp", f"joined gen {self.generation} "
+                            f"{'as leader' if is_leader else ''}")
+        assignments = []
+        if is_leader:
+            assignments = self._run_assignor(resp["members"])
+        self._sync(assignments)
+
+    def _run_assignor(self, members: list[dict]) -> list[dict]:
+        """Leader-side assignment (reference: rd_kafka_assignor_run)."""
+        subs = {m["member_id"]:
+                subscription_decode(m["metadata"])["topics"]
+                for m in members}
+        all_topics = sorted({t for ts in subs.values() for t in ts})
+        # partition counts from metadata (refresh if missing)
+        with self.rk._metadata_lock:
+            parts = {t: len(self.rk.metadata["topics"].get(t, {}))
+                     for t in all_topics}
+        missing = [t for t, n in parts.items() if n == 0]
+        if missing:
+            self.rk.metadata_refresh(f"assignor needs {missing}")
+        fn = ASSIGNORS.get(self.protocol, ASSIGNORS["range"])
+        per_member = fn(subs, parts)
+        return [{"member_id": m,
+                 "assignment": assignment_encode(a)}
+                for m, a in per_member.items()]
+
+    def _sync(self, assignments: list[dict]):
+        b = self._coord_broker()
+        if b is None:
+            self.join_state = "init"
+            return
+        self._pending = True
+        self.join_state = "wait-sync"
+        b.enqueue_request(Request(
+            ApiKey.SyncGroup,
+            {"group_id": self.group_id, "generation_id": self.generation,
+             "member_id": self.member_id, "assignments": assignments},
+            cb=self._handle_sync))
+
+    def _handle_sync(self, err, resp):
+        self._pending = False
+        if err is not None:
+            self.join_state = "init"
+            return
+        ec = Err.from_wire(resp["error_code"])
+        if ec != Err.NO_ERROR:
+            if ec in (Err.UNKNOWN_MEMBER_ID,):
+                self.member_id = ""
+            self.join_state = "init"
+            return
+        new_assignment = assignment_decode(resp["assignment"] or b"")
+        self.rebalance_cnt += 1
+        self.last_heartbeat = time.monotonic()
+        self.rk.dbg("cgrp", f"assignment: {new_assignment}")
+        self._deliver_rebalance(Err._ASSIGN_PARTITIONS, new_assignment)
+
+    def _deliver_rebalance(self, code: Err, assignment: dict):
+        """Rebalance op to the app (or auto-apply)
+        (reference: rd_kafka_cgrp_rebalance → op to app queue)."""
+        consumer = self.rk.consumer
+        if self.rk.conf.get("rebalance_cb"):
+            self.join_state = "wait-assign-rebalance-cb"
+            self._wait_rebalance_cb = True
+            consumer.queue.push(Op(OpType.REBALANCE,
+                                   payload=(code, assignment)))
+        else:
+            if code == Err._ASSIGN_PARTITIONS:
+                consumer.apply_assignment(assignment)
+            else:
+                consumer.apply_assignment({})
+            self.join_state = "steady"
+
+    def rebalance_done(self, assigned: bool):
+        """Called after the app's assign()/unassign() in the rebalance cb."""
+        self._wait_rebalance_cb = False
+        self.join_state = "steady" if assigned else "init"
+
+    def _trigger_rebalance_revoke(self):
+        self._deliver_rebalance(Err._REVOKE_PARTITIONS, self.assignment)
+
+    # ---------------------------------------------------------- heartbeat --
+    def _heartbeat(self):
+        b = self._coord_broker()
+        if b is None:
+            return
+        self.last_heartbeat = time.monotonic()
+        b.enqueue_request(Request(
+            ApiKey.Heartbeat,
+            {"group_id": self.group_id, "generation_id": self.generation,
+             "member_id": self.member_id},
+            cb=self._handle_heartbeat))
+
+    def _handle_heartbeat(self, err, resp):
+        if err is not None:
+            return
+        ec = Err.from_wire(resp["error_code"])
+        if ec == Err.NO_ERROR:
+            return
+        if ec == Err.REBALANCE_IN_PROGRESS:
+            self.rk.dbg("cgrp", "group is rebalancing")
+            self._trigger_rebalance_revoke()
+            if not self._wait_rebalance_cb:
+                self.join_state = "init"
+        elif ec in (Err.UNKNOWN_MEMBER_ID, Err.ILLEGAL_GENERATION,
+                    Err.FENCED_INSTANCE_ID):
+            self.member_id = "" if ec == Err.UNKNOWN_MEMBER_ID else self.member_id
+            self.join_state = "init"
+        elif ec in (Err.NOT_COORDINATOR, Err.COORDINATOR_NOT_AVAILABLE):
+            self.state = "init"
+
+    # -------------------------------------------------------- auto commit --
+    def _serve_auto_commit(self, now: float):
+        if not self.rk.conf.get("enable.auto.commit"):
+            return
+        ival = self.rk.conf.get("auto.commit.interval.ms") / 1000.0
+        if now < self._auto_commit_next:
+            return
+        self._auto_commit_next = now + ival
+        offsets = self.rk.consumer.stored_offsets()
+        if offsets:
+            self.commit_offsets(offsets, None)
+
+    @staticmethod
+    def _synth_offset_resp(items: dict, with_offsets: bool) -> dict:
+        """Build an OffsetCommit/OffsetFetch-shaped response for locally
+        (file-)stored offsets so every caller sees one response shape."""
+        by_topic: dict[str, list] = {}
+        for (t, p), off in items.items():
+            row = {"partition": p, "error_code": 0, "metadata": None}
+            if with_offsets:
+                row["offset"] = off if off is not None else -1
+            by_topic.setdefault(t, []).append(row)
+        return {"topics": [{"topic": t, "partitions": ps}
+                           for t, ps in by_topic.items()]}
+
+    def commit_offsets(self, offsets: dict[tuple[str, int], int],
+                       cb) -> bool:
+        # legacy file store split (offset.store.method=file,
+        # rdkafka_offset.c:98-330): file-backed topics commit locally
+        rk = self.rk
+        all_offsets = dict(offsets)      # full set for offset_commit_cb
+        store = rk.offset_store
+        if store is not None:
+            file_items = {k: v for k, v in offsets.items()
+                          if store.uses_file(k[0])}
+            if file_items:
+                store.commit_all(file_items)
+                for (t, p), off in file_items.items():
+                    tp = rk.get_toppar(t, p, create=False)
+                    if tp is not None:
+                        tp.committed_offset = off
+                if rk.interceptors:
+                    rk.interceptors.on_commit(file_items)
+                offsets = {k: v for k, v in offsets.items()
+                           if k not in file_items}
+                if not offsets:
+                    if cb:
+                        cb(None, self._synth_offset_resp(file_items, False))
+                    occb = rk.conf.get("offset_commit_cb")
+                    if occb:
+                        occb(None, file_items)
+                    return True
+                # mixed commit: report file-backed partitions alongside
+                # the broker result in both cb's response and occb
+                orig_cb = cb
+
+                def cb(err, resp, _orig=orig_cb, _file=file_items):
+                    if err is None and resp is not None:
+                        resp = dict(resp)
+                        resp["topics"] = (
+                            list(resp["topics"])
+                            + self._synth_offset_resp(_file, False)["topics"])
+                    if _orig:
+                        _orig(err, resp)
+        b = self._coord_broker()
+        if b is None:
+            if cb:
+                cb(KafkaError(Err._WAIT_COORD, "no coordinator"), None)
+            return False
+        by_topic: dict[str, list] = {}
+        for (t, p), off in offsets.items():
+            by_topic.setdefault(t, []).append(
+                {"partition": p, "offset": off, "metadata": None,
+                 "timestamp": -1})    # OffsetCommit v1 field; v2 ignores
+
+        def on_commit(err, resp):
+            if err is None and self.rk.interceptors:
+                self.rk.interceptors.on_commit(offsets)
+            if err is None:
+                for tpc in resp["topics"]:
+                    for pres in tpc["partitions"]:
+                        tp = self.rk.get_toppar(tpc["topic"],
+                                                pres["partition"],
+                                                create=False)
+                        if tp is not None and pres["error_code"] == 0:
+                            tp.committed_offset = offsets.get(
+                                (tpc["topic"], pres["partition"]),
+                                tp.committed_offset)
+            if cb:
+                cb(err, resp)
+            occb = self.rk.conf.get("offset_commit_cb")
+            if occb:
+                occb(err, all_offsets)
+
+        b.enqueue_request(Request(
+            ApiKey.OffsetCommit,
+            {"group_id": self.group_id, "generation_id": self.generation,
+             "member_id": self.member_id, "retention_time": -1,
+             "topics": [{"topic": t, "partitions": ps}
+                        for t, ps in by_topic.items()]},
+            cb=on_commit, retries_left=2))
+        return True
+
+    def fetch_committed(self, tps: list[tuple[str, int]], cb) -> bool:
+        rk = self.rk
+        store = rk.offset_store
+        file_reads: dict[tuple[str, int], Optional[int]] = {}
+        if store is not None:
+            file_tps = [k for k in tps if store.uses_file(k[0])]
+            if file_tps:
+                file_reads = {(t, p): store.read(t, p) for t, p in file_tps}
+                tps = [k for k in tps if k not in file_reads]
+                if not tps:
+                    if cb:
+                        cb(None, self._synth_offset_resp(file_reads, True))
+                    return True
+        b = self._coord_broker()
+        if b is None:
+            if file_reads and cb:
+                # deliver the file offsets we DID read; the broker-backed
+                # partitions fall back to the caller's no-result path
+                cb(None, self._synth_offset_resp(file_reads, True))
+                return True
+            return False
+        by_topic: dict[str, list] = {}
+        for t, p in tps:
+            by_topic.setdefault(t, []).append(p)
+
+        def on_fetch(err, resp):
+            if file_reads:
+                # merge locally-read file offsets into the result; on
+                # broker error still deliver the file offsets rather
+                # than discarding successfully-read local state
+                if err is None:
+                    resp = dict(resp)
+                    resp["topics"] = (list(resp["topics"])
+                                      + self._synth_offset_resp(
+                                          file_reads, True)["topics"])
+                else:
+                    err, resp = None, self._synth_offset_resp(
+                        file_reads, True)
+            cb(err, resp)
+
+        b.enqueue_request(Request(
+            ApiKey.OffsetFetch,
+            {"group_id": self.group_id,
+             "topics": [{"topic": t, "partitions": ps}
+                        for t, ps in by_topic.items()]},
+            cb=on_fetch if cb else None, retries_left=2))
+        return True
+
+    # --------------------------------------------------------------- leave --
+    def _leave(self):
+        b = self._coord_broker()
+        # KIP-345: static members do NOT send LeaveGroup — the member
+        # slot survives restarts until session.timeout.ms (reference:
+        # rd_kafka_cgrp_leave skips for group.instance.id)
+        static = bool(self.rk.conf.get("group.instance.id"))
+        if b is not None and self.member_id and not static:
+            b.enqueue_request(Request(
+                ApiKey.LeaveGroup,
+                {"group_id": self.group_id, "member_id": self.member_id},
+                cb=lambda e, r: None))
+        self.join_state = "init"
+        self.generation = -1
+        self.rk.consumer.apply_assignment({})
+
+    def terminate(self):
+        self.terminated = True
+        offsets = self.rk.consumer.stored_offsets()
+        if offsets and self.rk.conf.get("enable.auto.commit"):
+            self.commit_offsets(offsets, None)
+            time.sleep(0.05)  # give the commit a beat to transmit
+        self._leave()

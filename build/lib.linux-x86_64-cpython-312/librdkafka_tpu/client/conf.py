@@ -1,0 +1,479 @@
+"""Configuration system.
+
+A single declarative property table, the same design as the reference's
+``rd_kafka_properties`` table (src/rdkafka_conf.c:224): each property has a
+scope (global/topic), type, range/enum, default, producer/consumer
+applicability, and optional aliases. Docs are generated from the table
+(``python -m librdkafka_tpu.client.conf`` emits CONFIGURATION.md).
+
+New TPU-specific knobs live in the same table (SURVEY.md §5 "config"):
+``compression.backend`` selects the codec provider (cpu|tpu), defaulting to
+cpu, so the TPU path is strictly opt-in — the analog of gating through the
+reference's plugin boundary (src/rdkafka_plugin.c).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from .errors import Err, KafkaException
+
+# Scopes
+GLOBAL, TOPIC = "global", "topic"
+# Applicability
+P, C, PC = "P", "C", "PC"   # producer / consumer / both
+
+
+@dataclass
+class Prop:
+    name: str
+    scope: str                 # GLOBAL or TOPIC
+    ptype: str                 # "str" | "int" | "bool" | "enum" | "float" | "ptr" | "list"
+    default: Any
+    doc: str
+    app: str = PC              # P, C or PC
+    vmin: Optional[float] = None
+    vmax: Optional[float] = None
+    enum: Optional[tuple] = None
+    alias: Optional[str] = None          # alias target property name
+    validator: Optional[Callable[[Any], bool]] = None
+
+
+def _p(*args, **kw) -> Prop:
+    return Prop(*args, **kw)
+
+
+#: The declarative property table. Mirrors rdkafka_conf.c:224's table shape.
+PROPERTIES: list[Prop] = [
+    # ---- global: general ----
+    _p("builtin.features", GLOBAL, "str",
+       "gzip,snappy,lz4,zstd,ssl,sasl,regex,mocks,tpu-codec",
+       "Indicates builtin features for this build."),
+    _p("client.id", GLOBAL, "str", "rdkafka", "Client identifier."),
+    _p("bootstrap.servers", GLOBAL, "str", "", "Initial list of brokers host:port,..."),
+    _p("metadata.broker.list", GLOBAL, "str", "", "Alias for bootstrap.servers.",
+       alias="bootstrap.servers"),
+    _p("message.max.bytes", GLOBAL, "int", 1000000, "Maximum Kafka protocol request message size.",
+       vmin=1000, vmax=1000000000),
+    _p("message.copy.max.bytes", GLOBAL, "int", 65535,
+       "Maximum size for message to be copied to buffer (larger are referenced).",
+       vmin=0, vmax=1000000000),
+    _p("receive.message.max.bytes", GLOBAL, "int", 100000000,
+       "Maximum Kafka protocol response message size.", vmin=1000, vmax=2147483647),
+    _p("max.in.flight.requests.per.connection", GLOBAL, "int", 1000000,
+       "Maximum number of in-flight requests per broker connection.", vmin=1, vmax=1000000),
+    _p("max.in.flight", GLOBAL, "int", 1000000, "Alias.",
+       alias="max.in.flight.requests.per.connection"),
+    _p("metadata.request.timeout.ms", GLOBAL, "int", 60000, "Non-topic request timeout.",
+       vmin=10, vmax=900000),
+    _p("topic.metadata.refresh.interval.ms", GLOBAL, "int", 300000,
+       "Period of topic/broker metadata refresh; -1 disables.", vmin=-1, vmax=3600000),
+    _p("metadata.max.age.ms", GLOBAL, "int", 900000,
+       "Metadata cache max age.", vmin=1, vmax=86400000),
+    _p("topic.metadata.refresh.fast.interval.ms", GLOBAL, "int", 250,
+       "Refresh interval while leaders are unknown.", vmin=1, vmax=60000),
+    _p("topic.metadata.refresh.sparse", GLOBAL, "bool", True,
+       "Sparse metadata requests (only subscribed topics)."),
+    _p("topic.blacklist", GLOBAL, "list", "", "Topic blacklist regex list."),
+    _p("debug", GLOBAL, "list", "",
+       "Comma-separated debug contexts: generic,broker,topic,metadata,feature,queue,msg,"
+       "protocol,cgrp,security,fetch,interceptor,plugin,consumer,admin,eos,mock,all"),
+    _p("socket.timeout.ms", GLOBAL, "int", 60000, "Network request timeout.", vmin=10, vmax=300000),
+    _p("socket.send.buffer.bytes", GLOBAL, "int", 0, "SO_SNDBUF; 0=system default.",
+       vmin=0, vmax=100000000),
+    _p("socket.receive.buffer.bytes", GLOBAL, "int", 0, "SO_RCVBUF; 0=system default.",
+       vmin=0, vmax=100000000),
+    _p("socket.keepalive.enable", GLOBAL, "bool", False, "Enable TCP keep-alive."),
+    _p("socket.nagle.disable", GLOBAL, "bool", False, "Disable Nagle (TCP_NODELAY)."),
+    _p("socket.max.fails", GLOBAL, "int", 1,
+       "Disconnect broker after this many send failures.", vmin=0, vmax=1000000),
+    _p("broker.address.ttl", GLOBAL, "int", 1000, "DNS resolve cache ttl ms.", vmin=0, vmax=86400000),
+    _p("broker.address.family", GLOBAL, "enum", "any", "Address family.",
+       enum=("any", "v4", "v6")),
+    _p("reconnect.backoff.ms", GLOBAL, "int", 100, "Initial reconnect backoff.",
+       vmin=0, vmax=3600000),
+    _p("reconnect.backoff.max.ms", GLOBAL, "int", 10000, "Max reconnect backoff.",
+       vmin=0, vmax=3600000),
+    _p("statistics.interval.ms", GLOBAL, "int", 0,
+       "Statistics emit interval; 0 disables.", vmin=0, vmax=86400000),
+    _p("log_level", GLOBAL, "int", 6, "Max syslog level.", vmin=0, vmax=7),
+    _p("log.queue", GLOBAL, "bool", False, "Forward logs to queue instead of stderr."),
+    _p("log.thread.name", GLOBAL, "bool", True, "Print thread name in logs."),
+    _p("log.connection.close", GLOBAL, "bool", True, "Log broker disconnects."),
+    _p("internal.termination.signal", GLOBAL, "int", 0, "Unused (signal shim).", vmin=0, vmax=128),
+    _p("api.version.request", GLOBAL, "bool", True,
+       "Request broker supported api versions (ApiVersionRequest)."),
+    _p("api.version.request.timeout.ms", GLOBAL, "int", 10000, "", vmin=1, vmax=300000),
+    _p("api.version.fallback.ms", GLOBAL, "int", 0,
+       "How long to use broker.version.fallback after ApiVersion failure.",
+       vmin=0, vmax=604800000),
+    _p("broker.version.fallback", GLOBAL, "str", "0.10.0",
+       "Assumed broker version when ApiVersionRequest unsupported."),
+    # ---- global: security ----
+    _p("security.protocol", GLOBAL, "enum", "plaintext", "Protocol to talk to brokers.",
+       enum=("plaintext", "ssl", "sasl_plaintext", "sasl_ssl")),
+    _p("ssl.cipher.suites", GLOBAL, "str", "", "Cipher suites."),
+    _p("ssl.key.location", GLOBAL, "str", "", "Client private key path (PEM)."),
+    _p("ssl.key.password", GLOBAL, "str", "", "Key passphrase."),
+    _p("ssl.certificate.location", GLOBAL, "str", "", "Client cert path (PEM)."),
+    _p("ssl.ca.location", GLOBAL, "str", "", "CA bundle path."),
+    _p("ssl.keystore.location", GLOBAL, "str", "", "PKCS#12 keystore path."),
+    _p("ssl.keystore.password", GLOBAL, "str", "", "Keystore password."),
+    _p("enable.ssl.certificate.verification", GLOBAL, "bool", True, "Verify broker cert."),
+    _p("ssl.endpoint.identification.algorithm", GLOBAL, "enum", "none",
+       "Endpoint identification.", enum=("none", "https")),
+    _p("sasl.mechanisms", GLOBAL, "str", "GSSAPI",
+       "SASL mechanism: GSSAPI, PLAIN, SCRAM-SHA-256, SCRAM-SHA-512, OAUTHBEARER."),
+    _p("sasl.mechanism", GLOBAL, "str", "GSSAPI", "Alias.", alias="sasl.mechanisms"),
+    _p("sasl.username", GLOBAL, "str", "", "SASL username (PLAIN/SCRAM)."),
+    _p("sasl.password", GLOBAL, "str", "", "SASL password (PLAIN/SCRAM)."),
+    _p("sasl.oauthbearer.config", GLOBAL, "str", "", "OAUTHBEARER unsecured token config."),
+    _p("enable.sasl.oauthbearer.unsecure.jwt", GLOBAL, "bool", False,
+       "Enable builtin unsecured JWT handler."),
+    _p("sasl.kerberos.service.name", GLOBAL, "str", "kafka", "Kerberos service name."),
+    _p("sasl.kerberos.principal", GLOBAL, "str", "kafkaclient", "Client principal."),
+    # ---- global: plugins/interceptors ----
+    _p("plugin.library.paths", GLOBAL, "str", "",
+       "List of plugin libraries/modules to load (module:... python entry points)."),
+    _p("interceptors", GLOBAL, "ptr", None, "Interceptors added through the API."),
+    # ---- global: consumer group ----
+    _p("group.id", GLOBAL, "str", "", "Consumer group id.", app=C),
+    _p("group.instance.id", GLOBAL, "str", "",
+       "Static membership instance id.", app=C),
+    _p("partition.assignment.strategy", GLOBAL, "str", "range,roundrobin",
+       "Assignor names in preference order.", app=C),
+    _p("session.timeout.ms", GLOBAL, "int", 10000, "Group session timeout.", app=C,
+       vmin=1, vmax=3600000),
+    _p("heartbeat.interval.ms", GLOBAL, "int", 3000, "Group heartbeat interval.", app=C,
+       vmin=1, vmax=3600000),
+    _p("group.protocol.type", GLOBAL, "str", "consumer", "Group protocol type.", app=C),
+    _p("coordinator.query.interval.ms", GLOBAL, "int", 600000,
+       "Coordinator re-query interval.", app=C, vmin=1, vmax=3600000),
+    _p("max.poll.interval.ms", GLOBAL, "int", 300000,
+       "Max time between polls before leaving the group.", app=C, vmin=1, vmax=86400000),
+    _p("enable.auto.commit", GLOBAL, "bool", True, "Auto offset commit.", app=C),
+    _p("auto.commit.interval.ms", GLOBAL, "int", 5000,
+       "Auto commit interval.", app=C, vmin=0, vmax=86400000),
+    _p("enable.auto.offset.store", GLOBAL, "bool", True,
+       "Auto-store offset of last consumed message.", app=C),
+    _p("queued.min.messages", GLOBAL, "int", 100000,
+       "Min messages to keep in local fetch queue.", app=C, vmin=1, vmax=10000000),
+    _p("queued.max.messages.kbytes", GLOBAL, "int", 1048576,
+       "Max kbytes in local fetch queue.", app=C, vmin=1, vmax=2097151),
+    _p("fetch.wait.max.ms", GLOBAL, "int", 100, "Fetch max wait.", app=C, vmin=0, vmax=300000),
+    _p("fetch.message.max.bytes", GLOBAL, "int", 1048576,
+       "Initial max bytes per topic+partition to fetch.", app=C, vmin=1, vmax=1000000000),
+    _p("max.partition.fetch.bytes", GLOBAL, "int", 1048576, "Alias.", app=C,
+       alias="fetch.message.max.bytes"),
+    _p("fetch.max.bytes", GLOBAL, "int", 52428800, "Max bytes per fetch request.", app=C,
+       vmin=0, vmax=2147483135),
+    _p("fetch.num.inflight", GLOBAL, "int", 4,
+       "Max outstanding FetchRequests per broker, over disjoint "
+       "partition sets (the reference keeps the fetch pipe full instead "
+       "of serializing one Fetch per round trip, rdkafka_broker.c:4279).",
+       app=C, vmin=1, vmax=64),
+    _p("fetch.min.bytes", GLOBAL, "int", 1, "Min bytes broker should accumulate.", app=C,
+       vmin=1, vmax=100000000),
+    _p("fetch.error.backoff.ms", GLOBAL, "int", 500, "Backoff on fetch error.", app=C,
+       vmin=0, vmax=300000),
+    _p("isolation.level", GLOBAL, "enum", "read_committed",
+       "Transactional read isolation.", app=C, enum=("read_uncommitted", "read_committed")),
+    _p("enable.partition.eof", GLOBAL, "bool", False,
+       "Emit PARTITION_EOF event at end of partition.", app=C),
+    _p("check.crcs", GLOBAL, "bool", False, "Verify CRC32C of consumed messages.", app=C),
+    _p("allow.auto.create.topics", GLOBAL, "bool", False,
+       "Allow broker auto topic creation on metadata.", app=C),
+    # ---- global: producer ----
+    _p("enable.idempotence", GLOBAL, "bool", False,
+       "Exactly-once-ish producer: no dupes, no reordering (EOS v1).", app=P),
+    _p("enable.gapless.guarantee", GLOBAL, "bool", False,
+       "Fatal error if a message could create a sequence gap.", app=P),
+    _p("queue.buffering.max.messages", GLOBAL, "int", 100000,
+       "Max messages on producer queues.", app=P, vmin=1, vmax=10000000),
+    _p("queue.buffering.max.kbytes", GLOBAL, "int", 1048576,
+       "Max kbytes on producer queues.", app=P, vmin=1, vmax=2147483647),
+    _p("queue.buffering.max.ms", GLOBAL, "float", 0.5,
+       "Linger: delay before building MessageSets.", app=P, vmin=0, vmax=900000),
+    _p("linger.ms", GLOBAL, "float", 0.5, "Alias.", app=P, alias="queue.buffering.max.ms"),
+    _p("message.send.max.retries", GLOBAL, "int", 2, "Send retries.", app=P, vmin=0, vmax=10000000),
+    _p("retries", GLOBAL, "int", 2, "Alias.", app=P, alias="message.send.max.retries"),
+    _p("retry.backoff.ms", GLOBAL, "int", 100, "Retry backoff.", app=P, vmin=1, vmax=300000),
+    _p("queue.buffering.backpressure.threshold", GLOBAL, "int", 1,
+       "Backpressure threshold on outstanding requests.", app=P, vmin=1, vmax=1000000),
+    _p("compression.codec", GLOBAL, "enum", "none",
+       "Message compression codec.", app=P,
+       enum=("none", "gzip", "snappy", "lz4", "zstd")),
+    _p("compression.type", GLOBAL, "enum", "none", "Alias.", app=P,
+       enum=("none", "gzip", "snappy", "lz4", "zstd"), alias="compression.codec"),
+    _p("batch.num.messages", GLOBAL, "int", 10000,
+       "Max messages per MessageSet.", app=P, vmin=1, vmax=1000000),
+    _p("delivery.report.only.error", GLOBAL, "bool", False,
+       "Only failed DRs.", app=P),
+    _p("dr_cb", GLOBAL, "ptr", None, "Delivery report callback.", app=P),
+    _p("dr_msg_cb", GLOBAL, "ptr", None, "Per-message delivery report callback.", app=P),
+    # ---- TPU codec sidecar knobs (new; SURVEY.md §5 config section) ----
+    _p("compression.backend", GLOBAL, "enum", "cpu",
+       "Codec provider for MessageSet compression + CRC32C: 'cpu' uses the "
+       "native C++ path, 'tpu' offloads batched compress/CRC to the JAX/Pallas "
+       "sidecar (bit-identical wire bytes).", app=PC, enum=("cpu", "tpu")),
+    _p("tpu.launch.min.batches", GLOBAL, "int", 4,
+       "Min partition batches to coalesce into one TPU launch (launch quorum); "
+       "fewer than this falls back to the CPU provider.", vmin=1, vmax=4096),
+    _p("codec.pipeline.depth", GLOBAL, "int", 2,
+       "Max codec launches in flight per broker; 0 = compress inline on "
+       "the broker thread (pipeline overlap of batch build vs codec).",
+       vmin=0, vmax=64, app=P),
+    _p("tpu.mesh.devices", GLOBAL, "int", 0,
+       "Number of devices to shard the DEVICE lz4 encoder's block "
+       "compression over (0 = all local). Only reachable with "
+       "tpu.lz4.force=true — default routing runs lz4 on CPU.",
+       vmin=0, vmax=8192),
+    _p("tpu.transport.min.mb.s", GLOBAL, "int", 100,
+       "Adaptive offload gate: minimum measured host->device bandwidth "
+       "(MB/s) for CRC32C launches to leave the host. Below it (e.g. a "
+       "slow dev tunnel) every launch costs more in transfer than the "
+       "whole CPU checksum, so the provider self-routes to CPU. "
+       "0 disables the gate.", vmin=0, vmax=1_000_000),
+    _p("tpu.lz4.force", GLOBAL, "bool", False,
+       "Route lz4 block compression to the device encoder even though it "
+       "is slower than the native CPU path (PERF.md: LZ4's match search "
+       "is gather/sort-bound, ~3 orders of magnitude off CPU on TPU "
+       "vector units). Default off: backend=tpu runs lz4 on CPU and only "
+       "CRC32C on the MXU, so the TPU backend is never slower than cpu.",
+       app=P),
+    # ---- callbacks / opaque ----
+    _p("error_cb", GLOBAL, "ptr", None, "Error callback."),
+    _p("throttle_cb", GLOBAL, "ptr", None, "Throttle callback."),
+    _p("stats_cb", GLOBAL, "ptr", None, "Statistics callback."),
+    _p("background_event_cb", GLOBAL, "ptr", None,
+       "Background event callback: events are served from a dedicated "
+       "background thread instead of poll() (rdkafka_background.c)."),
+    _p("enabled_events", GLOBAL, "list", "",
+       "Event types to generate for queue_poll()/background consumption "
+       "(rd_kafka_conf_set_events analog): dr, error, log, stats."),
+    _p("log_cb", GLOBAL, "ptr", None, "Log callback."),
+    _p("oauthbearer_token_refresh_cb", GLOBAL, "ptr", None, "OAUTHBEARER refresh callback."),
+    _p("socket_cb", GLOBAL, "ptr", None, "Socket creation callback (sockem hook)."),
+    _p("connect_cb", GLOBAL, "ptr", None, "Socket connect callback (sockem hook)."),
+    _p("rebalance_cb", GLOBAL, "ptr", None, "Rebalance callback.", app=C),
+    _p("offset_commit_cb", GLOBAL, "ptr", None, "Offset commit result callback.", app=C),
+    _p("opaque", GLOBAL, "ptr", None, "Application opaque."),
+    _p("default_topic_conf", GLOBAL, "ptr", None, "Default topic config object."),
+    # ---- test / mock ----
+    _p("test.mock.num.brokers", GLOBAL, "int", 0,
+       "Create an in-process mock cluster with this many brokers "
+       "(reference: rdkafka_mock.c via rdkafka_conf.c).", vmin=0, vmax=10000),
+    _p("test.mock.default.partitions", GLOBAL, "int", 4,
+       "Partition count for topics auto-created by the mock cluster.",
+       vmin=1, vmax=10000),
+
+    # ---- topic scope ----
+    _p("request.required.acks", TOPIC, "int", -1,
+       "Required acks: -1=all ISR, 0=none, 1=leader.", app=P, vmin=-1, vmax=1000),
+    _p("acks", TOPIC, "int", -1, "Alias.", app=P, alias="request.required.acks"),
+    _p("request.timeout.ms", TOPIC, "int", 5000,
+       "Ack timeout of produce request.", app=P, vmin=1, vmax=900000),
+    _p("message.timeout.ms", TOPIC, "int", 300000,
+       "Local message delivery timeout; 0=infinite.", app=P, vmin=0, vmax=2147483647),
+    _p("delivery.timeout.ms", TOPIC, "int", 300000, "Alias.", app=P,
+       alias="message.timeout.ms"),
+    _p("partitioner", TOPIC, "enum", "consistent_random",
+       "Partitioner: random, consistent, consistent_random, murmur2, murmur2_random.",
+       app=P, enum=("random", "consistent", "consistent_random", "murmur2",
+                    "murmur2_random")),
+    _p("partitioner_cb", TOPIC, "ptr", None, "Custom partitioner callback.", app=P),
+    _p("compression.level", TOPIC, "int", -1,
+       "Codec-specific compression level.", app=P, vmin=-1, vmax=12),
+    _p("auto.offset.reset", TOPIC, "enum", "largest",
+       "Offset reset policy when no committed offset.", app=C,
+       enum=("smallest", "earliest", "beginning", "largest", "latest", "end", "error")),
+    _p("offset.store.method", TOPIC, "enum", "broker",
+       "Offset commit store method.", app=C, enum=("file", "broker")),
+    _p("offset.store.path", TOPIC, "str", ".",
+       "Path to local offset file store (legacy).", app=C),
+    _p("offset.store.sync.interval.ms", TOPIC, "int", -1,
+       "fsync interval for file store.", app=C, vmin=-1, vmax=86400000),
+]
+
+_BY_NAME: dict[str, Prop] = {}
+for prop in PROPERTIES:
+    _BY_NAME[prop.name] = prop
+
+_TRUE = {"true", "t", "1", "yes", "on"}
+_FALSE = {"false", "f", "0", "no", "off"}
+
+
+class _ConfBase:
+    """Shared get/set machinery for global and topic config."""
+
+    _scope = GLOBAL
+
+    def __init__(self, initial: Optional[dict] = None):
+        self._values: dict[str, Any] = {}
+        self._explicit: set[str] = set()
+        if initial:
+            for k, v in initial.items():
+                self.set(k, v)
+
+    # -- core API (reference: rd_kafka_conf_set, rdkafka_conf.c) --
+    def set(self, name: str, value: Any) -> None:
+        prop = _BY_NAME.get(name)
+        if prop is None or prop.scope != self._scope:
+            raise KafkaException(Err._INVALID_ARG,
+                                 f"No such {self._scope} configuration property: {name!r}")
+        if prop.alias:
+            return self.set(prop.alias, value)
+        self._values[prop.name] = self._coerce(prop, value)
+        self._explicit.add(prop.name)
+        # mutation counter + listeners: cached eligibility decisions
+        # (e.g. the produce fast lane keyed on dr callbacks) revalidate
+        # on change
+        self.version = getattr(self, "version", 0) + 1
+        for cb in getattr(self, "_listeners", ()):
+            cb()
+
+    def add_listener(self, cb) -> None:
+        """Invoke ``cb()`` after every set() (post-creation conf
+        mutations must invalidate cached eligibility decisions)."""
+        if not hasattr(self, "_listeners"):
+            self._listeners = []
+        self._listeners.append(cb)
+
+    def get(self, name: str) -> Any:
+        prop = _BY_NAME.get(name)
+        if prop is None or prop.scope != self._scope:
+            raise KafkaException(Err._INVALID_ARG,
+                                 f"No such {self._scope} configuration property: {name!r}")
+        if prop.alias:
+            return self.get(prop.alias)
+        return self._values.get(prop.name, prop.default)
+
+    def is_set(self, name: str) -> bool:
+        prop = _BY_NAME.get(name)
+        if prop and prop.alias:
+            name = prop.alias
+        return name in self._explicit
+
+    def update(self, d: dict) -> None:
+        for k, v in d.items():
+            self.set(k, v)
+
+    def dump(self) -> dict:
+        """All effective values (reference: rd_kafka_conf_dump)."""
+        out = {}
+        for prop in PROPERTIES:
+            if prop.scope == self._scope and not prop.alias and prop.ptype != "ptr":
+                out[prop.name] = self.get(prop.name)
+        return out
+
+    def copy(self):
+        dup = type(self)()
+        dup._values = dict(self._values)
+        dup._explicit = set(self._explicit)
+        return dup
+
+    @staticmethod
+    def _coerce(prop: Prop, value: Any) -> Any:
+        t = prop.ptype
+        if t == "ptr":
+            return value
+        if t == "bool":
+            if isinstance(value, bool):
+                return value
+            sval = str(value).strip().lower()
+            if sval in _TRUE:
+                return True
+            if sval in _FALSE:
+                return False
+            raise KafkaException(Err._INVALID_ARG,
+                                 f"Expected bool for {prop.name!r}, got {value!r}")
+        if t == "int":
+            try:
+                ival = int(str(value).strip())
+            except ValueError:
+                raise KafkaException(Err._INVALID_ARG,
+                                     f"Expected int for {prop.name!r}, got {value!r}")
+            if prop.vmin is not None and not (prop.vmin <= ival <= prop.vmax):
+                raise KafkaException(
+                    Err._INVALID_ARG,
+                    f"Configuration property {prop.name!r} value {ival} is outside "
+                    f"allowed range {int(prop.vmin)}..{int(prop.vmax)}")
+            return ival
+        if t == "float":
+            try:
+                fval = float(str(value).strip())
+            except ValueError:
+                raise KafkaException(Err._INVALID_ARG,
+                                     f"Expected float for {prop.name!r}, got {value!r}")
+            if prop.vmin is not None and not (prop.vmin <= fval <= prop.vmax):
+                raise KafkaException(Err._INVALID_ARG,
+                                     f"{prop.name!r} value {fval} outside range")
+            return fval
+        if t == "enum":
+            sval = str(value).strip().lower()
+            if sval not in prop.enum:
+                raise KafkaException(
+                    Err._INVALID_ARG,
+                    f"Invalid value {value!r} for enum property {prop.name!r} "
+                    f"(allowed: {', '.join(prop.enum)})")
+            return sval
+        if t == "list":
+            if isinstance(value, (list, tuple)):
+                return list(value)
+            return [s for s in re.split(r"[,\s]+", str(value)) if s]
+        return str(value)
+
+
+class Conf(_ConfBase):
+    """Global client configuration (reference: rd_kafka_conf_t).
+
+    Topic-scoped properties set here fall through to the default topic
+    config (the reference's conf fallthrough behavior)."""
+    _scope = GLOBAL
+
+    def set(self, name: str, value: Any) -> None:
+        prop = _BY_NAME.get(name)
+        if prop is not None and prop.scope == TOPIC:
+            tc = super().get("default_topic_conf")
+            if tc is None:
+                tc = TopicConf()
+                super().set("default_topic_conf", tc)
+            tc.set(name, value)
+            return
+        super().set(name, value)
+
+    def topic_conf(self) -> "TopicConf":
+        tc = self.get("default_topic_conf")
+        return tc.copy() if tc is not None else TopicConf()
+
+
+class TopicConf(_ConfBase):
+    """Per-topic configuration (reference: rd_kafka_topic_conf_t)."""
+    _scope = TOPIC
+
+
+def generate_configuration_md() -> str:
+    """Auto-generate CONFIGURATION.md from the table, like the reference does."""
+    out = ["# Configuration properties", ""]
+    for scope, title in ((GLOBAL, "Global configuration properties"),
+                         (TOPIC, "Topic configuration properties")):
+        out += [f"## {title}", "",
+                "Property | C/P | Range | Default | Description",
+                "---------|-----|-------|---------|------------"]
+        for prop in PROPERTIES:
+            if prop.scope != scope:
+                continue
+            rng = ""
+            if prop.vmin is not None:
+                rng = f"{int(prop.vmin)} .. {int(prop.vmax)}"
+            elif prop.enum:
+                rng = ", ".join(prop.enum)
+            doc = prop.doc if not prop.alias else f"Alias for `{prop.alias}`: {prop.doc}"
+            out.append(f"{prop.name} | {prop.app} | {rng} | {prop.default} | {doc}")
+        out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    print(generate_configuration_md())

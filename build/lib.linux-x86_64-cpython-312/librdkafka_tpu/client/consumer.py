@@ -1,0 +1,484 @@
+"""Public Consumer API: balanced KafkaConsumer + simple consumer.
+
+Reference: the KafkaConsumer API surface of rdkafka.h (subscribe / poll /
+commit / assign / seek / pause / position / committed) built over the cgrp
+FSM, with all per-partition fetch queues forwarded into one consumer queue
+(rd_kafka_q_fwd_set, rdkafka_queue.c:127) so a single poll serves
+everything.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from collections import deque
+
+from ..protocol import proto
+from ..protocol.proto import ApiKey
+from .broker import Request
+from .conf import Conf
+from .cgrp import ConsumerGroup
+from .errors import Err, KafkaError, KafkaException
+from .kafka import CONSUMER, Kafka
+from .msg import Message
+from .partition import FetchState, Toppar
+from .queue import Op, OpQueue, OpType
+
+
+@dataclass
+class TopicPartition:
+    """Public topic+partition+offset tuple (rd_kafka_topic_partition_t)."""
+    topic: str
+    partition: int
+    offset: int = proto.OFFSET_INVALID
+    error: Optional[KafkaError] = None
+
+    def __hash__(self):
+        return hash((self.topic, self.partition))
+
+
+class Consumer:
+    def __init__(self, conf):
+        if isinstance(conf, dict):
+            c = Conf()
+            c.update(conf)
+            conf = c
+        self._rk = Kafka(conf, CONSUMER)
+        self._rk.consumer = self
+        self.queue = OpQueue("consumer")
+        # single-queue consumer polling: the main reply queue (errors,
+        # stats, logs) forwards into the consumer queue (reference:
+        # rd_kafka_poll_set_consumer, rk_rep → rk_consumer fwd)
+        self._rk.rep.forward_to(self.queue)
+        group_id = conf.get("group.id")
+        self._rk.cgrp = ConsumerGroup(self._rk, group_id) if group_id else None
+        self._assignment: dict[tuple[str, int], Toppar] = {}
+        # messages from a batched FETCH op awaiting delivery via poll()
+        self._pending: deque = deque()
+        self._auto_store = conf.get("enable.auto.offset.store")
+        self._closed = False
+
+    # ---------------------------------------------------------- subscribe --
+    def subscribe(self, topics: list[str], on_assign=None, on_revoke=None):
+        if self._rk.cgrp is None:
+            raise KafkaException(Err._UNKNOWN_GROUP,
+                                 "subscribe requires group.id")
+        if on_assign or on_revoke:
+            self._rk.conf.set("rebalance_cb",
+                              self._make_rebalance_cb(on_assign, on_revoke))
+        self._rk.cgrp.subscribe(topics)
+
+    def _make_rebalance_cb(self, on_assign, on_revoke):
+        def cb(consumer, code, partitions):
+            if code == Err._ASSIGN_PARTITIONS:
+                if on_assign:
+                    on_assign(consumer, partitions)
+                else:
+                    consumer.assign(partitions)
+            else:
+                if on_revoke:
+                    on_revoke(consumer, partitions)
+                else:
+                    consumer.unassign()
+        return cb
+
+    def unsubscribe(self):
+        if self._rk.cgrp:
+            self._rk.cgrp.unsubscribe()
+
+    def subscription(self) -> list[str]:
+        return list(self._rk.cgrp.subscription) if self._rk.cgrp else []
+
+    # ------------------------------------------------------------- assign --
+    def assign(self, partitions: list[TopicPartition]):
+        assignment = {}
+        for tp in partitions:
+            assignment.setdefault(tp.topic, []).append(tp.partition)
+        self.apply_assignment(assignment,
+                              offsets={(tp.topic, tp.partition): tp.offset
+                                       for tp in partitions})
+        if self._rk.cgrp:
+            self._rk.cgrp.rebalance_done(assigned=True)
+
+    def unassign(self):
+        self.apply_assignment({})
+        if self._rk.cgrp:
+            self._rk.cgrp.rebalance_done(assigned=False)
+
+    def assignment(self) -> list[TopicPartition]:
+        return [TopicPartition(t, p, tp.app_offset)
+                for (t, p), tp in self._assignment.items()]
+
+    def apply_assignment(self, assignment: dict[str, list[int]],
+                         offsets: Optional[dict] = None):
+        """Start/stop fetchers to match the assignment (reference:
+        rd_kafka_cgrp_assign → toppar OP_FETCH_START)."""
+        rk = self._rk
+        new_keys = {(t, p) for t, ps in assignment.items() for p in ps}
+        # stop removed partitions
+        for key in list(self._assignment):
+            if key not in new_keys:
+                tp = self._assignment.pop(key)
+                tp.fetch_state = FetchState.STOPPED
+                tp.version += 1
+                tp.fetchq.forward_to(None)
+                tp.fetchq_cnt = 0
+                tp.fetchq_bytes = 0
+        if rk.cgrp:
+            rk.cgrp.assignment = assignment
+        if not new_keys:
+            return
+        # gather committed offsets if in a group
+        need = [k for k in new_keys if k not in self._assignment]
+        explicit = offsets or {}
+
+        def start(committed: dict):
+            for key in need:
+                t, p = key
+                tp = rk.get_toppar(t, p)
+                self._assignment[key] = tp
+                tp.fetchq.forward_to(self.queue)
+                off = explicit.get(key, proto.OFFSET_INVALID)
+                if off < 0:
+                    off = committed.get(key, proto.OFFSET_INVALID)
+                if off >= 0:
+                    tp.fetch_offset = off
+                    tp.fetch_state = FetchState.ACTIVE
+                else:
+                    policy = rk.topic_conf_for(t).get("auto.offset.reset")
+                    tp.fetch_offset = (
+                        proto.OFFSET_BEGINNING
+                        if policy in ("smallest", "earliest", "beginning")
+                        else proto.OFFSET_END)
+                    tp.fetch_state = FetchState.OFFSET_QUERY
+                tp.version += 1
+                rk._wake_leader(tp)
+
+        if rk.cgrp and need:
+            done = {}
+
+            def on_fetched(err, resp):
+                committed = {}
+                if err is None:
+                    for tr in resp["topics"]:
+                        for pr in tr["partitions"]:
+                            if pr["error_code"] == 0 and pr["offset"] >= 0:
+                                committed[(tr["topic"], pr["partition"])] = \
+                                    pr["offset"]
+                start(committed)
+
+            if not rk.cgrp.fetch_committed(list(need), on_fetched):
+                start({})
+        else:
+            start({})
+
+    # --------------------------------------------------------------- poll --
+    def poll(self, timeout: float = 1.0) -> Optional[Message]:
+        if self._rk.cgrp:
+            self._rk.cgrp.poll_tick()
+        deadline = time.monotonic() + timeout
+        while True:
+            while self._pending:
+                tp, m, ver = self._pending.popleft()
+                msg = self._deliver(tp, m, ver)
+                if msg is not None:
+                    return msg
+            remain = deadline - time.monotonic()
+            op = self.queue.pop(max(0.0, min(remain, 0.1)))
+            if op is None:
+                if time.monotonic() >= deadline:
+                    return None
+                continue
+            msg = self._serve_op(op)
+            if msg is not None:
+                return msg
+            if time.monotonic() >= deadline:
+                return None
+
+    def consume(self, num_messages: int = 1, timeout: float = 1.0
+                ) -> list[Message]:
+        out = []
+        deadline = time.monotonic() + timeout
+        while len(out) < num_messages:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                break
+            m = self.poll(remain)
+            if m is None:
+                break
+            out.append(m)
+        return out
+
+    def _serve_op(self, op: Op) -> Optional[Message]:
+        rk = self._rk
+        if op.type == OpType.FETCH:
+            tp, msgs, version = op.payload
+            first = self._deliver(tp, msgs[0], version)
+            for m in msgs[1:]:
+                self._pending.append((tp, m, version))
+            return first
+        if op.type == OpType.CONSUMER_ERR:
+            tp, msg, version = op.payload
+            return msg if tp.version == version else None
+        if op.type == OpType.REBALANCE:
+            code, assignment = op.payload
+            cb = rk.conf.get("rebalance_cb")
+            parts = [TopicPartition(t, p) for t, ps in assignment.items()
+                     for p in ps]
+            if cb:
+                cb(self, code, parts)
+            return None
+        # forwarded main-queue ops (errors/stats/logs): dispatch to the
+        # same handlers rd_kafka_poll would use
+        rk._serve_rep_op(op)
+        return None
+
+    def _deliver(self, tp: Toppar, msg: Message,
+                 version: int) -> Optional[Message]:
+        """Per-message delivery bookkeeping; None when the message is
+        stale (partition seeked/revoked since the fetch)."""
+        rk = self._rk
+        tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
+        tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
+        # Stale when the partition was seeked/paused since the fetch
+        # (version barrier) OR when it has been revoked from the current
+        # assignment.  The revocation check applies to group and simple
+        # consumers alike — assign()/unassign() maintain _assignment in
+        # both modes (reference: rd_kafka_op_version_outdated plus the
+        # fetchq disconnect on rd_kafka_toppar_fetch_stop).
+        if (tp.version != version
+                or (tp.topic, tp.partition) not in self._assignment):
+            return None     # stale: accounting released above
+        tp.app_offset = msg.offset + 1
+        if self._auto_store:
+            tp.stored_offset = msg.offset + 1
+        return msg
+
+    # ------------------------------------------------------------ offsets --
+    def stored_offsets(self) -> dict[tuple[str, int], int]:
+        """Offsets pending commit (stored > committed)."""
+        out = {}
+        for key, tp in self._assignment.items():
+            if tp.stored_offset >= 0 and tp.stored_offset != tp.committed_offset:
+                out[key] = tp.stored_offset
+        return out
+
+    def store_offsets(self, message: Optional[Message] = None,
+                      offsets: Optional[list[TopicPartition]] = None):
+        if message is not None:
+            tp = self._assignment.get((message.topic, message.partition))
+            if tp:
+                tp.stored_offset = message.offset + 1
+        for tpo in offsets or []:
+            tp = self._assignment.get((tpo.topic, tpo.partition))
+            if tp:
+                tp.stored_offset = tpo.offset
+
+    def commit(self, message: Optional[Message] = None,
+               offsets: Optional[list[TopicPartition]] = None,
+               asynchronous: bool = False):
+        if self._rk.cgrp is None:
+            raise KafkaException(Err._UNKNOWN_GROUP, "commit requires group.id")
+        if message is not None:
+            to_commit = {(message.topic, message.partition): message.offset + 1}
+        elif offsets is not None:
+            to_commit = {(o.topic, o.partition): o.offset for o in offsets}
+        else:
+            to_commit = self.stored_offsets()
+        if not to_commit:
+            return None
+        if asynchronous:
+            self._rk.cgrp.commit_offsets(to_commit, None)
+            return None
+        done = []
+
+        def cb(err, resp):
+            done.append(err)
+
+        self._rk.cgrp.commit_offsets(to_commit, cb)
+        deadline = time.monotonic() + 10
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if done and done[0] is not None:
+            raise KafkaException(done[0])
+        return [TopicPartition(t, p, off)
+                for (t, p), off in to_commit.items()]
+
+    def committed(self, partitions: list[TopicPartition],
+                  timeout: float = 10.0) -> list[TopicPartition]:
+        if self._rk.cgrp is None:
+            raise KafkaException(Err._UNKNOWN_GROUP, "requires group.id")
+        result = {}
+        done = []
+
+        def cb(err, resp):
+            if err is None:
+                for tr in resp["topics"]:
+                    for pr in tr["partitions"]:
+                        result[(tr["topic"], pr["partition"])] = pr["offset"]
+            done.append(err)
+
+        self._rk.cgrp.fetch_committed(
+            [(p.topic, p.partition) for p in partitions], cb)
+        deadline = time.monotonic() + timeout
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.005)
+        return [TopicPartition(p.topic, p.partition,
+                               result.get((p.topic, p.partition),
+                                          proto.OFFSET_INVALID))
+                for p in partitions]
+
+    # ------------------------------------------------------ seek & pause --
+    def seek(self, partition: TopicPartition):
+        tp = self._assignment.get((partition.topic, partition.partition))
+        if tp is None:
+            raise KafkaException(Err._STATE, "partition not assigned")
+        tp.version += 1
+        tp.fetchq.pop_all()
+        tp.fetchq_cnt = 0
+        tp.fetchq_bytes = 0
+        if partition.offset in (proto.OFFSET_BEGINNING, proto.OFFSET_END):
+            tp.fetch_offset = partition.offset
+            tp.fetch_state = FetchState.OFFSET_QUERY
+        else:
+            tp.fetch_offset = partition.offset
+            tp.fetch_state = FetchState.ACTIVE
+        self._rk._wake_leader(tp)
+
+    def pause(self, partitions: list[TopicPartition]):
+        for p in partitions:
+            tp = self._assignment.get((p.topic, p.partition))
+            if tp:
+                tp.paused = True
+
+    def resume(self, partitions: list[TopicPartition]):
+        for p in partitions:
+            tp = self._assignment.get((p.topic, p.partition))
+            if tp:
+                tp.paused = False
+                self._rk._wake_leader(tp)
+
+    def position(self, partitions: list[TopicPartition]
+                 ) -> list[TopicPartition]:
+        out = []
+        for p in partitions:
+            tp = self._assignment.get((p.topic, p.partition))
+            out.append(TopicPartition(p.topic, p.partition,
+                                      tp.app_offset if tp else
+                                      proto.OFFSET_INVALID))
+        return out
+
+    def get_watermark_offsets(self, partition: TopicPartition,
+                              timeout: float = 10.0,
+                              cached: bool = False) -> tuple[int, int]:
+        """Low/high watermarks (reference: rd_kafka_query_watermark_
+        offsets / rd_kafka_get_watermark_offsets). ``cached=True``
+        returns the fetcher's last-known value without a query; the
+        query path is two ListOffsets lookups through the same
+        machinery as offsets_for_times (BEGINNING/END timestamps)."""
+        if cached:
+            tp = self._rk.get_toppar(partition.topic, partition.partition)
+            return (0, tp.hi_offset)
+        deadline = time.monotonic() + timeout
+        out = []
+        for ts in (proto.OFFSET_BEGINNING, proto.OFFSET_END):
+            r = self.offsets_for_times(
+                [TopicPartition(partition.topic, partition.partition, ts)],
+                timeout=max(0.0, deadline - time.monotonic()))[0]
+            if r.error is not None:
+                raise KafkaException(r.error)
+            out.append(r.offset)
+        return (out[0], out[1])
+
+    def offsets_for_times(self, partitions: list[TopicPartition],
+                          timeout: float = 10.0) -> list[TopicPartition]:
+        """Earliest offsets at/after the given timestamps (reference:
+        rd_kafka_offsets_for_times -> ListOffsets v1 with real
+        timestamps). Input offsets carry the timestamps (ms), like the
+        reference API. A timestamp past the end of the log yields
+        offset -1 with NO error (reference semantics)."""
+        rk = self._rk
+        results: dict = {}
+        deadline = time.monotonic() + timeout   # ONE budget for the call
+
+        def make_cb(keys):
+            def cb(err, resp):
+                if err is None:
+                    for tr in resp["topics"]:
+                        for pr in tr["partitions"]:
+                            off = pr.get("offset")
+                            if off is None:     # ListOffsets v0: plural
+                                offs = pr.get("offsets") or [-1]
+                                off = offs[0]
+                            key = (tr["topic"], pr["partition"])
+                            results[key] = (pr["error_code"], off)
+                else:
+                    for k in keys:
+                        results[k] = (-1, proto.OFFSET_INVALID)
+            return cb
+
+        # group by leader broker like the fetch path
+        by_broker: dict = {}
+        for tpo in partitions:
+            tp = rk.get_toppar(tpo.topic, tpo.partition)
+            i = 0
+            while tp.leader_id < 0 and time.monotonic() < deadline:
+                if i % 10 == 0:     # refresh at ~0.5s cadence, not 50ms
+                    rk.metadata_refresh("offsets_for_times")
+                i += 1
+                time.sleep(0.05)
+            by_broker.setdefault(tp.leader_id, []).append(tpo)
+        for leader, tpos in by_broker.items():
+            b = rk.brokers.get(leader)
+            if b is None:
+                for tpo in tpos:
+                    results[(tpo.topic, tpo.partition)] = (
+                        -1, proto.OFFSET_INVALID)
+                continue
+            body = {"replica_id": -1,
+                    "topics": [{"topic": tpo.topic, "partitions": [
+                        {"partition": tpo.partition,
+                         "timestamp": tpo.offset,
+                         "max_num_offsets": 1}]}
+                        for tpo in tpos]}
+            keys = [(tpo.topic, tpo.partition) for tpo in tpos]
+            b.enqueue_request(Request(ApiKey.ListOffsets, body,
+                                      retries_left=2, cb=make_cb(keys)))
+        while (len(results) < len(partitions)
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        out = []
+        for tpo in partitions:
+            key = (tpo.topic, tpo.partition)
+            r = TopicPartition(tpo.topic, tpo.partition,
+                               proto.OFFSET_INVALID)
+            if key not in results:
+                r.error = KafkaError(Err._TIMED_OUT)
+            else:
+                ec, off = results[key]
+                r.offset = off
+                if ec == -1:
+                    r.error = KafkaError(Err._TRANSPORT)
+                elif ec > 0:
+                    r.error = KafkaError(Err.from_wire(ec))
+                # ec == 0 with offset -1 is the legitimate "no offset
+                # at or after this timestamp" result - NOT an error
+            out.append(r)
+        return out
+
+    def poll_kafka(self, timeout: float = 0.0) -> int:
+        return self._rk.poll(timeout)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._rk.cgrp:
+            self._rk.cgrp.terminate()
+        self.apply_assignment({})
+        self._rk.close()
+
+    @property
+    def rk(self) -> Kafka:
+        return self._rk

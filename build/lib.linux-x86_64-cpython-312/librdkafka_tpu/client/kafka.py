@@ -1,0 +1,1394 @@
+"""The client handle (reference: rd_kafka_t, src/rdkafka.c).
+
+Owns configuration, the broker set, topics/toppars, the metadata cache,
+the reply ("rep") queue the app polls, and the main thread
+(rd_kafka_thread_main, rdkafka.c:1834) that drives timers: metadata
+refresh, message timeout scans, stats emission, cgrp serving, and
+unassigned-partition migration.
+"""
+from __future__ import annotations
+
+import json
+import random
+import socket
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..protocol import apis, proto
+from ..protocol.msgset import (iter_batches, parse_msgset_v01,
+                               parse_records_v2, verify_crc_v2)
+from ..protocol.proto import ApiKey
+from .arena import ArenaBatch, arena_new, batch_msgids, lane_new
+from .broker import Broker, Request
+from .conf import Conf, TopicConf
+from .errors import Err, KafkaError, KafkaException
+from .msg import Message, MsgStatus, PARTITION_UA, partitioner_fn
+from .partition import FetchState, Toppar
+from .queue import Op, OpQueue, OpType, Timers
+
+PRODUCER, CONSUMER = "producer", "consumer"
+
+
+class Topic:
+    """rd_kafka_itopic_t analog: per-topic state + UA message parking."""
+
+    def __init__(self, name: str, tconf: TopicConf):
+        self.name = name
+        self.conf = tconf
+        self.partition_cnt = -1
+        self.ua_msgq: deque[Message] = deque()   # parked until metadata
+        self.partitioner = partitioner_fn(tconf.get("partitioner"))
+        self.lock = threading.Lock()
+
+
+class IdempotenceManager:
+    """EOS v1 producer-id state machine (reference:
+    src/rdkafka_idempotence.c — REQ_PID→WAIT_PID→ASSIGNED, drain+epoch-bump
+    recovery at :347-440)."""
+
+    def __init__(self, rk: "Kafka"):
+        self.rk = rk
+        self.state = "INIT"
+        self.pid = -1
+        self.epoch = -1
+        self._lock = threading.Lock()
+
+    def can_produce(self) -> bool:
+        return self.state == "ASSIGNED"
+
+    def serve(self):
+        with self._lock:
+            if self.state == "DRAIN":
+                # wait for every in-flight ProduceRequest to resolve, then
+                # rebase each toppar's sequence origin to its oldest
+                # unacked message and fetch a fresh PID (reference
+                # DRAIN_BUMP → REQ_PID, rdkafka_idempotence.c:374-440)
+                with self.rk._toppars_lock:
+                    tps = list(self.rk._toppars.values())
+                for t in tps:
+                    with t.lock:
+                        # inflight must be observed atomically with the
+                        # queue scan: broker threads pop a batch and
+                        # claim inflight under this same lock, so per
+                        # toppar either the pop already happened
+                        # (inflight > 0 → wait) or the batch is still
+                        # queued and counted in `pending` below.
+                        # Fast-lane arena records hold NO msgids yet
+                        # (assigned at take()): they will draw ids from
+                        # next_msgid onward, which the default already
+                        # rebases to.
+                        if t.inflight > 0:
+                            return
+                        pending = []
+                        for b in t.retry_batches:
+                            pending += batch_msgids(b)
+                        pending += [m.msgid for m in t.xmit_msgq]
+                        pending += [m.msgid for m in t.msgq]
+                        t.epoch_base_msgid = (
+                            min(pending, default=t.next_msgid) - 1)
+                self.state = "INIT"
+            if self.state in ("INIT", "RETRY"):
+                broker = self.rk.any_up_broker()
+                if broker is None:
+                    return
+                self.state = "WAIT_PID"
+                broker.enqueue_request(Request(
+                    ApiKey.InitProducerId,
+                    {"transactional_id": None,
+                     "transaction_timeout_ms": 60000},
+                    retries_left=3, cb=self._handle_pid))
+
+    def _handle_pid(self, err, resp):
+        with self._lock:
+            if self.state != "WAIT_PID":
+                return          # a drain was requested while in flight
+            if err is not None or resp["error_code"] != 0:
+                self.state = "RETRY"
+                return
+            self.pid = resp["producer_id"]
+            self.epoch = resp["producer_epoch"]
+            self.state = "ASSIGNED"
+            self.rk.dbg("eos", f"assigned PID {self.pid} epoch {self.epoch}")
+
+    def drain_epoch_bump(self, reason: str):
+        """Enter DRAIN: stop producing; serve() acquires a new PID and
+        rebases sequence origins once every in-flight request has
+        resolved (reference DRAIN_BUMP, rdkafka_idempotence.c:374-440).
+        Used for recoverable gaps the broker never saw (e.g. messages
+        timing out locally, rdkafka_broker.c:3291-3309) — NOT for
+        head-of-line sequence desync, which is fatal."""
+        with self._lock:
+            if self.state in ("ASSIGNED", "WAIT_PID"):
+                self.rk.dbg("eos", f"drain+epoch bump: {reason}")
+                self.state = "DRAIN"
+
+
+class Kafka:
+    """Client instance; create via Producer() or Consumer()."""
+
+    def __init__(self, conf: Conf, client_type: str):
+        self.conf = conf
+        self.type = client_type
+        self.is_producer = client_type == PRODUCER
+        self.is_consumer = client_type == CONSUMER
+        self.rep = OpQueue("rk_rep")          # app-facing reply queue
+        self.ops = OpQueue("rk_ops")
+        self.timers = Timers()
+        self.brokers: dict[int, Broker] = {}
+        self._bootstrap: list[Broker] = []
+        self._brokers_lock = threading.Lock()
+        self.topics: dict[str, Topic] = {}
+        self._topics_lock = threading.Lock()
+        self._toppars: dict[tuple[str, int], Toppar] = {}
+        self._toppars_lock = threading.Lock()
+        self.metadata: dict = {"brokers": {}, "topics": {}}
+        self._metadata_lock = threading.Lock()
+        self._metadata_inflight = False
+        self._metadata_refresh_queued = False
+        self._fast_refresh_scheduled = False
+        self._addr_cache: dict = {}        # broker.address.ttl DNS cache
+        self._purge_epoch = 0              # invalidates in-pipeline batches
+        self._metadata_topic_ts: dict = {}  # topic -> last metadata time
+        self.flushing = False
+        self.terminating = False
+        self.fatal_error: Optional[KafkaError] = None
+        # Queue accounting lives in the enqueue lane (native when the
+        # extension builds): C produce() updates the counters atomically
+        # under the GIL; Python paths go through lane.acct().  msg_cnt /
+        # msg_bytes remain readable as properties.
+        self._lane = lane_new()
+        # DR ops pushed to the reply queue but not yet served to the app.
+        # flush() must wait on msg_cnt + dr_cnt, like the reference's
+        # rd_kafka_outq_len which counts undelivered DR ops
+        # (rdkafka.c:3905) — otherwise flush() can return between the
+        # msg_cnt decrement and the DR callback, losing the report to a
+        # post-flush close.
+        self.dr_cnt = 0
+        # serializes COMPOUND transitions (msg_cnt release + dr_cnt
+        # claim) against flush()'s combined read
+        self._msg_cnt_lock = threading.Lock()
+        self.cgrp = None                       # set by Consumer
+        self.consumer = None                   # back-ref set by Consumer
+        self.interceptors = conf.get("interceptors") or None
+        self.mock_cluster = None
+        self.stats = None                      # StatsCollector, set below
+        self.debug_contexts = set(conf.get("debug"))
+        # debug contexts force DEBUG visibility (the reference raises
+        # log_level to 7 whenever debug is set, rd_kafka_conf_finalize)
+        self._log_level = (7 if self.debug_contexts
+                           else conf.get("log_level"))
+        self.log_cb = conf.get("log_cb")
+        # topic.blacklist (reference rdkafka_pattern.c blacklist list):
+        # matching topics are invisible to metadata/subscriptions
+        import re as _re
+        self._blacklist = [_re.compile(pat if pat.startswith("^") else
+                                       "^" + _re.escape(pat) + "$")
+                           for pat in conf.get("topic.blacklist")]
+
+        # native enqueue fast lane (client/arena.py): engaged per call
+        # when there are no DR consumers or interceptors — produce()
+        # then marshals key/value into a per-toppar native arena in one
+        # C call instead of building a Message object (the app-thread
+        # GIL ceiling; reference zero-allocation enqueue rdkafka_msg.c)
+        self._fast_lane_ver = -1          # recompute on conf mutation
+        self._fast_lane = False
+        # validated (topic, partition) -> Toppar with a live arena; one
+        # dict hit replaces topic lookup + partition check + toppar
+        # lookup on the produce hot path
+        self._fast_tp: dict = {}
+        # the lane's C produce() is the public entry point: eligible
+        # records never touch a Python frame; everything else tails into
+        # _produce_slow (the Message pipeline + first-sight setup)
+        self._lane.configure(
+            self._produce_slow, self._wake_leader,
+            conf.get("queue.buffering.max.messages"),
+            conf.get("queue.buffering.max.kbytes") * 1024,
+            conf.get("message.copy.max.bytes"))
+        self.produce = self._lane.produce
+        conf.add_listener(self._recompute_fast_lane)
+        self._recompute_fast_lane()
+
+        # codec provider selection (compression.backend; SURVEY.md §7 st.5)
+        backend = conf.get("compression.backend")
+        if backend == "tpu":
+            from ..ops.tpu import TpuCodecProvider
+            self.codec_provider = TpuCodecProvider(
+                min_batches=conf.get("tpu.launch.min.batches"),
+                mesh_devices=conf.get("tpu.mesh.devices"),
+                lz4_force=conf.get("tpu.lz4.force"),
+                min_transport_mb_s=conf.get("tpu.transport.min.mb.s"))
+        else:
+            from ..ops.cpu import CpuCodecProvider
+            self.codec_provider = CpuCodecProvider()
+
+        self.idemp = (IdempotenceManager(self)
+                      if self.is_producer and conf.get("enable.idempotence")
+                      else None)
+
+        # codec pipeline thread (codec.pipeline.depth; SURVEY.md §5
+        # axis 2 — overlap batch build/socket IO with codec launches)
+        self.codec_pipeline_depth = conf.get("codec.pipeline.depth")
+        self.codec_worker = None
+        if self.is_producer and self.codec_pipeline_depth > 0:
+            from .broker import CodecWorker
+            self.codec_worker = CodecWorker(self)
+
+        # OAUTHBEARER app-supplied token (set_oauthbearer_token; the
+        # refresh flow of rdkafka_sasl_oauthbearer.c's
+        # RD_KAFKA_OP_OAUTHBEARER_REFRESH machinery)
+        self._oauth_token = None      # (token, principal, expiry_unix)
+        self._oauth_failure = None
+        self._oauth_timer = None
+        self._oauth_cb_lock = threading.Lock()
+
+        # TLS context — one per instance, shared by all broker threads
+        # (reference: rd_kafka_ssl_ctx_init, rdkafka_ssl.c)
+        from . import tls as _tls
+        self._ssl_ctx = _tls.make_client_ctx(conf)
+
+        # SASL mechanism validation happens at client creation so a
+        # misconfigured mechanism fails fast (reference: rd_kafka_new
+        # sasl checks, rdkafka.c:~2000)
+        if self.sasl_required():
+            from .sasl import validate_mechanism
+            validate_mechanism(conf)
+
+        from .stats import StatsCollector
+        self.stats = StatsCollector(self)
+
+        # legacy file offset store (offset.store.method=file)
+        self.offset_store = None
+        if self.is_consumer:
+            from .offset_store import FileOffsetStore
+            self.offset_store = FileOffsetStore(self)
+
+        # optional background event thread (rdkafka_background.c:109,
+        # created at rd_kafka_new rdkafka.c:2189-2196)
+        self.background = None
+        bg_cb = conf.get("background_event_cb")
+        if bg_cb is not None:
+            from .event import BackgroundThread
+            self.background = BackgroundThread(self, bg_cb)
+
+        # implicit mock cluster (test.mock.num.brokers)
+        nmock = conf.get("test.mock.num.brokers")
+        bootstrap = conf.get("bootstrap.servers")
+        if nmock > 0 and not bootstrap:
+            from ..mock.cluster import MockCluster
+            self.mock_cluster = MockCluster(
+                num_brokers=nmock,
+                default_partitions=conf.get("test.mock.default.partitions"))
+            bootstrap = self.mock_cluster.bootstrap_servers()
+        if not bootstrap:
+            raise KafkaException(Err._INVALID_ARG,
+                                 "bootstrap.servers not configured")
+
+        # plugins (plugin.library.paths; reference rdkafka_plugin.c —
+        # each entry's conf_init() registers interceptors)
+        plugin_paths = conf.get("plugin.library.paths")
+        if plugin_paths:
+            from .interceptor import load_plugins
+            self.interceptors = load_plugins(plugin_paths, conf)
+            conf.set("interceptors", self.interceptors)
+
+        # interceptors on_new
+        if self.interceptors:
+            self.interceptors.on_new(self)
+
+        nodeid = -1
+        for hp in bootstrap.split(","):
+            host, _, port = hp.strip().rpartition(":")
+            b = Broker(self, nodeid, host, int(port),
+                       name=f"{host}:{port}/bootstrap")
+            self._bootstrap.append(b)
+            self.brokers[nodeid] = b
+            nodeid -= 1
+
+        # timers (reference main loop rdkafka.c:1877-1886)
+        refresh = conf.get("topic.metadata.refresh.interval.ms")
+        if refresh > 0:
+            self.timers.add(refresh / 1000.0,
+                            lambda: self.metadata_refresh("periodic"))
+        self.timers.add(1.0, self._scan_msg_timeouts)
+        stats_ival = conf.get("statistics.interval.ms")
+        if stats_ival > 0:
+            self.timers.add(stats_ival / 1000.0, self._emit_stats)
+
+        self._main = threading.Thread(target=self._thread_main,
+                                      name="rdk:main", daemon=True)
+        self._main.start()
+        for b in self._bootstrap:
+            b.start()
+        self.metadata_refresh("bootstrap")
+
+    # ------------------------------------------------------------ logging --
+    _LOG_LEVELS = {"EMERG": 0, "ALERT": 1, "CRIT": 2, "ERROR": 3,
+                   "WARN": 4, "NOTICE": 5, "INFO": 6, "DEBUG": 7}
+
+    def log(self, level: str, msg: str):
+        # numeric syslog-style filter (reference log_level, default 6)
+        if self._LOG_LEVELS.get(level, 6) > self._log_level:
+            return
+        # log.thread.name: tag messages with the emitting thread exactly
+        # like the reference's "[thrd:...]" prefix (rdlog.c)
+        if self.conf.get("log.thread.name"):
+            msg = f"[thrd:{threading.current_thread().name}] {msg}"
+        # log.queue: logs become LOG events served from the app-facing
+        # queue (poll/queue_poll) instead of synchronous output — the
+        # log_cb then fires on the POLLING thread (reference
+        # rd_kafka_conf "log.queue" + rd_kafka_set_log_queue)
+        if self.conf.get("log.queue"):
+            self.rep.push(Op(OpType.LOG, payload=(level, "rdkafka", msg)))
+            return
+        if self.log_cb:
+            self.log_cb(level, "rdkafka", msg)
+        elif level in ("ERROR", "WARN"):
+            print(f"%{level}|rdkafka| {msg}", file=sys.stderr)
+
+    def dbg(self, ctx: str, msg: str):
+        if ctx in self.debug_contexts or "all" in self.debug_contexts:
+            self.log("DEBUG", f"[{ctx}] {msg}")
+
+    # -------------------------------------------------------- main thread --
+    def _thread_main(self):
+        if self.interceptors:
+            self.interceptors.on_thread_start("main", "rdk:main")
+        while not self.terminating:
+            timeout = self.timers.next_timeout(0.1)
+            op = self.ops.pop(timeout)
+            if op is not None:
+                self._op_serve(op)
+            self.timers.run()
+            if self.idemp:
+                self.idemp.serve()
+            if self.cgrp:
+                self.cgrp.serve()
+        if self.interceptors:
+            self.interceptors.on_thread_exit("main", "rdk:main")
+
+    def _op_serve(self, op: Op):
+        if op.cb:
+            op.cb(op)
+
+    # ----------------------------------------------------------- metadata --
+    def blacklisted(self, topic: str) -> bool:
+        return any(p.search(topic) for p in self._blacklist)
+
+    def any_up_broker(self) -> Optional[Broker]:
+        with self._brokers_lock:
+            ups = [b for b in self.brokers.values() if b.is_up()]
+        return random.choice(ups) if ups else None
+
+    def metadata_refresh(self, reason: str = ""):
+        if self.terminating:
+            return
+        if self._metadata_inflight:
+            # queue one follow-up so a refresh requested mid-flight (e.g.
+            # regex discovery racing a sparse refresh) is not lost until
+            # the periodic timer (reference: rd_kafka_metadata_refresh
+            # coalescing)
+            self._metadata_refresh_queued = True
+            return
+        b = self.any_up_broker()
+        if b is None:
+            # will be retried when a broker comes up (broker_state_change)
+            return
+        self._metadata_inflight = True
+        sparse = self.conf.get("topic.metadata.refresh.sparse")
+        with self._topics_lock:
+            names = list(self.topics) if sparse else None
+        if names == []:
+            names = None if not self.is_consumer else []
+        if self.cgrp is not None and self.cgrp.patterns:
+            # regex subscriptions need the full cluster topic list
+            names = None
+        # metadata.max.age.ms: expire cache entries past their age
+        # (reference rdkafka_metadata_cache.c:289). Existing toppar
+        # leader delegation is updated by the refresh RESPONSE
+        # (_assign_toppar_leader); the expiry only keeps get_toppar and
+        # admin list_topics from reading decayed entries meanwhile
+        max_age = self.conf.get("metadata.max.age.ms") / 1000.0
+        now = time.monotonic()
+        with self._metadata_lock:
+            for name, ts in list(self._metadata_topic_ts.items()):
+                if now - ts > max_age:
+                    self.metadata["topics"].pop(name, None)
+                    del self._metadata_topic_ts[name]
+        self.dbg("metadata", f"refresh ({reason}) via {b.name}")
+        full = not names        # None or [] → broker enumerates all topics
+        b.enqueue_request(Request(
+            ApiKey.Metadata,
+            # v4+ carries the auto-creation flag: producers may trigger
+            # broker-side topic creation, consumers only when
+            # allow.auto.create.topics (KIP-204; reference
+            # rd_kafka_MetadataRequest). Older negotiated versions
+            # simply don't serialize the key.
+            {"topics": names,
+             "allow_auto_topic_creation":
+                 self.is_producer or
+                 bool(self.conf.get("allow.auto.create.topics"))},
+            retries_left=2,
+            abs_timeout=time.monotonic() +
+            self.conf.get("metadata.request.timeout.ms") / 1000.0,
+            cb=lambda e, r: self._handle_metadata(e, r, full=full)))
+
+    def _handle_metadata(self, err, resp, full: bool = False):
+        self._metadata_inflight = False
+        if self._metadata_refresh_queued:
+            self._metadata_refresh_queued = False
+            self.timers.add(0.05, lambda: self.metadata_refresh("queued"),
+                            once=True)
+        if err is not None:
+            return
+        with self._metadata_lock:
+            new_brokers = {b["node_id"]: (b["host"], b["port"])
+                           for b in resp["brokers"]}
+            self.metadata["brokers"] = new_brokers
+            self.metadata["controller_id"] = resp.get("controller_id", -1)
+            seen = set()
+            for t in resp["topics"]:
+                if self.blacklisted(t["topic"]):
+                    continue
+                terr = Err.from_wire(t["error_code"])
+                if terr == Err.UNKNOWN_TOPIC_OR_PART:
+                    # topic deleted: drop it from the cache
+                    self.metadata["topics"].pop(t["topic"], None)
+                    continue
+                if terr != Err.NO_ERROR:
+                    # transient (e.g. LEADER_NOT_AVAILABLE during
+                    # election): the topic still exists — keep it in
+                    # `seen` so prune/regex don't treat it as deleted
+                    seen.add(t["topic"])
+                    continue
+                seen.add(t["topic"])
+                self.metadata["topics"][t["topic"]] = {
+                    p["partition"]: p["leader"] for p in t["partitions"]}
+                self._metadata_topic_ts[t["topic"]] = time.monotonic()
+            if full:
+                # a full metadata response enumerates every topic: prune
+                # cache entries that vanished (deleted topics)
+                for name in list(self.metadata["topics"]):
+                    if name not in seen:
+                        del self.metadata["topics"][name]
+        if full and self.cgrp is not None:
+            # regex subscription re-evaluation (rdkafka_pattern.c)
+            self.cgrp.metadata_update(seen)
+        # leaderless partitions (election in progress): re-query on the
+        # fast interval (topic.metadata.refresh.fast.interval.ms;
+        # reference rd_kafka_metadata_refresh fast path)
+        leaderless = any(
+            p["leader"] < 0
+            for t in resp["topics"] if t["error_code"] == 0
+            for p in t["partitions"])
+        if leaderless and not self._fast_refresh_scheduled:
+            self._fast_refresh_scheduled = True
+            fast = self.conf.get(
+                "topic.metadata.refresh.fast.interval.ms") / 1000.0
+
+            def _fast_refresh():
+                self._fast_refresh_scheduled = False
+                self.metadata_refresh("fast")
+
+            self.timers.add(fast, _fast_refresh, once=True)
+        # instantiate broker threads for newly discovered nodes
+        with self._brokers_lock:
+            for nid, (host, port) in new_brokers.items():
+                if nid not in self.brokers:
+                    b = Broker(self, nid, host, port)
+                    self.brokers[nid] = b
+                    b.start()
+        # update topic partition counts + migrate UA messages + leaders
+        for t in resp["topics"]:
+            name = t["topic"]
+            topic = self.topics.get(name)
+            if topic is not None:
+                with topic.lock:
+                    topic.partition_cnt = len(t["partitions"])
+                if self.is_producer:
+                    self._fail_unknown_partitions(name, len(t["partitions"]))
+            for p in t["partitions"]:
+                if p["leader"] < 0:
+                    continue
+                tp = self.get_toppar(name, p["partition"],
+                                     create=(topic is not None))
+                if tp is not None:
+                    self._assign_toppar_leader(tp, p["leader"])
+        self._migrate_ua_msgs()
+
+    def _assign_toppar_leader(self, tp: Toppar, leader: int):
+        if tp.leader_id == leader:
+            return
+        old = tp.leader_id
+        tp.leader_id = leader
+        with self._brokers_lock:
+            if old in self.brokers:
+                self.brokers[old].remove_toppar(tp)
+            if leader in self.brokers:
+                self.brokers[leader].add_toppar(tp)
+        self.dbg("topic", f"{tp}: leader {old} -> {leader}")
+
+    def _fail_unknown_partitions(self, topic: str, cnt: int):
+        """Error-DR messages parked on partitions beyond the topic's real
+        partition count (reference: rd_kafka_topic_partition_cnt_update →
+        UNKNOWN_PARTITION delivery failures, rdkafka_topic.c)."""
+        with self._toppars_lock:
+            tps = [tp for (t, p), tp in self._toppars.items()
+                   if t == topic and p >= cnt]
+        for tp in tps:
+            self._fast_tp.pop((tp.topic, tp.partition), None)
+            self._lane.map.pop((tp.topic, tp.partition), None)
+            failed: list[Message] = []
+            fast_cnt = fast_bytes = 0
+            with tp.lock:
+                failed.extend(tp.msgq)
+                tp.msgq.clear()
+                tp.msgq_bytes = 0
+                failed.extend(tp.xmit_msgq)
+                tp.xmit_msgq.clear()
+                for b in tp.retry_batches:
+                    if isinstance(b, ArenaBatch):
+                        fast_cnt += b.count
+                        fast_bytes += b.nbytes
+                    else:
+                        failed.extend(b)
+                tp.retry_batches.clear()
+                if tp.arena is not None:
+                    c, nb = tp.arena.clear()
+                    fast_cnt += c
+                    fast_bytes += nb
+            if fast_cnt:
+                self._lane.acct(-fast_cnt, -fast_bytes)
+            if failed:
+                self.dr_msgq(failed, KafkaError(
+                    Err._UNKNOWN_PARTITION,
+                    f"{tp}: partition does not exist"))
+
+    def _migrate_ua_msgs(self):
+        with self._topics_lock:
+            topics = list(self.topics.values())
+        for topic in topics:
+            with topic.lock:
+                if topic.partition_cnt <= 0 or not topic.ua_msgq:
+                    continue
+                msgs, topic.ua_msgq = topic.ua_msgq, deque()
+            for m in msgs:
+                self._partition_and_enq(topic, m)
+
+    # -------------------------------------------------------------- topics --
+    def get_topic(self, name: str) -> Topic:
+        created = False
+        with self._topics_lock:
+            t = self.topics.get(name)
+            if t is None:
+                t = Topic(name, self.conf.topic_conf())
+                self.topics[name] = t
+                created = True
+        if created:
+            # outside _topics_lock: metadata_refresh re-acquires it
+            self.metadata_refresh(f"new topic {name}")
+        return t
+
+    def topic_conf_for(self, name: str) -> TopicConf:
+        with self._topics_lock:
+            t = self.topics.get(name)
+        return t.conf if t else self.conf.topic_conf()
+
+    def get_toppar(self, topic: str, partition: int,
+                   create: bool = True) -> Optional[Toppar]:
+        key = (topic, partition)
+        with self._toppars_lock:
+            tp = self._toppars.get(key)
+            if tp is None and create:
+                tp = Toppar(topic, partition)
+                self._toppars[key] = tp
+                with self._metadata_lock:
+                    leader = self.metadata["topics"].get(topic, {}).get(partition)
+                if leader is not None and leader >= 0:
+                    self._assign_toppar_leader(tp, leader)
+            return tp
+
+    # ------------------------------------------------------------ produce --
+    @property
+    def msg_cnt(self) -> int:
+        return self._lane.msg_cnt
+
+    @property
+    def msg_bytes(self) -> int:
+        return self._lane.msg_bytes
+
+    def _produce_slow(self, topic: str, value=None, key=None,
+                      partition=PARTITION_UA, on_delivery=None, timestamp=0,
+                      headers=(), opaque=None) -> None:
+        """The Message-path produce (and the fast lane's first-sight
+        setup).  The PUBLIC entry point is ``self.produce`` — the native
+        Lane.produce (enqlane.cpp), which handles every eligible record
+        in one C call and tail-calls here for the rest."""
+        # positional order matches the confluent-style public API
+        # (topic, value, key, partition, on_delivery, timestamp, headers)
+        if isinstance(value, str):
+            value = value.encode()
+        if isinstance(key, str):
+            key = key.encode()
+        if self.fatal_error:
+            raise KafkaException(self.fatal_error)
+        sz = (len(value) if value else 0) + (len(key) if key else 0)
+        # lock keeps check+claim atomic on this Python path (the C lane
+        # does both inside one GIL-atomic call)
+        with self._msg_cnt_lock:
+            if self._lane.full(sz):
+                raise KafkaException(Err._QUEUE_FULL,
+                                     "producer queue is full")
+            self._lane.acct(1, sz)
+        # native enqueue fast lane: no Message object, one C call into
+        # the per-toppar arena (queue accounting above is shared;
+        # _fast_lane stays fresh via the conf.add_listener hook)
+        if (self._fast_lane and partition >= 0 and not headers
+                and on_delivery is None and opaque is None and not timestamp
+                and (value is None or type(value) is bytes)
+                and (key is None or type(key) is bytes)
+                and self._produce_fast(topic, key, value, partition, sz)):
+            return
+        m = Message(topic, value=value, key=key, partition=partition,
+                    headers=headers, timestamp=timestamp, opaque=opaque)
+        if on_delivery is not None:
+            m.on_delivery = on_delivery   # per-message DR callback
+        if self.interceptors:
+            self.interceptors.on_send(m)
+        # lock-free fast path: dict reads are atomic under the GIL; fall
+        # back to the locked creation path on first sight of a topic
+        t = self.topics.get(topic)
+        if t is None:
+            t = self.get_topic(topic)
+        if partition == PARTITION_UA:
+            with t.lock:
+                if t.partition_cnt <= 0:
+                    t.ua_msgq.append(m)     # park until metadata
+                    return
+            self._partition_and_enq(t, m)
+        else:
+            cnt = t.partition_cnt       # int read: GIL-atomic, no lock
+            if 0 < cnt <= partition:
+                # known-invalid partition fails at produce() time
+                # (reference: rd_kafka_msg_partitioner → UNKNOWN_PARTITION)
+                self._lane.acct(-1, -sz)
+                raise KafkaException(
+                    Err._UNKNOWN_PARTITION,
+                    f"{topic}[{partition}]: partition does not exist")
+            tp = self._toppars.get((topic, partition))
+            if tp is None:
+                tp = self.get_toppar(topic, partition)
+            if tp.arena_ok:
+                self._demote(tp)    # Message path claims this toppar
+            if tp.enq_msg(m):
+                self._wake_leader(tp)
+
+    def _recompute_fast_lane(self) -> None:
+        conf = self.conf
+        self._fast_lane = (
+            self.is_producer
+            and not self.interceptors
+            and not conf.get("dr_msg_cb") and not conf.get("dr_cb")
+            and "dr" not in conf.get("enabled_events")
+            and conf.get("background_event_cb") is None)
+        self._fast_lane_ver = getattr(conf, "version", 0)
+        # the C entry consults this flag before touching an arena; a
+        # conf.set that adds a DR consumer flips it via the listener
+        try:
+            self._lane.enabled = 1 if self._fast_lane else 0
+        except AttributeError:
+            pass                        # lane not constructed yet
+
+    def _produce_fast(self, topic: str, key, value, partition: int,
+                      sz: int) -> bool:
+        """Fast-lane enqueue; False = caller falls back to the Message
+        path (queue accounting stays — both paths share it)."""
+        tp = self._fast_tp.get((topic, partition))
+        if tp is not None:
+            if not tp.arena_ok:         # demoted since caching
+                return False
+            if tp.arena.append(key, value) == 1:
+                self._wake_leader(tp)   # wake on empty→non-empty only
+            return True
+        # ---- first sight: validate, create the arena, cache ------------
+        t = self.topics.get(topic)
+        if t is None:
+            t = self.get_topic(topic)
+        cnt = t.partition_cnt
+        if 0 < cnt <= partition:
+            self._lane.acct(-1, -sz)
+            raise KafkaException(
+                Err._UNKNOWN_PARTITION,
+                f"{topic}[{partition}]: partition does not exist")
+        tp = self._toppars.get((topic, partition))
+        if tp is None:
+            tp = self.get_toppar(topic, partition)
+        if not tp.arena_ok:
+            # cache the demoted toppar too: the next eligible produce
+            # short-circuits on one dict hit instead of re-running the
+            # topic/partition/toppar lookups before falling back
+            self._fast_tp[(topic, partition)] = tp
+            return False
+        a = tp.arena
+        if a is None:
+            with tp.lock:
+                if tp.arena is None and tp.arena_ok:
+                    tp.arena = arena_new()
+                a = tp.arena
+            if a is None:               # extension unavailable: demote
+                tp.arena_ok = False
+                self._fast_tp[(topic, partition)] = tp
+                return False
+        self._fast_tp[(topic, partition)] = tp
+        # register with the C entry point: subsequent produces for this
+        # toppar never enter a Python frame
+        self._lane.map[(topic, partition)] = (a, tp)
+        if a.append(key, value) == 1:
+            self._wake_leader(tp)
+        return True
+
+    def _partition_and_enq(self, topic: Topic, m: Message):
+        pcb = topic.conf.get("partitioner_cb")
+        if pcb:
+            m.partition = pcb(m.key, topic.partition_cnt)
+        else:
+            m.partition = topic.partitioner(m.key, topic.partition_cnt)
+        tp = self._toppars.get((topic.name, m.partition))
+        if tp is None:
+            tp = self.get_toppar(topic.name, m.partition)
+        if tp.arena_ok:
+            self._demote(tp)        # Message path claims this toppar
+        if tp.enq_msg(m):
+            self._wake_leader(tp)
+
+    def _demote(self, tp: Toppar) -> None:
+        """Permanently route a toppar through the Message path: remove
+        it from the C entry's map FIRST so no new fast-lane records land
+        while the arena drains into the msgq (FIFO preserved)."""
+        key = (tp.topic, tp.partition)
+        self._lane.map.pop(key, None)
+        self._fast_tp.pop(key, None)
+        tp.demote_arena()
+
+    def _wake_leader(self, tp: Toppar):
+        with self._brokers_lock:
+            b = self.brokers.get(tp.leader_id)
+        if b is not None:
+            b.ops.push(Op(OpType.BROKER_WAKEUP))
+
+    # ------------------------------------------------------------ DR path --
+    def dr_msgq(self, msgs, err: Optional[KafkaError]):
+        """Queue delivery reports (reference: rd_kafka_dr_msgq,
+        rdkafka_broker.c:2432).  Accepts list[Message] or a fast-lane
+        ArenaBatch — the lane is only engaged when there are no DR
+        consumers, so an ArenaBatch resolves to pure queue accounting."""
+        if isinstance(msgs, ArenaBatch):
+            with self._msg_cnt_lock:
+                self._lane.acct(-msgs.count, -msgs.nbytes)
+            return
+        if err is not None:
+            for m in msgs:
+                m.error = err
+        if self.interceptors:
+            for m in msgs:
+                self.interceptors.on_acknowledgement(m)
+        out = []
+        if (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
+                or "dr" in self.conf.get("enabled_events")
+                or self.background is not None
+                or any(m.on_delivery is not None for m in msgs)):
+            only_err = self.conf.get("delivery.report.only.error")
+            out = msgs if (err or not only_err) else \
+                [m for m in msgs if m.error]
+        # msg_cnt release and dr_cnt claim must be ONE atomic step:
+        # a flush() reading between them would see outstanding == 0 and
+        # return before the DR reaches the app
+        with self._msg_cnt_lock:
+            self._lane.acct(-len(msgs), -sum(m.size for m in msgs))
+            self.dr_cnt += len(out)
+        if out:
+            # one DR op per batch, not per message (queue-push overhead)
+            self.rep.push(Op(OpType.DR, payload=out))
+
+    def poll(self, timeout: float = 0.0) -> int:
+        """Serve the app reply queue: DRs, errors, stats, logs
+        (reference: rd_kafka_poll, rdkafka.c:3574)."""
+        served = 0
+        t = timeout
+        while True:
+            op = self.rep.pop(t)
+            if op is None:
+                return served
+            t = 0
+            self._serve_rep_op(op)
+            served += 1
+
+    def queue_poll(self, timeout: float = 0.0):
+        """Pop one typed Event from the reply queue (reference:
+        rd_kafka_queue_poll → rd_kafka_event_t). Alternative to the
+        callback dispatch of poll()."""
+        from .event import Event
+        op = self.rep.pop(timeout)
+        if op is not None and op.type == OpType.DR:
+            self._dr_served(len(op.payload))
+        return Event(op) if op is not None else None
+
+    def _dr_served(self, n: int) -> None:
+        """A DR op reached the app (callback fired / event popped)."""
+        with self._msg_cnt_lock:
+            self.dr_cnt -= n
+
+    def _serve_rep_op(self, op: Op):
+        if op.type == OpType.DR:
+            cb = self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
+            try:
+                for m in op.payload:
+                    mcb = m.on_delivery or cb
+                    if mcb:
+                        mcb(m.error, m)
+            finally:
+                self._dr_served(len(op.payload))
+        elif op.type == OpType.ERR:
+            cb = self.conf.get("error_cb")
+            if cb:
+                cb(op.payload)
+        elif op.type == OpType.THROTTLE:
+            cb = self.conf.get("throttle_cb")
+            if cb:
+                cb(*op.payload)       # (broker_name, broker_id, throttle_ms)
+        elif op.type == OpType.STATS:
+            cb = self.conf.get("stats_cb")
+            if cb:
+                cb(op.payload)
+        elif op.type == OpType.LOG:
+            if self.log_cb:
+                self.log_cb(*op.payload)
+        elif op.cb:
+            op.cb(op)
+
+    @property
+    def outq_len(self) -> int:
+        """rd_kafka_outq_len: unacked messages + undelivered DR ops."""
+        with self._msg_cnt_lock:
+            return self.msg_cnt + self.dr_cnt
+
+    def op_err(self, err: KafkaError):
+        self.rep.push(Op(OpType.ERR, payload=err))
+
+    def set_fatal_error(self, err: KafkaError):
+        err.fatal = True
+        if self.fatal_error is None:
+            self.fatal_error = err
+            self._lane.fatal = 1        # C produce must reject now
+            self.op_err(err)
+
+    # -------------------------------------------------------------- flush --
+    def flush(self, timeout: float = 10.0) -> int:
+        """Wait for all outstanding messages; returns count still queued
+        (reference: rd_kafka_flush, rdkafka.c:3905)."""
+        self.flushing = True
+        # DR-mode split (reference rk_drmode, rd_kafka_flush): with a dr
+        # callback, flush serves the reply queue itself; in event mode
+        # (enabled_events has "dr", no callback) it must NOT consume DR
+        # events destined for the app's queue_poll — it only waits for
+        # another thread (or the background thread) to drain them.
+        dr_event_mode = (
+            not (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb"))
+            and "dr" in self.conf.get("enabled_events")
+            and self.background is None)
+        try:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._msg_cnt_lock:
+                    # undelivered DR ops count toward the outstanding
+                    # total (reference rd_kafka_outq_len, rdkafka.c:3905)
+                    n = self.msg_cnt + self.dr_cnt
+                if n == 0:
+                    return 0
+                self._wake_all_brokers()
+                if dr_event_mode:
+                    time.sleep(0.01)
+                else:
+                    self.poll(0.01)
+            with self._msg_cnt_lock:
+                return self.msg_cnt + self.dr_cnt
+        finally:
+            self.flushing = False
+
+    def purge(self, in_queue: bool = True, in_flight: bool = False) -> None:
+        """Purge messages (reference: rd_kafka_purge):
+        ``in_queue`` — every queued message (msgq, xmit_msgq, frozen
+        retry batches, UA parking) gets a _PURGE_QUEUE DR;
+        ``in_flight`` — outstanding ProduceRequests are abandoned on the
+        broker threads and their messages get _PURGE_INFLIGHT DRs (any
+        late broker response is dropped by the corrid filter)."""
+        purged = []
+        fast_cnt = fast_bytes = 0
+        with self._toppars_lock:
+            tps = list(self._toppars.values())
+        for tp in tps:
+            with tp.lock:
+                if in_queue:
+                    purged.extend(tp.msgq)
+                    tp.msgq.clear()
+                    tp.msgq_bytes = 0
+                    purged.extend(tp.xmit_msgq)
+                    tp.xmit_msgq.clear()
+                    for batch in tp.retry_batches:
+                        if isinstance(batch, ArenaBatch):
+                            fast_cnt += batch.count
+                            fast_bytes += batch.nbytes
+                        else:
+                            purged.extend(batch)
+                    tp.retry_batches.clear()
+                    if tp.arena is not None:
+                        c, nb = tp.arena.clear()
+                        fast_cnt += c
+                        fast_bytes += nb
+        with self._topics_lock:
+            for t in self.topics.values():
+                with t.lock:
+                    if in_queue:
+                        purged.extend(t.ua_msgq)
+                        t.ua_msgq.clear()
+        if fast_cnt:
+            self._lane.acct(-fast_cnt, -fast_bytes)
+        if purged:
+            self.dr_msgq(purged, KafkaError(Err._PURGE_QUEUE, "purged"))
+        if in_flight:
+            # batches inside the codec pipeline are neither queued nor in
+            # waitresp: bump the purge epoch so their codec_done results
+            # are discarded with _PURGE_INFLIGHT instead of being sent
+            self._purge_epoch += 1
+            with self._brokers_lock:
+                brokers = list(self.brokers.values())
+            for b in brokers:
+                b.ops.push(Op(OpType.PURGE))
+        if self.idemp and (purged or fast_cnt or in_flight):
+            # purged messages consumed msgids: the sequence chain has a
+            # gap the broker would reject — resync PID/epoch (the DRAIN
+            # rebase recomputes the base from what is still pending)
+            self.idemp.drain_epoch_bump("purge")
+
+    def _wake_all_brokers(self):
+        with self._brokers_lock:
+            for b in self.brokers.values():
+                b.ops.push(Op(OpType.BROKER_WAKEUP))
+
+    # ------------------------------------------------- broker transitions --
+    def broker_state_change(self, broker: Broker):
+        if broker.is_up():
+            self.metadata_refresh(f"broker {broker.name} up")
+
+    def broker_down(self, broker: Broker, err: KafkaError):
+        with self._brokers_lock:
+            any_up = any(b.is_up() for b in self.brokers.values())
+        if not any_up and not self.terminating:
+            self.op_err(KafkaError(Err._ALL_BROKERS_DOWN,
+                                   "all brokers are down"))
+
+    # ------------------------------------------------------ msg timeouts --
+    def _scan_msg_timeouts(self):
+        """(reference: rd_kafka_broker_toppar_msgq_scan,
+        rdkafka_broker.c:3093)"""
+        if not self.is_producer:
+            return
+        now = time.monotonic()
+        with self._toppars_lock:
+            tps = list(self._toppars.values())
+        any_possibly_persisted = False
+        any_expired = False
+        for tp in tps:
+            tmo = self.topic_conf_for(tp.topic).get("message.timeout.ms") / 1000.0
+            if tmo <= 0:
+                continue
+            expired = []
+            fast_cnt = fast_bytes = 0
+            fast_pp = False
+            with tp.lock:
+                if tp.arena is not None and len(tp.arena):
+                    # fast-lane records carry a native monotonic µs stamp
+                    c, nb = tp.arena.expire(int((now - tmo) * 1e6))
+                    fast_cnt += c
+                    fast_bytes += nb
+                for q in (tp.msgq, tp.xmit_msgq):
+                    while q and now - q[0].enq_time > tmo:
+                        expired.append(q.popleft())
+                # frozen retry batches expire whole (membership must stay
+                # intact); a batch expires when its head message has
+                # (reference scans all queues, rdkafka_broker.c:3093)
+                while tp.retry_batches:
+                    b = tp.retry_batches[0]
+                    head_enq = (b.enq_first if isinstance(b, ArenaBatch)
+                                else b[0].enq_time)
+                    if now - head_enq <= tmo:
+                        break
+                    tp.retry_batches.popleft()
+                    if isinstance(b, ArenaBatch):
+                        fast_cnt += b.count
+                        fast_bytes += b.nbytes
+                        fast_pp = fast_pp or b.possibly_persisted
+                    else:
+                        expired.extend(b)
+            if fast_cnt:
+                any_expired = True
+                any_possibly_persisted = any_possibly_persisted or fast_pp
+                self._lane.acct(-fast_cnt, -fast_bytes)
+                if (self.idemp and fast_pp
+                        and self.conf.get("enable.gapless.guarantee")):
+                    # an expired SENT fast-lane batch leaves a sequence
+                    # gap, same as the Message path below
+                    self.set_fatal_error(KafkaError(
+                        Err._GAPLESS_GUARANTEE,
+                        f"{tp}: message timed out with "
+                        "enable.gapless.guarantee set"))
+            if expired:
+                any_expired = True
+                if any(m.status == MsgStatus.POSSIBLY_PERSISTED
+                       for m in expired):
+                    any_possibly_persisted = True
+                terr = KafkaError(Err._MSG_TIMED_OUT, "message timed out")
+                if self.idemp and self.conf.get("enable.gapless.guarantee"):
+                    # a timed-out message leaves a sequence gap: fatal
+                    # under gapless (reference _GAPLESS_GUARANTEE)
+                    terr = KafkaError(
+                        Err._GAPLESS_GUARANTEE,
+                        f"{tp}: message timed out with "
+                        "enable.gapless.guarantee set")
+                    self.set_fatal_error(terr)
+                self.dr_msgq(expired, terr)
+        if any_expired and self.idemp:
+            # ANY timed-out message leaves a sequence gap the broker will
+            # reject — even never-transmitted ones consumed msgids;
+            # recover via drain + epoch bump (reference:
+            # rdkafka_broker.c:3291-3309)
+            self.idemp.drain_epoch_bump("message(s) timed out")
+
+    # --------------------------------------------------------- stats emit --
+    def _emit_stats(self):
+        blob = self.stats.emit_json()
+        self.rep.push(Op(OpType.STATS, payload=blob))
+
+    # ------------------------------------------------- consumer fetch path --
+    def fetch_reply_handle(self, tp: Toppar, pres: dict, broker: Broker,
+                           batches: Optional[list] = None,
+                           fo: Optional[int] = None,
+                           ver: Optional[int] = None):
+        """Parse a fetch response partition into messages
+        (reference: rd_kafka_fetch_reply_handle → rd_kafka_msgset_parse,
+        rdkafka_msgset_reader.c:1410; aborted-txn filtering :1442-1560).
+
+        ``batches``: pre-processed v2 batches from the broker's batched
+        phase — [(info, records_bytes_DECOMPRESSED, last_offset)] with
+        CRCs already verified in ONE provider call across the whole
+        Fetch response (the consumer-side mirror of the producer's
+        batched codec seam). None falls back to inline per-batch work
+        (legacy v0/v1 messagesets, tests). A batch payload of None marks
+        a decompress failure — errored only if the batch would actually
+        be delivered (aborted/control batches are skipped unread).
+
+        ``fo``/``ver``: the (fetch_offset, version) snapshot the caller
+        took when it decided this response is current; all skip/parse
+        decisions use the snapshot so a concurrent seek() can't desync
+        them, and deliveries are stamped with ``ver`` so post-seek ops
+        get discarded by the consumer's staleness filter."""
+        if fo is None:
+            fo = tp.fetch_offset
+        if ver is None:
+            ver = tp.version
+        blob = pres["records"] or b""
+        if not blob:
+            if (self.conf.get("enable.partition.eof")
+                    and fo >= tp.hi_offset
+                    and tp.eof_reported_at != fo):
+                tp.eof_reported_at = fo
+                m = Message(tp.topic, partition=tp.partition)
+                m.offset = fo
+                m.error = KafkaError(Err._PARTITION_EOF, "partition EOF")
+                tp.fetchq.push(Op(OpType.FETCH, payload=(tp, [m], ver)))
+            return
+        check_crcs = self.conf.get("check.crcs")
+        read_committed = (self.conf.get("isolation.level") == "read_committed")
+        aborted_list = pres.get("aborted_transactions") or []
+        aborted = {a["producer_id"]: sorted(x["first_offset"]
+                   for x in aborted_list
+                   if x["producer_id"] == a["producer_id"])
+                   for a in aborted_list}
+        active_aborts: set[int] = set()
+        msgs: list[Message] = []
+        next_offset = fo
+        is_v2 = (len(blob) > proto.V2_OF_Magic and blob[proto.V2_OF_Magic] == 2)
+        if is_v2:
+            if batches is None:
+                # inline fallback path: per-batch CRC + decompress
+                batches = []
+                for info, payload, full in iter_batches(blob):
+                    last = info.base_offset + info.last_offset_delta
+                    if last >= fo:
+                        if check_crcs and not verify_crc_v2(info, full):
+                            self.op_err(KafkaError(
+                                Err._BAD_MSG,
+                                f"{tp}: CRC mismatch at offset "
+                                f"{info.base_offset}"))
+                            tp.fetch_backoff_until = time.monotonic() + 0.5
+                            return
+                        if info.codec:
+                            try:
+                                payload = self.codec_provider.decompress_many(
+                                    info.codec, [payload])[0]
+                            except Exception as e:
+                                self.op_err(KafkaError(
+                                    Err._BAD_COMPRESSION,
+                                    f"{tp}: decompress ({info.codec}): "
+                                    f"{e!r}"))
+                                tp.fetch_backoff_until = \
+                                    time.monotonic() + 0.5
+                                return
+                    batches.append((info, payload, last))
+            for info, payload, last in batches:
+                if last < fo:
+                    next_offset = max(next_offset, last + 1)
+                    continue
+                # aborted-txn bookkeeping
+                pid = info.producer_id
+                if read_committed and pid in aborted:
+                    while aborted[pid] and aborted[pid][0] <= info.base_offset:
+                        aborted[pid].pop(0)
+                        active_aborts.add(pid)
+                if info.is_control:
+                    # control record: key = [version i16, type i16]
+                    try:
+                        recs = (parse_records_v2(info, payload)
+                                if payload is not None else [])
+                        if recs and recs[0].key and len(recs[0].key) >= 4:
+                            ctype = int.from_bytes(recs[0].key[2:4], "big")
+                            if ctype == proto.CTRL_ABORT:
+                                active_aborts.discard(pid)
+                    except Exception:
+                        pass
+                    next_offset = last + 1
+                    continue
+                if (read_committed and info.is_transactional
+                        and pid in active_aborts):
+                    next_offset = last + 1
+                    continue
+                if payload is None:      # decompress failed (phase C)
+                    self.op_err(KafkaError(
+                        Err._BAD_COMPRESSION,
+                        f"{tp}: decompress ({info.codec}) failed at "
+                        f"offset {info.base_offset}"))
+                    tp.fetch_backoff_until = time.monotonic() + 0.5
+                    return
+                for r in parse_records_v2(info, payload):
+                    if r.offset < fo:
+                        continue
+                    m = Message(tp.topic, value=r.value, key=r.key,
+                                partition=tp.partition,
+                                headers=r.headers, timestamp=r.timestamp)
+                    m.offset = r.offset
+                    m.timestamp_type = r.timestamp_type
+                    msgs.append(m)
+                next_offset = last + 1
+        else:
+            dec = lambda codec, b: self.codec_provider.decompress_many(codec, [b])[0]
+            for r in parse_msgset_v01(blob, dec):
+                if r.offset < fo:
+                    continue
+                m = Message(tp.topic, value=r.value, key=r.key,
+                            partition=tp.partition, timestamp=r.timestamp)
+                m.offset = r.offset
+                msgs.append(m)
+                next_offset = max(next_offset, r.offset + 1)
+
+        if tp.version != ver:
+            return      # seek/rebalance raced this response: drop it
+        tp.fetch_offset = next_offset
+        tp.eof_reported_at = proto.OFFSET_INVALID
+        if self.interceptors:
+            for m in msgs:
+                self.interceptors.on_consume(m)
+        # accounting BEFORE the push: the app thread may drain the op
+        # (decrements clamp at 0) the instant it becomes visible
+        tp.fetchq_cnt += len(msgs)
+        tp.fetchq_bytes += sum(m.size for m in msgs)
+        if msgs:
+            # ONE op per parsed partition response (per-message op
+            # push/pop dominated the consume profile)
+            tp.fetchq.push(Op(OpType.FETCH, payload=(tp, msgs, ver)))
+        if self.stats:
+            self.stats.c_rx_msgs += len(msgs)
+
+    def offset_reset(self, tp: Toppar, reason: str):
+        """Apply auto.offset.reset (reference: rdkafka_offset.c
+        RD_KAFKA_OP_OFFSET_RESET path)."""
+        policy = self.topic_conf_for(tp.topic).get("auto.offset.reset")
+        if policy in ("smallest", "earliest", "beginning"):
+            tp.fetch_offset = proto.OFFSET_BEGINNING
+            tp.fetch_state = FetchState.OFFSET_QUERY
+        elif policy in ("largest", "latest", "end"):
+            tp.fetch_offset = proto.OFFSET_END
+            tp.fetch_state = FetchState.OFFSET_QUERY
+        else:
+            m = Message(tp.topic, partition=tp.partition)
+            m.error = KafkaError(Err._NO_OFFSET, reason)
+            tp.fetchq.push(Op(OpType.CONSUMER_ERR, payload=(tp, m, tp.version)))
+            tp.fetch_state = FetchState.STOPPED
+        self.dbg("fetch", f"{tp}: offset reset ({policy}): {reason}")
+
+    # -------------------------------------------------------------- close --
+    def close(self, timeout: float = 5.0):
+        if self.is_producer:
+            self.flush(timeout)
+        self.terminating = True
+        with self._brokers_lock:
+            brokers = list(self.brokers.values())
+        for b in brokers:
+            b.stop()
+        for b in brokers:
+            b.thread.join(timeout=2.0)
+        self._main.join(timeout=2.0)
+        if self.interceptors:
+            self.interceptors.on_destroy(self)
+        if self.mock_cluster:
+            self.mock_cluster.stop()
+        if self.offset_store is not None:
+            self.offset_store.close()
+        if self.background is not None:
+            self.background.stop()
+        if self.codec_worker is not None:
+            self.codec_worker.stop()
+
+    # ------------------------------------------------------- oauthbearer --
+    def set_oauthbearer_token(self, token: str, lifetime_ms: int = 0,
+                              principal: str = "") -> None:
+        """App-supplied OAUTHBEARER token (rd_kafka_oauthbearer_set_token).
+        A refresh is scheduled at 80% of the token lifetime, firing the
+        oauthbearer_token_refresh_cb again (the previous schedule is
+        replaced, so proactive re-sets don't accumulate timers)."""
+        expiry = (time.time() + lifetime_ms / 1000.0) if lifetime_ms else 0
+        self._oauth_token = (token, principal, expiry)
+        self._oauth_failure = None
+        if self._oauth_timer is not None:
+            self.timers.stop(self._oauth_timer)
+            self._oauth_timer = None
+        if lifetime_ms > 0 and self.conf.get("oauthbearer_token_refresh_cb"):
+            self._oauth_timer = self.timers.add(
+                max(1.0, lifetime_ms / 1000.0 * 0.8),
+                lambda: self._oauth_refresh_fire(force=True), once=True)
+
+    def set_oauthbearer_token_failure(self, errstr: str) -> None:
+        """(rd_kafka_oauthbearer_set_token_failure) — the failure stands
+        until the next refresh attempt, which clears it and retries."""
+        self._oauth_failure = errstr
+
+    def _oauth_refresh_fire(self, force: bool = False):
+        """Invoke the app's refresh cb. Serialized: concurrent broker
+        reconnects must not fan out duplicate token fetches (the
+        reference guarantees single-threaded cb invocation).
+        ``force`` is the proactive 80%-lifetime timer path — the token
+        is still fresh there by construction, that's the point."""
+        cb = self.conf.get("oauthbearer_token_refresh_cb")
+        if cb is None or self.terminating:
+            return
+        with self._oauth_cb_lock:
+            if not force and self._oauth_token_fresh():
+                return              # another thread already refreshed
+            self._oauth_failure = None    # each attempt starts clean
+            try:
+                cb(self, self.conf.get("sasl.oauthbearer.config"))
+            except Exception as e:
+                self._oauth_failure = repr(e)
+                self.log("ERROR", f"oauthbearer refresh cb raised: {e!r}")
+
+    def _oauth_token_fresh(self) -> bool:
+        t = self._oauth_token
+        if t is None:
+            return False
+        _tok, _principal, expiry = t
+        return not expiry or time.time() < expiry
+
+    def get_oauthbearer_token(self):
+        """Token for the SASL client: a fresh app-set token, else invoke
+        the refresh callback (which must call set_oauthbearer_token).
+        Returns the (token, principal, expiry) tuple or None — None with
+        a refresh cb configured is an authentication FAILURE, never an
+        unsecured-JWS fallback."""
+        if not self._oauth_token_fresh():
+            if self.conf.get("oauthbearer_token_refresh_cb") is not None:
+                self._oauth_refresh_fire()
+        if self._oauth_failure or not self._oauth_token_fresh():
+            return None
+        return self._oauth_token
+
+    # ----------------------------------------------------------- security --
+    def ssl_ctx(self):
+        """The per-instance TLS context, or None for plaintext
+        (reference: rk_conf.ssl.ctx built at rd_kafka_ssl_ctx_init)."""
+        return self._ssl_ctx
+
+    def connect_cb(self, host: str, port: int, timeout: float):
+        """Create the TCP connection for a broker. Honors the app's
+        ``connect_cb``/``socket_cb`` conf hooks — the seam the reference
+        exposes for sockem-style network shaping (rdkafka_conf.c
+        socket_cb/connect_cb; tests/sockem.c interposes here). Also
+        applies socket.* buffer/keepalive knobs and
+        broker.address.family resolution."""
+        cb = self.conf.get("connect_cb")
+        if cb is not None:
+            return cb(host, port, timeout)
+        fam_conf = self.conf.get("broker.address.family")
+        family = {"v4": socket.AF_INET, "v6": socket.AF_INET6}.get(
+            fam_conf, socket.AF_UNSPEC)
+        sock_cb = self.conf.get("socket_cb")
+        last_err = None
+        for af, stype, sproto, _, addr in self._resolve(host, port, family):
+            try:
+                s = (sock_cb(af, stype, sproto) if sock_cb is not None
+                     else socket.socket(af, stype, sproto))
+            except OSError as e:
+                last_err = e
+                continue
+            try:
+                sndbuf = self.conf.get("socket.send.buffer.bytes")
+                if sndbuf:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, sndbuf)
+                rcvbuf = self.conf.get("socket.receive.buffer.bytes")
+                if rcvbuf:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, rcvbuf)
+                if self.conf.get("socket.keepalive.enable"):
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                s.settimeout(timeout)
+                s.connect(addr)
+                return s
+            except OSError as e:
+                last_err = e
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        raise last_err or OSError(f"cannot resolve {host}:{port}")
+
+    def _resolve(self, host: str, port: int, family) -> list:
+        """getaddrinfo with a broker.address.ttl cache (reference:
+        rdaddr.c rd_sockaddr_list caching + rotation)."""
+        ttl = self.conf.get("broker.address.ttl") / 1000.0
+        key = (host, port, family)
+        now = time.monotonic()
+        hit = self._addr_cache.get(key)
+        if hit is not None and now < hit[0]:
+            return hit[1]
+        infos = socket.getaddrinfo(host, port, family, socket.SOCK_STREAM)
+        if ttl > 0:
+            self._addr_cache[key] = (now + ttl, infos)
+        return infos
+
+    # ---------------------------------------------------------------- SASL --
+    def sasl_required(self) -> bool:
+        return self.conf.get("security.protocol") in ("sasl_plaintext",
+                                                      "sasl_ssl")
+
+    def sasl_start(self, broker: Broker):
+        from .sasl import sasl_client_start
+        sasl_client_start(self, broker)

@@ -1,0 +1,88 @@
+"""Message objects and partitioners (reference: src/rdkafka_msg.c).
+
+``Message`` is the app-visible object (rd_kafka_message_t analog) carrying
+payload/key/headers/offset/timestamp/error plus the internal delivery
+state used by the idempotent producer (persistence status, msgid,
+retries). Partitioners mirror the reference set (rdkafka_msg.c:797-869):
+random, consistent, consistent_random, murmur2, murmur2_random.
+"""
+from __future__ import annotations
+
+import enum
+import random
+import time
+from typing import Optional, Sequence
+
+from ..protocol import proto
+from ..utils.hash import consistent_partition, murmur2_partition
+from .errors import Err, KafkaError
+
+PARTITION_UA = -1  # unassigned: partitioner decides
+
+
+class MsgStatus(enum.Enum):
+    """Delivery status (rd_kafka_msg_status_t): drives idempotent retry
+    safety — POSSIBLY_PERSISTED messages may not be retried blindly."""
+    NOT_PERSISTED = 0
+    POSSIBLY_PERSISTED = 1
+    PERSISTED = 2
+
+
+class Message:
+    __slots__ = ("topic", "partition", "key", "value", "headers", "offset",
+                 "timestamp", "timestamp_type", "error", "opaque", "msgid",
+                 "retries", "status", "enq_time", "ts_backoff", "latency_us",
+                 "on_delivery",
+                 "size")
+
+    def __init__(self, topic: str, value: Optional[bytes] = None,
+                 key: Optional[bytes] = None,
+                 headers: Sequence[tuple[str, Optional[bytes]]] = (),
+                 partition: int = PARTITION_UA, timestamp: int = 0,
+                 opaque=None):
+        self.topic = topic
+        self.partition = partition
+        self.key = key
+        self.value = value
+        self.headers = list(headers) if headers else []
+        self.offset = proto.OFFSET_INVALID
+        self.timestamp = timestamp or int(time.time() * 1000)
+        self.timestamp_type = proto.TSTYPE_CREATE_TIME
+        self.error: Optional[KafkaError] = None
+        self.opaque = opaque
+        self.msgid = 0            # producer-assigned FIFO id (idempotence)
+        self.retries = 0
+        self.status = MsgStatus.NOT_PERSISTED
+        self.enq_time = time.monotonic()
+        self.ts_backoff = 0.0
+        self.latency_us = 0
+        self.on_delivery = None       # per-message DR callback
+        self.size = (len(value) if value else 0) + (len(key) if key else 0)
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __repr__(self):
+        return (f"Message({self.topic}[{self.partition}]@{self.offset}"
+                f"{' err=' + self.error.code.name if self.error else ''})")
+
+
+def partition_random(key, cnt, rnd=random.random):
+    return int(rnd() * cnt) % cnt
+
+
+def partitioner_fn(name: str):
+    """Resolve a partitioner by config name; returns f(key, cnt) -> int."""
+    if name == "random":
+        return lambda key, cnt: partition_random(key, cnt)
+    if name == "consistent":
+        return lambda key, cnt: consistent_partition(key or b"", cnt)
+    if name == "consistent_random":
+        return lambda key, cnt: (consistent_partition(key, cnt) if key
+                                 else partition_random(key, cnt))
+    if name == "murmur2":
+        return lambda key, cnt: murmur2_partition(key or b"", cnt)
+    if name == "murmur2_random":
+        return lambda key, cnt: (murmur2_partition(key, cnt) if key
+                                 else partition_random(key, cnt))
+    raise ValueError(f"unknown partitioner {name!r}")

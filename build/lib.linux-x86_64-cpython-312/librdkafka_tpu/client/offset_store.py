@@ -1,0 +1,119 @@
+"""Legacy local file offset store (reference: src/rdkafka_offset.c:98-330).
+
+``offset.store.method=file`` (topic conf, deprecated in the reference
+but part of the surface): committed offsets are persisted to local text
+files instead of the broker. Per toppar, the file is
+``<offset.store.path>/<topic>-<partition>.offset`` when the path is a
+directory (the reference's layout), else the configured path itself.
+``offset.store.sync.interval.ms`` controls fsync: -1 never, 0 after
+every write, >0 at most once per interval (reference rdkafka_offset.c:46
+syncs from the main thread on that timer).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from .kafka import Kafka
+
+
+class _OffsetFile:
+    __slots__ = ("path", "fd", "last_sync", "dirty")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.fd: Optional[int] = None
+        self.last_sync = 0.0
+        self.dirty = False
+
+    def open(self):
+        if self.fd is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self.fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+
+    def read(self) -> Optional[int]:
+        self.open()
+        os.lseek(self.fd, 0, os.SEEK_SET)
+        data = os.read(self.fd, 64).strip()
+        if not data:
+            return None
+        try:
+            return int(data)
+        except ValueError:
+            return None
+
+    def write(self, offset: int, sync_interval_ms: int):
+        self.open()
+        payload = b"%d\n" % offset
+        os.lseek(self.fd, 0, os.SEEK_SET)
+        os.write(self.fd, payload)
+        os.ftruncate(self.fd, len(payload))
+        self.dirty = True
+        now = time.monotonic()
+        if sync_interval_ms == 0 or (
+                sync_interval_ms > 0
+                and now - self.last_sync >= sync_interval_ms / 1000.0):
+            os.fsync(self.fd)
+            self.last_sync = now
+            self.dirty = False
+
+    def close(self):
+        if self.fd is not None:
+            if self.dirty:
+                try:
+                    os.fsync(self.fd)
+                except OSError:
+                    pass
+            os.close(self.fd)
+            self.fd = None
+
+
+class FileOffsetStore:
+    """All file-backed offsets for one client instance."""
+
+    def __init__(self, rk: "Kafka"):
+        self.rk = rk
+        self._files: dict[tuple[str, int], _OffsetFile] = {}
+        self._lock = threading.Lock()
+
+    def _file(self, topic: str, partition: int) -> _OffsetFile:
+        key = (topic, partition)
+        with self._lock:
+            f = self._files.get(key)
+            if f is None:
+                base = self.rk.topic_conf_for(topic).get("offset.store.path")
+                if os.path.isdir(base) or base.endswith(os.sep) or base == ".":
+                    path = os.path.join(base, f"{topic}-{partition}.offset")
+                else:
+                    path = base
+                f = _OffsetFile(path)
+                self._files[key] = f
+            return f
+
+    def uses_file(self, topic: str) -> bool:
+        return (self.rk.topic_conf_for(topic).get("offset.store.method")
+                == "file")
+
+    def read(self, topic: str, partition: int) -> Optional[int]:
+        try:
+            return self._file(topic, partition).read()
+        except OSError:
+            return None
+
+    def commit_all(self, offsets: dict) -> None:
+        """Write {(topic, partition): offset} to their files."""
+        for (t, p), off in offsets.items():
+            ival = self.rk.topic_conf_for(t).get(
+                "offset.store.sync.interval.ms")
+            self._file(t, p).write(off, ival)
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                f.close()
+            self._files.clear()

@@ -1,0 +1,70 @@
+"""Public Producer API (reference: rd_kafka_producev / rd_kafka_produce,
+src/rdkafka_msg.c:241-478, plus flush/purge from rdkafka.c)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from .conf import Conf
+from .kafka import Kafka, PRODUCER
+from .msg import PARTITION_UA
+
+
+class Producer:
+    """
+    >>> p = Producer({"bootstrap.servers": "...", "linger.ms": 5})
+    >>> p.produce("topic", b"value", key=b"k", on_delivery=cb)
+    >>> p.flush()
+    """
+
+    def __init__(self, conf):
+        if isinstance(conf, dict):
+            c = Conf()
+            dr = conf.pop("on_delivery", None)
+            c.update(conf)
+            if dr:
+                c.set("dr_msg_cb", dr)
+            conf = c
+        self._rk = Kafka(conf, PRODUCER)
+        # bound-method alias: produce() goes straight to the client hot
+        # path (str encoding + on_delivery handled there)
+        self.produce = self._rk.produce
+
+    def produce_batch(self, topic: str, msgs: list[dict],
+                      partition: int = PARTITION_UA) -> int:
+        """Batch produce (reference: rd_kafka_produce_batch,
+        rdkafka_msg.c:478). Returns number enqueued."""
+        n = 0
+        for m in msgs:
+            try:
+                self.produce(topic, value=m.get("value"), key=m.get("key"),
+                             partition=m.get("partition", partition),
+                             headers=m.get("headers", ()),
+                             timestamp=m.get("timestamp", 0))
+                n += 1
+            except Exception:
+                pass
+        return n
+
+    def poll(self, timeout: float = 0.0) -> int:
+        return self._rk.poll(timeout)
+
+    def flush(self, timeout: float = 10.0) -> int:
+        return self._rk.flush(timeout)
+
+    def purge(self, in_queue: bool = True, in_flight: bool = False) -> None:
+        self._rk.purge(in_queue, in_flight)
+
+    def __len__(self) -> int:
+        # rd_kafka_outq_len semantics: unacked messages PLUS undelivered
+        # delivery-report ops (rdkafka.c:3905) — the documented
+        # `while len(p): p.poll(...)` drain pattern must not exit while
+        # DR callbacks are still queued
+        return self._rk.outq_len
+
+    def close(self, timeout: float = 5.0):
+        self._rk.close(timeout)
+
+    # escape hatch for tests / advanced use
+    @property
+    def rk(self) -> Kafka:
+        return self._rk

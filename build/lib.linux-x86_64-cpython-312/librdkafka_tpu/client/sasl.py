@@ -298,30 +298,17 @@ class GssapiClient:
 
     def __init__(self, rk, broker_host: str, ctx_factory=None):
         service = rk.conf.get("sasl.kerberos.service.name")
-        # RFC 4752 authzid stays EMPTY (authorize as the authenticated
-        # principal) — the reference's cyrus provider does the same; a
-        # non-empty authzid that differs from the Kerberos principal is
-        # rejected by the broker's authorize check.
-        self.authzid = ""
-        # sasl.kerberos.principal selects which cached credential to
-        # initiate with (the reference uses it for kinit); empty/default
-        # "kafkaclient" means the ccache default.
-        principal = rk.conf.get("sasl.kerberos.principal")
+        self.authzid = rk.conf.get("sasl.kerberos.principal") or ""
         if ctx_factory is None:
             if not gssapi_available():
                 raise KafkaException(
                     Err._UNSUPPORTED_FEATURE,
                     "GSSAPI requires the python-gssapi package")
             import gssapi
-            creds = None
-            if principal and principal != "kafkaclient":
-                creds = gssapi.Credentials(
-                    name=gssapi.Name(principal), usage="initiate")
             name = gssapi.Name(
                 f"{service}@{broker_host}",
                 name_type=gssapi.NameType.hostbased_service)
-            self.ctx = gssapi.SecurityContext(name=name, creds=creds,
-                                              usage="initiate")
+            self.ctx = gssapi.SecurityContext(name=name, usage="initiate")
         else:
             self.ctx = ctx_factory(service, broker_host)
         self._ssf_done = False

@@ -1,0 +1,123 @@
+"""Statistics: windowed averages with percentiles + the JSON stats blob.
+
+Reference: rd_avg_t (src/rdavg.h) over HdrHistogram (rdhdrhistogram.c),
+emitted by rd_kafka_stats_emit_all (rdkafka.c:1473-1700) every
+statistics.interval.ms with the schema documented in STATISTICS.md.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import TYPE_CHECKING
+
+from ..utils.hdrhistogram import HdrHistogram
+
+if TYPE_CHECKING:
+    from .kafka import Kafka
+
+
+class Avg:
+    """Windowed HdrHistogram with rollover (reference: rd_avg_t,
+    rdavg.h:37-165 — values accumulate into the current window; the
+    stats emitter rolls the window over and renders min/avg/max +
+    p50..p99.99, rdkafka.c:1582-1630). O(1) record, constant memory."""
+
+    __slots__ = ("_hist", "_lock")
+
+    #: STATISTICS.md percentile fields
+    PCTS = ((50, "p50"), (75, "p75"), (90, "p90"), (95, "p95"),
+            (99, "p99"), (99.99, "p99_99"))
+
+    def __init__(self, lowest: int = 1, highest: int = 60_000_000,
+                 sigfigs: int = 3):
+        self._hist = HdrHistogram(lowest, highest, sigfigs)
+        self._lock = threading.Lock()
+
+    def add(self, v: float):
+        with self._lock:
+            self._hist.record(int(v))
+
+    def rollover(self) -> dict:
+        with self._lock:
+            h = self._hist
+            vals, stddev = h.snapshot([p for p, _ in self.PCTS])
+            out = {"min": h.min_v, "max": h.max_v,
+                   "avg": int(h.mean()), "sum": h.sum_v, "cnt": h.total,
+                   "stddev": int(stddev),
+                   "hdrsize": h.memsize,
+                   "outofrange": h.out_of_range}
+            for (pct, name), v in zip(self.PCTS, vals):
+                out[name] = v
+            h.reset()
+        return out
+
+
+class StatsCollector:
+    """Aggregates counters from the client and renders the stats JSON."""
+
+    def __init__(self, rk: "Kafka"):
+        self.rk = rk
+        self.ts_start = time.time()
+        self.c_tx_msgs = 0
+        self.c_rx_msgs = 0
+        self.int_latency = Avg()      # produce() -> MessageSet write
+        self.codec_latency = Avg()    # batched codec provider call
+
+    def emit_json(self) -> str:
+        rk = self.rk
+        brokers = {}
+        for b in list(rk.brokers.values()):
+            brokers[b.name] = {
+                "name": b.name, "nodeid": b.nodeid, "state": b.state.value,
+                "tx": b.c_tx, "txbytes": b.c_tx_bytes,
+                "rx": b.c_rx, "rxbytes": b.c_rx_bytes,
+                "req_timeouts": b.c_req_timeouts,
+                # latency decomposition (STATISTICS.md broker window stats)
+                "rtt": b.rtt_avg.rollover(),
+                "outbuf_latency": b.outbuf_avg.rollover(),
+                "throttle": b.throttle_avg.rollover(),
+                "toppars": {f"{tp.topic}-{tp.partition}":
+                            {"topic": tp.topic, "partition": tp.partition}
+                            for tp in list(b.toppars)},
+            }
+        topics = {}
+        for (t, p), tp in list(rk._toppars.items()):
+            topics.setdefault(t, {"topic": t, "partitions": {}})
+            topics[t]["partitions"][str(p)] = {
+                "partition": p, "leader": tp.leader_id,
+                "msgq_cnt": (len(tp.msgq)
+                             + (len(tp.arena) if tp.arena is not None
+                                else 0)),
+                "xmit_msgq_cnt": len(tp.xmit_msgq),
+                "fetchq_cnt": tp.fetchq_cnt,
+                "fetch_state": tp.fetch_state.value,
+                "app_offset": tp.app_offset,
+                "stored_offset": tp.stored_offset,
+                "committed_offset": tp.committed_offset,
+                "hi_offset": tp.hi_offset,
+            }
+        blob = {
+            "name": rk.conf.get("client.id"),
+            "client_id": rk.conf.get("client.id"),
+            "type": rk.type,
+            "ts": int(time.time() * 1e6),
+            "time": int(time.time()),
+            "age": int((time.time() - self.ts_start) * 1e6),
+            "msg_cnt": rk.msg_cnt,
+            "msg_max": rk.conf.get("queue.buffering.max.messages"),
+            "txmsgs": self.c_tx_msgs, "rxmsgs": self.c_rx_msgs,
+            "int_latency": self.int_latency.rollover(),
+            "codec_latency": self.codec_latency.rollover(),
+            "brokers": brokers,
+            "topics": topics,
+        }
+        if rk.cgrp is not None:
+            blob["cgrp"] = {"state": rk.cgrp.join_state,
+                            "rebalance_cnt": rk.cgrp.rebalance_cnt,
+                            "assignment_size": len(rk.cgrp.assignment)}
+        if rk.idemp is not None:
+            blob["eos"] = {"idemp_state": rk.idemp.state,
+                           "producer_id": rk.idemp.pid,
+                           "producer_epoch": rk.idemp.epoch}
+        return json.dumps(blob)

@@ -1,0 +1,131 @@
+"""TLS transport support (reference: src/rdkafka_ssl.c, src/rdkafka_cert.c).
+
+The reference builds one OpenSSL ``SSL_CTX`` per client instance at
+``rd_kafka_ssl_ctx_init`` (rdkafka_ssl.c:~1100) from the ``ssl.*``
+configuration properties, loading CA bundles, client cert/key pairs and
+PKCS#12 keystores (rdkafka_cert.c:~200), then drives the per-connection
+handshake from the transport poll loop (rdkafka_transport.c:612-719).
+
+This module is the TPU-rebuild equivalent: ``make_client_ctx(conf)``
+constructs a single :class:`ssl.SSLContext` per client from the same
+property names; the broker thread drives the non-blocking handshake in
+its connection FSM (client/broker.py, state CONNECT).
+"""
+from __future__ import annotations
+
+import os
+import ssl
+import tempfile
+from typing import Optional
+
+from .errors import Err, KafkaError, KafkaException
+
+
+def uses_ssl(conf) -> bool:
+    return conf.get("security.protocol") in ("ssl", "sasl_ssl")
+
+
+def make_client_ctx(conf) -> Optional[ssl.SSLContext]:
+    """Build the client SSLContext from ``ssl.*`` conf properties.
+
+    Maps the reference's property semantics (rdkafka_conf.c ssl section):
+      - ssl.ca.location: CA bundle file or directory; default = system CAs
+      - ssl.certificate.location / ssl.key.location / ssl.key.password:
+        client cert+key PEM pair
+      - ssl.keystore.location / ssl.keystore.password: PKCS#12 keystore
+        holding the client key+cert (rdkafka_cert.c PKCS12 path)
+      - ssl.cipher.suites: OpenSSL cipher list
+      - enable.ssl.certificate.verification: peer verification on/off
+      - ssl.endpoint.identification.algorithm: "https" = hostname check
+    """
+    if not uses_ssl(conf):
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+
+    verify = conf.get("enable.ssl.certificate.verification")
+    algo = conf.get("ssl.endpoint.identification.algorithm")
+    # check_hostname must be disabled before verify_mode can be relaxed
+    ctx.check_hostname = bool(verify) and algo == "https"
+    ctx.verify_mode = ssl.CERT_REQUIRED if verify else ssl.CERT_NONE
+
+    ca = conf.get("ssl.ca.location")
+    if ca:
+        try:
+            if os.path.isdir(ca):
+                ctx.load_verify_locations(capath=ca)
+            else:
+                ctx.load_verify_locations(cafile=ca)
+        except (ssl.SSLError, OSError) as e:
+            raise KafkaException(Err._SSL, f"ssl.ca.location {ca!r}: {e}")
+    elif verify:
+        ctx.load_default_certs(ssl.Purpose.SERVER_AUTH)
+
+    cert = conf.get("ssl.certificate.location")
+    key = conf.get("ssl.key.location")
+    if cert:
+        try:
+            ctx.load_cert_chain(cert, keyfile=key or None,
+                                password=conf.get("ssl.key.password") or None)
+        except (ssl.SSLError, OSError) as e:
+            raise KafkaException(Err._SSL, f"client certificate: {e}")
+
+    ks = conf.get("ssl.keystore.location")
+    if ks:
+        _load_pkcs12(ctx, ks, conf.get("ssl.keystore.password"))
+
+    ciphers = conf.get("ssl.cipher.suites")
+    if ciphers:
+        try:
+            ctx.set_ciphers(ciphers)
+        except ssl.SSLError as e:
+            raise KafkaException(Err._SSL, f"ssl.cipher.suites: {e}")
+    return ctx
+
+
+def _load_pkcs12(ctx: ssl.SSLContext, path: str, password: str) -> None:
+    """PKCS#12 keystore → client cert chain (rdkafka_cert.c PKCS12 load).
+
+    Python's ssl module cannot ingest PKCS#12 directly; decode with
+    `cryptography` and hand the PEM material to the context through a
+    transient file (deleted immediately after load).
+    """
+    try:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, NoEncryption, PrivateFormat, pkcs12)
+    except ImportError:
+        raise KafkaException(Err._SSL,
+                         "ssl.keystore.location requires the 'cryptography' "
+                         "package for PKCS#12 decoding")
+    try:
+        blob = open(path, "rb").read()
+        pw = password.encode() if password else None
+        pkey, pcert, extra = pkcs12.load_key_and_certificates(blob, pw)
+    except Exception as e:
+        raise KafkaException(Err._SSL, f"ssl.keystore.location {path!r}: {e}")
+    pem = b""
+    if pkey is not None:
+        pem += pkey.private_bytes(Encoding.PEM, PrivateFormat.PKCS8,
+                                  NoEncryption())
+    if pcert is not None:
+        pem += pcert.public_bytes(Encoding.PEM)
+    for c in extra or []:
+        pem += c.public_bytes(Encoding.PEM)
+    fd, tmp = tempfile.mkstemp(suffix=".pem")
+    try:
+        os.write(fd, pem)
+        os.close(fd)
+        ctx.load_cert_chain(tmp)
+    finally:
+        os.unlink(tmp)
+
+
+def make_server_ctx(certfile: str, keyfile: str, cafile: str = None,
+                    require_client_cert: bool = False) -> ssl.SSLContext:
+    """Server-side context for the mock cluster's TLS listener mode."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    if cafile:
+        ctx.load_verify_locations(cafile)
+    if require_client_cert:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

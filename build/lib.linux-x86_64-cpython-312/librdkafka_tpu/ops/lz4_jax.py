@@ -1,0 +1,209 @@
+"""LZ4 block encoder on TPU — bit-exact with the deterministic TPU-greedy
+spec shared with ops/native/codec.cpp (tk_lz4_block_compress).
+
+The reference compresses each MessageSet sequentially on the broker thread
+(rdkafka_msgset_writer.c:1090 → vendored lz4.c). Its hash-chain match
+search is a serial data dependence — useless on a systolic/vector machine.
+The TPU-greedy spec was designed so the SAME wire bytes fall out of a
+fully data-parallel formulation:
+
+  * Insert-all rule: every position 0..P enters the hash table exactly once,
+    in order, regardless of the parse. Hence
+        candidate[p] = max { q < p : HASH(src[q:q+4]) == HASH(src[p:p+4]) }
+    is parse-independent and computable for ALL positions at once with ONE
+    stable argsort by hash (predecessor within equal-hash runs).
+  * Match lengths: blocked longest-common-extension — compare 16-byte
+    gathers per round, ≤ ceil(273/16)+1 rounds, all positions in parallel.
+  * Greedy parse (p jumps by mlen on match, +1 otherwise) is a successor
+    graph; the visited set is computed by pointer doubling in log2(N)
+    scatter/gather rounds.
+  * Token stream: per-sequence byte counts → exclusive scan for output
+    offsets → every output byte is computed independently by binary-
+    searching its sequence (searchsorted) and evaluating a closed-form
+    (token | extension-run | literal gather | offset | match-extension).
+
+Everything is static-shape, sort/scan/gather — XLA-friendly; batches of
+blocks are vmapped on the leading axis (the per-toppar batch axis of
+SURVEY.md §3.2).
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .packing import next_pow2, pad_right
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+HASH_BITS = 12
+MAXMATCH = 273
+MINMATCH = 4
+
+
+def _bound(n: int) -> int:
+    return n + n // 255 + 16
+
+
+def _extlen(L):
+    """Number of length-extension bytes for a literal/match run field."""
+    return jnp.where(L >= 15, (L - 15) // 255 + 1, 0)
+
+
+def _lz4_block_one(data, n, N: int):
+    """Compress one (N,)-uint8 buffer of true length n. → ((C,) uint8, len)."""
+    C = _bound(N)
+    D = N + 2                       # dense sequence-table size (+pseudo, +junk)
+    pos = jnp.arange(N, dtype=I32)
+    n = n.astype(I32)
+
+    # --- 4-byte little-endian values and hashes at every position --------
+    def at(off):
+        return data[jnp.clip(pos + off, 0, N - 1)].astype(U32)
+
+    val = at(0) | (at(1) << 8) | (at(2) << 16) | (at(3) << 24)
+    h = (val * U32(2654435761)) >> U32(32 - HASH_BITS)
+
+    # --- candidate[p]: predecessor with equal hash --------------------
+    # one single-array sort of unique composite keys (hash<<17 | pos)
+    # reproduces the stable (hash, pos) order at a fraction of the
+    # argsort/pair-sort compile cost (the 64K sort dominated the 35 s
+    # XLA compile of the original formulation)
+    assert N <= (1 << 17)
+    key = (h.astype(I32) << 17) | pos
+    skey = jax.lax.sort(key)
+    order = skey & ((1 << 17) - 1)
+    h_sorted = skey >> 17
+    prev_pos = jnp.concatenate([jnp.full((1,), -1, I32), order[:-1]])
+    same = jnp.concatenate([jnp.zeros((1,), bool), h_sorted[1:] == h_sorted[:-1]])
+    cand_sorted = jnp.where(same, prev_pos, -1)
+    cand = jnp.zeros((N,), I32).at[order].set(cand_sorted)
+
+    valid = ((cand >= 0) & (pos - cand <= 65535)
+             & (val[jnp.clip(cand, 0, N - 1)] == val)
+             & (pos + 12 <= n))
+
+    # --- match lengths: blocked LCE, 16 bytes per round ------------------
+    mmax = jnp.minimum(MAXMATCH, n - 5 - pos)
+    k16 = jnp.arange(16, dtype=I32)
+
+    def g16(base):
+        return data[jnp.clip(base[:, None] + k16[None, :], 0, N - 1)]
+
+    def lce_cond(st):
+        return jnp.any(st[1])
+
+    def lce_body(st):
+        mlen, active = st
+        neq = g16(cand + mlen) != g16(pos + mlen)
+        run = jnp.where(neq.any(1), jnp.argmax(neq, 1).astype(I32), I32(16))
+        add = jnp.where(active, jnp.minimum(run, mmax - mlen), 0)
+        mlen = mlen + add
+        active = active & (run == 16) & (mlen < mmax)
+        return mlen, active
+
+    mlen0 = jnp.where(valid, I32(MINMATCH), I32(0))
+    mlen, _ = jax.lax.while_loop(lce_cond, lce_body,
+                                 (mlen0, valid & (mlen0 < mmax)))
+
+    # --- greedy parse via pointer doubling -------------------------------
+    # fori_loop keeps the graph one-round-sized (the unrolled version
+    # cost ~35 s of XLA compile for N=64K)
+    sink = I32(N + 1)
+    nxt = jnp.where(valid, pos + mlen, pos + 1)
+    jump = jnp.where(pos + 12 <= n, jnp.minimum(nxt, sink), sink)
+    J0 = jnp.concatenate([jump, jnp.full((2,), sink, I32)])    # (N+2,)
+    on0 = jnp.zeros((N + 2,), bool).at[0].set(True)
+
+    def pd_round(_, st):
+        on, J = st
+        on = on.at[jnp.where(on, J, sink)].set(True)
+        return on, J[J]
+
+    rounds = int(np.ceil(np.log2(N + 2))) + 1
+    on, _ = jax.lax.fori_loop(0, rounds, pd_round, (on0, J0))
+    match_here = on[:N] & valid
+
+    # --- anchors (end of previous match) and literal runs ----------------
+    mend = jnp.where(match_here, pos + mlen, 0)
+    cm = jax.lax.cummax(mend)
+    anchor = jnp.concatenate([jnp.zeros((1,), I32), cm[:-1]])
+    lit = pos - anchor
+    final_anchor = cm[-1]
+    final_lit = n - final_anchor
+
+    # --- per-sequence output sizes and offsets ---------------------------
+    el = _extlen(lit)
+    em = _extlen(mlen - MINMATCH)
+    sz = jnp.where(match_here, 1 + el + lit + 2 + em, 0)
+    csum = jnp.cumsum(sz)
+    out_off = csum - sz                 # exclusive
+    total_seq = csum[-1]
+    S = jnp.sum(match_here.astype(I32))
+    efl = jnp.where(final_lit >= 15, (final_lit - 15) // 255 + 1, 0)
+    total_out = total_seq + 1 + efl + final_lit
+
+    # --- compact sequences into dense tables (+ pseudo-seq for final run)
+    # one fused scatter builds all five tables (separate scatters were a
+    # large share of the XLA compile budget)
+    di = jnp.where(match_here, jnp.cumsum(match_here.astype(I32)) - 1, D - 1)
+    junks = jnp.array([[int(C + 1)], [0], [0], [MINMATCH], [0]], I32)
+    vals = jnp.stack([out_off, lit, anchor, mlen, pos - cand])     # (5, N)
+    TBL = jnp.broadcast_to(junks, (5, D)).at[:, di].set(vals)
+    TBL = TBL.at[:, D - 1].set(junks[:, 0])
+    TBL = TBL.at[:3, S].set(jnp.stack([total_seq, final_lit, final_anchor]))
+    # searchsorted needs OOF non-decreasing: real entries strictly increase,
+    # pseudo = total_seq, padding = C+1.
+    OOF = TBL[0]
+
+    # --- materialize every output byte in parallel -----------------------
+    j = jnp.arange(C, dtype=I32)
+    i = jnp.searchsorted(OOF, j, side="right").astype(I32) - 1
+    i = jnp.clip(i, 0, D - 1)
+    G = TBL[:, i]                                                  # (5, C)
+    r = j - G[0]
+    L = G[1]
+    elq = _extlen(L)
+    A = G[2]
+    M = G[3] - MINMATCH
+    emq = _extlen(M)
+    hasm = i < S
+    token = (jnp.minimum(L, 15) << 4) | jnp.where(hasm, jnp.minimum(M, 15), 0)
+    off = G[4]
+    lit_start = 1 + elq
+    lit_end = lit_start + L
+    litb = data[jnp.clip(A + r - lit_start, 0, N - 1)].astype(I32)
+
+    mk = r - lit_end - 1                # 1-based index into match-ext run
+    byte = jnp.where(mk < emq, 255, (M - 15) % 255)
+    byte = jnp.where(r == lit_end + 1, off >> 8, byte)
+    byte = jnp.where(r == lit_end, off & 0xFF, byte)
+    byte = jnp.where((r >= lit_start) & (r < lit_end), litb, byte)
+    byte = jnp.where((r >= 1) & (r <= elq),
+                     jnp.where(r < elq, 255, (L - 15) % 255), byte)
+    byte = jnp.where(r == 0, token, byte)
+    byte = jnp.where(j < total_out, byte, 0)
+    return byte.astype(jnp.uint8), total_out
+
+
+@lru_cache(maxsize=8)
+def _jit_for(N: int):
+    fn = jax.vmap(lambda d, n: _lz4_block_one(d, n, N))
+    return jax.jit(fn)
+
+
+
+
+def lz4_block_compress_many(blocks: list[bytes]) -> list[bytes]:
+    """Compress many ≤64KB blocks in one vmapped device launch."""
+    if not blocks:
+        return []
+    N = next_pow2(max(len(b) for b in blocks))
+    data, lens = pad_right(blocks, N)
+    out, olens = _jit_for(N)(data, lens)
+    out = np.asarray(out)
+    olens = np.asarray(olens)
+    return [out[i, :olens[i]].tobytes() for i in range(len(blocks))]

@@ -32,27 +32,12 @@ def _compile(src: str, so: str, extra: list[str]) -> str:
     return so
 
 
-def _loadable(so: str) -> bool:
-    """A shipped .so can be foreign (sdist built on another arch/libc);
-    trust it only if ctypes can actually load it."""
-    import ctypes
-    try:
-        ctypes.CDLL(so)
-        return True
-    except OSError:
-        return False
-
-
 def build(force: bool = False) -> str:
     """Compile codec.cpp to a shared library if stale; returns the .so path."""
     with _lock:
         if force and os.path.exists(SO):
             os.remove(SO)
-        so = _compile(SRC, SO, ["-fvisibility=hidden"])
-        if not _loadable(so):
-            os.remove(so)               # wrong-platform prebuilt: rebuild
-            so = _compile(SRC, SO, ["-fvisibility=hidden"])
-        return so
+        return _compile(SRC, SO, ["-fvisibility=hidden"])
 
 
 def build_enqlane(force: bool = False) -> str:
@@ -65,19 +50,13 @@ def build_enqlane(force: bool = False) -> str:
 
 
 def load_enqlane():
-    """Import the tk_enqlane extension module (building if stale). A
-    shipped wrong-platform binary gets one rebuild before giving up."""
+    """Import the tk_enqlane extension module (building if stale)."""
     import importlib.machinery
     import importlib.util
 
-    def _load(path):
-        loader = importlib.machinery.ExtensionFileLoader("tk_enqlane", path)
-        spec = importlib.util.spec_from_loader("tk_enqlane", loader)
-        mod = importlib.util.module_from_spec(spec)
-        loader.exec_module(mod)
-        return mod
-
-    try:
-        return _load(build_enqlane())
-    except ImportError:
-        return _load(build_enqlane(force=True))
+    path = build_enqlane()
+    loader = importlib.machinery.ExtensionFileLoader("tk_enqlane", path)
+    spec = importlib.util.spec_from_loader("tk_enqlane", loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod

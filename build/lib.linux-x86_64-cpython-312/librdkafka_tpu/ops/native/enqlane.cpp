@@ -1,0 +1,616 @@
+// Native produce() enqueue lane — the GIL-ceiling fix.
+//
+// The reference's produce hot path (rd_kafka_toppar_enq_msg called from
+// rd_kafka_producev, rdkafka_msg.c:299/rdkafka_broker.c:3242) does zero
+// allocations per record: payloads land in preallocated queues and the
+// msgset writer walks them.  The Python client paid ~7 µs/message on the
+// app thread building a Message object and deque-appending it, then the
+// broker thread paid again iterating those objects to feed the native
+// framer (tk_frame_v2, codec.cpp:468).
+//
+// This module is a CPython extension (not ctypes — per-call overhead
+// matters at ~1 µs/record): an Arena is a per-toppar growable byte
+// buffer + per-record (klen, vlen, enq_us) arrays.  produce() appends
+// key/value straight into it in ONE C call; the broker thread take()s a
+// contiguous run — base bytes + length arrays — that tk_frame_v2
+// consumes directly with no per-record Python work on either side.
+// Record timestamps are the batch build time (fast-lane messages carry
+// timestamp=0, i.e. "now"), so no per-record wall clock is stored; the
+// monotonic enq_us feeds message.timeout.ms and latency stats.
+//
+// Thread contract: every method holds the GIL for its entire (short)
+// duration — the GIL is the lock, exactly like the Python deques it
+// replaces.  App thread appends; broker thread takes; main thread
+// expires/clears.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <structmember.h>
+
+#include <stdint.h>
+#include <string.h>
+#include <time.h>
+
+static inline int64_t now_us(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000 + ts.tv_nsec / 1000;
+}
+
+typedef struct {
+    PyObject_HEAD
+    uint8_t *buf;        // concatenated key||value payload bytes
+    int64_t cap, len;
+    int32_t *klens;      // -1 = null key
+    int32_t *vlens;      // -1 = null value
+    int64_t *enq;        // CLOCK_MONOTONIC µs at append
+    int64_t *boff;       // boff[i] = payload offset of record i; boff[count] = len
+    int32_t count, rcap;
+    int32_t start;       // first un-taken record (partial takes)
+} Arena;
+
+static int arena_grow_buf(Arena *a, int64_t need) {
+    if (a->len + need <= a->cap) return 0;
+    int64_t ncap = a->cap ? a->cap : 1 << 16;
+    while (a->len + need > ncap) ncap *= 2;
+    uint8_t *nb = (uint8_t *)PyMem_Realloc(a->buf, ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    a->buf = nb;
+    a->cap = ncap;
+    return 0;
+}
+
+static int arena_grow_recs(Arena *a) {
+    if (a->count < a->rcap) return 0;
+    int32_t ncap = a->rcap ? a->rcap * 2 : 1024;
+    int32_t *nk = (int32_t *)PyMem_Realloc(a->klens, ncap * 4);
+    if (!nk) { PyErr_NoMemory(); return -1; }
+    a->klens = nk;
+    int32_t *nv = (int32_t *)PyMem_Realloc(a->vlens, ncap * 4);
+    if (!nv) { PyErr_NoMemory(); return -1; }
+    a->vlens = nv;
+    int64_t *ne = (int64_t *)PyMem_Realloc(a->enq, ncap * 8);
+    if (!ne) { PyErr_NoMemory(); return -1; }
+    a->enq = ne;
+    int64_t *nb = (int64_t *)PyMem_Realloc(a->boff, (ncap + 1) * 8);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    a->boff = nb;
+    a->rcap = ncap;
+    return 0;
+}
+
+static void arena_reset(Arena *a) {
+    a->count = 0;
+    a->start = 0;
+    a->len = 0;
+    a->boff[0] = 0;
+}
+
+// Reclaim the consumed prefix: partial takes leave [0, boff[start])
+// garbage that would otherwise grow with cumulative produced volume
+// under sustained production (the arena never fully drains when
+// records arrive faster than the per-batch take cap).
+static void arena_compact(Arena *a) {
+    int32_t live = a->count - a->start;
+    int64_t base = a->boff[a->start];
+    if (live > 0) {
+        memmove(a->buf, a->buf + base, (size_t)(a->len - base));
+        memmove(a->klens, a->klens + a->start, (size_t)live * 4);
+        memmove(a->vlens, a->vlens + a->start, (size_t)live * 4);
+        memmove(a->enq, a->enq + a->start, (size_t)live * 8);
+        for (int32_t i = 0; i <= live; i++)
+            a->boff[i] = a->boff[a->start + i] - base;
+        a->len -= base;
+    } else {
+        a->len = 0;
+        a->boff[0] = 0;
+    }
+    a->count = live;
+    a->start = 0;
+}
+
+// Shared append body (arena_append + lane_produce): grow, compact a
+// large consumed prefix, copy payloads, stamp the record.
+static int arena_do_append(Arena *a, const char *kp, int64_t kl,
+                           const char *vp, int64_t vl) {
+    int64_t need = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
+    if (a->start > 0
+        && (a->boff[a->start] >= (1 << 20) || a->start >= 8192))
+        arena_compact(a);
+    if (arena_grow_buf(a, need) < 0 || arena_grow_recs(a) < 0) return -1;
+    if (kl > 0) { memcpy(a->buf + a->len, kp, kl); a->len += kl; }
+    if (vl > 0) { memcpy(a->buf + a->len, vp, vl); a->len += vl; }
+    int32_t i = a->count;
+    a->klens[i] = (int32_t)kl;
+    a->vlens[i] = (int32_t)vl;
+    a->enq[i] = now_us();
+    a->count = i + 1;
+    a->boff[a->count] = a->len;
+    return 0;
+}
+
+// append(key: bytes|None, value: bytes|None) -> remaining count
+static PyObject *arena_append(Arena *a, PyObject *const *args,
+                              Py_ssize_t nargs) {
+    if (nargs != 2) {
+        PyErr_SetString(PyExc_TypeError, "append(key, value)");
+        return NULL;
+    }
+    PyObject *key = args[0], *val = args[1];
+    int64_t kl = -1, vl = -1;
+    const char *kp = NULL, *vp = NULL;
+    if (key != Py_None) {
+        if (!PyBytes_Check(key)) {
+            PyErr_SetString(PyExc_TypeError, "key must be bytes or None");
+            return NULL;
+        }
+        kl = PyBytes_GET_SIZE(key);
+        kp = PyBytes_AS_STRING(key);
+    }
+    if (val != Py_None) {
+        if (!PyBytes_Check(val)) {
+            PyErr_SetString(PyExc_TypeError, "value must be bytes or None");
+            return NULL;
+        }
+        vl = PyBytes_GET_SIZE(val);
+        vp = PyBytes_AS_STRING(val);
+    }
+    if (arena_do_append(a, kp, kl, vp, vl) < 0) return NULL;
+    return PyLong_FromLong(a->count - a->start);
+}
+
+// take(max_count, max_bytes)
+//   -> (base, klens, vlens, count, nbytes, enq_first_us, enq_last_us)
+//      | None when empty
+static PyObject *arena_take(Arena *a, PyObject *const *args,
+                            Py_ssize_t nargs) {
+    if (nargs != 2) {
+        PyErr_SetString(PyExc_TypeError, "take(max_count, max_bytes)");
+        return NULL;
+    }
+    int64_t max_count = PyLong_AsLongLong(args[0]);
+    int64_t max_bytes = PyLong_AsLongLong(args[1]);
+    if (PyErr_Occurred()) return NULL;
+    int32_t avail = a->count - a->start;
+    if (avail <= 0) Py_RETURN_NONE;
+    int32_t n = 0;
+    int64_t nb = 0;
+    while (n < avail && n < max_count) {
+        int64_t rl = a->boff[a->start + n + 1] - a->boff[a->start + n];
+        if (n > 0 && nb + rl > max_bytes) break;
+        nb += rl;
+        n++;
+    }
+    int32_t s = a->start;
+    PyObject *base = PyBytes_FromStringAndSize(
+        (const char *)(a->buf + a->boff[s]), nb);
+    PyObject *kb = PyBytes_FromStringAndSize((const char *)(a->klens + s),
+                                             (Py_ssize_t)n * 4);
+    PyObject *vb = PyBytes_FromStringAndSize((const char *)(a->vlens + s),
+                                             (Py_ssize_t)n * 4);
+    if (!base || !kb || !vb) {
+        Py_XDECREF(base); Py_XDECREF(kb); Py_XDECREF(vb);
+        return NULL;
+    }
+    int64_t ef = a->enq[s], el = a->enq[s + n - 1];
+    a->start = s + n;
+    if (a->start == a->count) arena_reset(a);
+    PyObject *r = Py_BuildValue("(NNNiLLL)", base, kb, vb, (int)n,
+                                (long long)nb, (long long)ef, (long long)el);
+    return r;
+}
+
+// expire(cutoff_us) -> (count, nbytes): drop the prefix enqueued at or
+// before cutoff_us (message.timeout.ms scan)
+static PyObject *arena_expire(Arena *a, PyObject *arg) {
+    int64_t cutoff = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return NULL;
+    int32_t n = 0;
+    int64_t nb = 0;
+    while (a->start < a->count && a->enq[a->start] <= cutoff) {
+        nb += a->boff[a->start + 1] - a->boff[a->start];
+        a->start++;
+        n++;
+    }
+    if (a->start == a->count) arena_reset(a);
+    return Py_BuildValue("(iL)", (int)n, (long long)nb);
+}
+
+// clear() -> (count, nbytes): drop everything (purge)
+static PyObject *arena_clear(Arena *a, PyObject *Py_UNUSED(ignored)) {
+    int32_t n = a->count - a->start;
+    int64_t nb = a->boff[a->count] - a->boff[a->start];
+    arena_reset(a);
+    return Py_BuildValue("(iL)", (int)n, (long long)nb);
+}
+
+// drain_records() -> [(key|None, value|None), ...]: demotion path when a
+// toppar mixes fast-lane and Message traffic (rare; FIFO preserved by
+// converting the arena prefix into Message objects)
+static PyObject *arena_drain_records(Arena *a, PyObject *Py_UNUSED(ig)) {
+    int32_t n = a->count - a->start;
+    PyObject *list = PyList_New(n);
+    if (!list) return NULL;
+    for (int32_t i = 0; i < n; i++) {
+        int32_t r = a->start + i;
+        int64_t off = a->boff[r];
+        int32_t kl = a->klens[r], vl = a->vlens[r];
+        PyObject *k, *v;
+        if (kl < 0) { k = Py_None; Py_INCREF(k); }
+        else {
+            k = PyBytes_FromStringAndSize((const char *)(a->buf + off), kl);
+            off += kl;
+        }
+        if (vl < 0) { v = Py_None; Py_INCREF(v); }
+        else
+            v = PyBytes_FromStringAndSize((const char *)(a->buf + off), vl);
+        if (!k || !v) {
+            Py_XDECREF(k); Py_XDECREF(v); Py_DECREF(list);
+            return NULL;
+        }
+        PyObject *t = PyTuple_Pack(2, k, v);
+        Py_DECREF(k); Py_DECREF(v);
+        if (!t) { Py_DECREF(list); return NULL; }
+        PyList_SET_ITEM(list, i, t);
+    }
+    arena_reset(a);
+    return list;
+}
+
+static PyObject *arena_first_enq_us(Arena *a, PyObject *Py_UNUSED(ig)) {
+    if (a->start >= a->count) return PyLong_FromLong(-1);
+    return PyLong_FromLongLong(a->enq[a->start]);
+}
+
+static PyObject *arena_nbytes(Arena *a, PyObject *Py_UNUSED(ig)) {
+    return PyLong_FromLongLong(a->boff[a->count] - a->boff[a->start]);
+}
+
+static Py_ssize_t arena_length(PyObject *self) {
+    Arena *a = (Arena *)self;
+    return a->count - a->start;
+}
+
+static PyObject *arena_new(PyTypeObject *type, PyObject *args,
+                           PyObject *kwds) {
+    Arena *a = (Arena *)type->tp_alloc(type, 0);
+    if (!a) return NULL;
+    a->buf = NULL; a->cap = 0; a->len = 0;
+    a->klens = NULL; a->vlens = NULL; a->enq = NULL;
+    a->boff = (int64_t *)PyMem_Malloc(8);
+    if (!a->boff) { Py_DECREF(a); return PyErr_NoMemory(); }
+    a->boff[0] = 0;
+    a->count = 0; a->rcap = 0; a->start = 0;
+    return (PyObject *)a;
+}
+
+static void arena_dealloc(Arena *a) {
+    PyMem_Free(a->buf);
+    PyMem_Free(a->klens);
+    PyMem_Free(a->vlens);
+    PyMem_Free(a->enq);
+    PyMem_Free(a->boff);
+    Py_TYPE(a)->tp_free((PyObject *)a);
+}
+
+// ============================================================ Lane =====
+//
+// The whole produce() hot path as ONE C call: argument parsing,
+// eligibility, queue-full accounting, toppar lookup, arena append.
+// The Python wrapper binds the public Producer.produce directly to
+// Lane.produce; ineligible calls tail into the stored Python fallback
+// (the Message path).  Counters live here — C methods are atomic under
+// the GIL, replacing the Python-side msg_cnt lock for the hot path.
+
+typedef struct {
+    PyObject_HEAD
+    PyObject *map;        // dict {(topic, partition) -> (Arena, toppar)}
+    PyObject *fallback;   // rk._produce_slow(topic, value, key, ...)
+    PyObject *wake;       // rk._wake_fast(toppar) on empty->non-empty
+    int64_t msg_cnt, msg_bytes;
+    int64_t max_msgs, max_bytes;
+    int64_t copy_max;     // message.copy.max.bytes: larger values keep a
+                          // Python reference (Message path) instead of
+                          // being copied into the arena
+    int enabled;          // conf-level eligibility (no DR consumers)
+    int fatal;            // set_fatal_error happened: produce must raise
+} Lane;
+
+static PyObject *lane_new(PyTypeObject *type, PyObject *args,
+                          PyObject *kwds) {
+    Lane *l = (Lane *)type->tp_alloc(type, 0);
+    if (!l) return NULL;
+    l->map = PyDict_New();
+    if (!l->map) { Py_DECREF(l); return NULL; }
+    l->fallback = NULL;
+    l->wake = NULL;
+    l->msg_cnt = 0; l->msg_bytes = 0;
+    l->max_msgs = 100000; l->max_bytes = 1LL << 30;
+    l->copy_max = 65535;
+    l->enabled = 0; l->fatal = 0;
+    return (PyObject *)l;
+}
+
+// GC support: Lane participates in a reference cycle by design
+// (Kafka -> _lane -> fallback/wake bound methods -> Kafka), so it must
+// be traversable or every producer instance leaks permanently.
+static int lane_traverse(Lane *l, visitproc visit, void *arg) {
+    Py_VISIT(l->map);
+    Py_VISIT(l->fallback);
+    Py_VISIT(l->wake);
+    return 0;
+}
+
+static int lane_clear(Lane *l) {
+    Py_CLEAR(l->map);
+    Py_CLEAR(l->fallback);
+    Py_CLEAR(l->wake);
+    return 0;
+}
+
+static void lane_dealloc(Lane *l) {
+    PyObject_GC_UnTrack(l);
+    lane_clear(l);
+    Py_TYPE(l)->tp_free((PyObject *)l);
+}
+
+// configure(fallback, wake, max_msgs, max_bytes[, copy_max])
+static PyObject *lane_configure(Lane *l, PyObject *const *args,
+                                Py_ssize_t nargs) {
+    if (nargs != 4 && nargs != 5) {
+        PyErr_SetString(
+            PyExc_TypeError,
+            "configure(fallback, wake, max_msgs, max_bytes[, copy_max])");
+        return NULL;
+    }
+    Py_INCREF(args[0]); Py_XSETREF(l->fallback, args[0]);
+    Py_INCREF(args[1]); Py_XSETREF(l->wake, args[1]);
+    l->max_msgs = PyLong_AsLongLong(args[2]);
+    l->max_bytes = PyLong_AsLongLong(args[3]);
+    if (nargs == 5) l->copy_max = PyLong_AsLongLong(args[4]);
+    if (PyErr_Occurred()) return NULL;
+    Py_RETURN_NONE;
+}
+
+// acct(dn, dbytes) -> (msg_cnt, msg_bytes): shared accounting for the
+// Message path / DR / purge / timeout sites (atomic under the GIL)
+static PyObject *lane_acct(Lane *l, PyObject *const *args,
+                           Py_ssize_t nargs) {
+    if (nargs != 2) {
+        PyErr_SetString(PyExc_TypeError, "acct(dn, dbytes)");
+        return NULL;
+    }
+    l->msg_cnt += PyLong_AsLongLong(args[0]);
+    l->msg_bytes += PyLong_AsLongLong(args[1]);
+    if (PyErr_Occurred()) return NULL;
+    return Py_BuildValue("(LL)", (long long)l->msg_cnt,
+                         (long long)l->msg_bytes);
+}
+
+// full() -> bool: queue-full check for the Message path
+static PyObject *lane_full(Lane *l, PyObject *const *args,
+                           Py_ssize_t nargs) {
+    int64_t sz = 0;
+    if (nargs == 1) sz = PyLong_AsLongLong(args[0]);
+    return PyBool_FromLong(l->msg_cnt >= l->max_msgs
+                           || l->msg_bytes + sz > l->max_bytes);
+}
+
+static const char *const lane_kwnames[] = {
+    "topic", "value", "key", "partition", "on_delivery", "timestamp",
+    "headers", "opaque", NULL};
+// interned kwname objects (module init): caller kwnames are interned by
+// CPython, so pointer equality is the common case
+static PyObject *lane_kw_interned[8];
+
+// produce(topic, value=None, key=None, partition=-1, on_delivery=None,
+//         timestamp=0, headers=(), opaque=None)
+// The public producer entry point.  Eligible records append straight
+// into the per-toppar arena; everything else tail-calls the fallback.
+static PyObject *lane_produce(Lane *l, PyObject *const *args,
+                              Py_ssize_t nargs, PyObject *kwnames) {
+    PyObject *argv[8] = {NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL};
+    if (nargs > 8) { // >8 positionals: fallback raises the proper TypeError
+        if (!l->fallback) {
+            PyErr_SetString(PyExc_RuntimeError, "lane fallback not set");
+            return NULL;
+        }
+        return PyObject_Vectorcall(l->fallback, args, nargs, kwnames);
+    }
+    Py_ssize_t npos = nargs;
+    for (Py_ssize_t i = 0; i < npos; i++) argv[i] = args[i];
+    int eligible_kw = 1;
+    if (kwnames) {
+        Py_ssize_t nkw = PyTuple_GET_SIZE(kwnames);
+        for (Py_ssize_t i = 0; i < nkw; i++) {
+            PyObject *name = PyTuple_GET_ITEM(kwnames, i);
+            int hit = 0;
+            for (int j = 0; lane_kwnames[j]; j++) {
+                if (name == lane_kw_interned[j]
+                    || PyObject_RichCompareBool(name, lane_kw_interned[j],
+                                                Py_EQ) == 1) {
+                    if (j < npos) {
+                        // duplicate positional+keyword: route to the
+                        // Python fallback for the proper TypeError
+                        eligible_kw = 0;
+                        break;
+                    }
+                    argv[j] = args[nargs + i];
+                    hit = 1;
+                    break;
+                }
+            }
+            if (!eligible_kw) break;
+            if (!hit) { eligible_kw = 0; argv[0] = NULL; break; }
+        }
+    }
+    PyObject *topic = argv[0], *value = argv[1], *key = argv[2];
+    PyObject *partition = argv[3];
+    int eligible =
+        eligible_kw && l->enabled && !l->fatal && topic != NULL
+        && PyUnicode_Check(topic)
+        && (value == NULL || value == Py_None || PyBytes_Check(value))
+        && (key == NULL || key == Py_None || PyBytes_Check(key))
+        && partition != NULL && PyLong_Check(partition)
+        && (argv[4] == NULL || argv[4] == Py_None)      // on_delivery
+        && (argv[5] == NULL                              // timestamp
+            || (PyLong_Check(argv[5]) && PyLong_AsLongLong(argv[5]) == 0))
+        && (argv[6] == NULL || argv[6] == Py_None        // headers
+            || (PyTuple_Check(argv[6]) && PyTuple_GET_SIZE(argv[6]) == 0)
+            || (PyList_Check(argv[6]) && PyList_GET_SIZE(argv[6]) == 0))
+        && (argv[7] == NULL || argv[7] == Py_None);      // opaque
+    if (eligible) {
+        long long part = PyLong_AsLongLong(partition);
+        if (part >= 0) {
+            PyObject *kt = PyTuple_Pack(2, topic, partition);
+            if (!kt) return NULL;
+            PyObject *ent = PyDict_GetItemWithError(l->map, kt);  // borrowed
+            Py_DECREF(kt);
+            if (!ent && PyErr_Occurred()) return NULL;
+            if (ent) {
+                Arena *a = (Arena *)PyTuple_GET_ITEM(ent, 0);
+                int64_t kl = (key && key != Py_None)
+                                 ? PyBytes_GET_SIZE(key) : -1;
+                int64_t vl = (value && value != Py_None)
+                                 ? PyBytes_GET_SIZE(value) : -1;
+                int64_t sz = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
+                if (vl > l->copy_max || kl > l->copy_max)
+                    goto fallback;      // message.copy.max.bytes: keep a
+                                        // reference (Message path), don't
+                                        // copy into the arena
+                if (l->msg_cnt >= l->max_msgs
+                    || l->msg_bytes + sz > l->max_bytes)
+                    goto fallback;      // slow path raises _QUEUE_FULL
+                if (arena_do_append(
+                        a, kl >= 0 ? PyBytes_AS_STRING(key) : NULL, kl,
+                        vl >= 0 ? PyBytes_AS_STRING(value) : NULL, vl) < 0)
+                    return NULL;
+                l->msg_cnt += 1;
+                l->msg_bytes += sz;
+                if (a->count - a->start == 1 && l->wake) {
+                    // empty -> non-empty: wake the leader broker
+                    PyObject *tp = PyTuple_GET_ITEM(ent, 1);
+                    PyObject *r = PyObject_CallOneArg(l->wake, tp);
+                    if (!r) return NULL;
+                    Py_DECREF(r);
+                }
+                Py_RETURN_NONE;
+            }
+        }
+    }
+    // slow path: the Python Message pipeline (also first-sight setup)
+fallback:
+    // eligibility parsing may have left an OverflowError pending (e.g.
+    // partition or timestamp outside int64) — clear before calling out
+    if (PyErr_Occurred()) PyErr_Clear();
+    if (!l->fallback) {
+        PyErr_SetString(PyExc_RuntimeError, "lane fallback not set");
+        return NULL;
+    }
+    return PyObject_Vectorcall(l->fallback, args, nargs, kwnames);
+}
+
+static PyMemberDef lane_members[] = {
+    {"map", T_OBJECT_EX, offsetof(Lane, map), READONLY,
+     "{(topic, partition) -> (Arena, toppar)}"},
+    {"enabled", T_INT, offsetof(Lane, enabled), 0,
+     "conf-level fast-lane eligibility"},
+    {"fatal", T_INT, offsetof(Lane, fatal), 0,
+     "fatal error pending: produce raises"},
+    {NULL}};
+
+static PyObject *lane_get_msg_cnt(Lane *l, void *c) {
+    return PyLong_FromLongLong(l->msg_cnt);
+}
+static PyObject *lane_get_msg_bytes(Lane *l, void *c) {
+    return PyLong_FromLongLong(l->msg_bytes);
+}
+static PyGetSetDef lane_getset[] = {
+    {"msg_cnt", (getter)lane_get_msg_cnt, NULL, "queued+inflight msgs"},
+    {"msg_bytes", (getter)lane_get_msg_bytes, NULL, "queued bytes"},
+    {NULL}};
+
+static PyMethodDef lane_methods[] = {
+    {"produce", (PyCFunction)(void (*)(void))lane_produce,
+     METH_FASTCALL | METH_KEYWORDS, "the public produce() entry point"},
+    {"configure", (PyCFunction)(void (*)(void))lane_configure,
+     METH_FASTCALL, "configure(fallback, wake, max_msgs, max_bytes)"},
+    {"acct", (PyCFunction)(void (*)(void))lane_acct, METH_FASTCALL,
+     "acct(dn, dbytes) -> (msg_cnt, msg_bytes)"},
+    {"full", (PyCFunction)(void (*)(void))lane_full, METH_FASTCALL,
+     "full(sz=0) -> bool"},
+    {NULL, NULL, 0, NULL}};
+
+static PyTypeObject LaneType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    "tk_enqlane.Lane",             /* tp_name */
+    sizeof(Lane),                  /* tp_basicsize */
+};
+
+static PyMethodDef arena_methods[] = {
+    {"append", (PyCFunction)(void (*)(void))arena_append, METH_FASTCALL,
+     "append(key, value) -> remaining record count"},
+    {"take", (PyCFunction)(void (*)(void))arena_take, METH_FASTCALL,
+     "take(max_count, max_bytes) -> run tuple or None"},
+    {"expire", (PyCFunction)arena_expire, METH_O,
+     "expire(cutoff_us) -> (count, nbytes) dropped"},
+    {"clear", (PyCFunction)arena_clear, METH_NOARGS,
+     "clear() -> (count, nbytes) dropped"},
+    {"drain_records", (PyCFunction)arena_drain_records, METH_NOARGS,
+     "drain_records() -> [(key, value), ...] and reset"},
+    {"first_enq_us", (PyCFunction)arena_first_enq_us, METH_NOARGS,
+     "first_enq_us() -> int64 (-1 when empty)"},
+    {"nbytes", (PyCFunction)arena_nbytes, METH_NOARGS,
+     "nbytes() -> payload bytes queued"},
+    {NULL, NULL, 0, NULL}};
+
+static PySequenceMethods arena_as_sequence = {
+    arena_length,   /* sq_length */
+};
+
+static PyTypeObject ArenaType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    "tk_enqlane.Arena",            /* tp_name */
+    sizeof(Arena),                 /* tp_basicsize */
+};
+
+static struct PyModuleDef enqlane_module = {
+    PyModuleDef_HEAD_INIT, "tk_enqlane",
+    "Native per-toppar produce() enqueue arena", -1, NULL};
+
+PyMODINIT_FUNC PyInit_tk_enqlane(void) {
+    ArenaType.tp_dealloc = (destructor)arena_dealloc;
+    ArenaType.tp_flags = Py_TPFLAGS_DEFAULT;
+    ArenaType.tp_methods = arena_methods;
+    ArenaType.tp_new = arena_new;
+    ArenaType.tp_as_sequence = &arena_as_sequence;
+    if (PyType_Ready(&ArenaType) < 0) return NULL;
+    for (int j = 0; lane_kwnames[j]; j++) {
+        lane_kw_interned[j] = PyUnicode_InternFromString(lane_kwnames[j]);
+        if (!lane_kw_interned[j]) return NULL;
+    }
+    LaneType.tp_dealloc = (destructor)lane_dealloc;
+    LaneType.tp_flags = Py_TPFLAGS_DEFAULT | Py_TPFLAGS_HAVE_GC;
+    LaneType.tp_traverse = (traverseproc)lane_traverse;
+    LaneType.tp_clear = (inquiry)lane_clear;
+    LaneType.tp_methods = lane_methods;
+    LaneType.tp_members = lane_members;
+    LaneType.tp_getset = lane_getset;
+    LaneType.tp_new = lane_new;
+    if (PyType_Ready(&LaneType) < 0) return NULL;
+    PyObject *m = PyModule_Create(&enqlane_module);
+    if (!m) return NULL;
+    Py_INCREF(&ArenaType);
+    if (PyModule_AddObject(m, "Arena", (PyObject *)&ArenaType) < 0) {
+        Py_DECREF(&ArenaType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    Py_INCREF(&LaneType);
+    if (PyModule_AddObject(m, "Lane", (PyObject *)&LaneType) < 0) {
+        Py_DECREF(&LaneType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

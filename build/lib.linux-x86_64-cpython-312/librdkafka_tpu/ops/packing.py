@@ -1,0 +1,42 @@
+"""Host-side batch packing helpers shared by the device codec kernels.
+
+The lz4 kernel wants RIGHT-padded rows (positions are absolute from the
+block start); the crc32c kernel wants LEFT-padded rows (leading zeros are
+a no-op under a zero initial register — see ops/crc32c_jax.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def next_pow2(n: int, lo: int = 64) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+def _pack(buffers: list[bytes], N: int, left: bool) -> tuple[np.ndarray, np.ndarray]:
+    B = len(buffers)
+    out = np.zeros((B, N), dtype=np.uint8)
+    lens = np.zeros((B,), dtype=np.int32)
+    for i, b in enumerate(buffers):
+        n = len(b)
+        lens[i] = n
+        if n:
+            arr = np.frombuffer(bytes(b), dtype=np.uint8)
+            if left:
+                out[i, N - n:] = arr
+            else:
+                out[i, :n] = arr
+    return out, lens
+
+
+def pad_left(buffers: list[bytes], N: int):
+    """Right-aligned rows (leading zeros) — the crc32c kernel layout."""
+    return _pack(buffers, N, True)
+
+
+def pad_right(buffers: list[bytes], N: int):
+    """Left-aligned rows (trailing zeros) — the lz4 kernel layout."""
+    return _pack(buffers, N, False)

@@ -1,0 +1,207 @@
+"""TPU codec provider — the north-star offload (SURVEY.md §7 stage 5).
+
+Replaces the broker-thread compression + CRC hot loops of the reference
+(rdkafka_msgset_writer.c:1129 writer_compress, crc32c.c:39) with batched
+device launches:
+
+  * lz4: every ≤64KB frame block of every partition batch is compressed in
+    ONE vmapped launch (ops/lz4_jax.py); frames are assembled host-side
+    byte-identically to the CPU provider (ops/native/codec.cpp
+    tk_lz4f_compress — magic | FLG 0x60 | BD 0x40 | HC | blocks | EndMark,
+    incompressible blocks stored raw with the high bit set).
+  * crc32c: chunk-parallel + GF(2) combine (ops/crc32c_jax.py).
+  * gzip/zstd entropy coding and snappy stay on the CPU provider behind the
+    same interface for now (SURVEY.md §7 risk list: entropy stages last).
+
+Wire bytes are bit-identical to the CPU provider by construction; the
+equivalence suite is tests/test_0018_tpu_codec.py.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from . import cpu as _cpu
+from .crc32c_jax import crc32c_many_mxu as _crc32c_many_mxu
+from .lz4_jax import lz4_block_compress_many
+
+LZ4F_MAGIC = 0x184D2204
+LZ4F_BLOCKSIZE = 65536
+
+_HC = None
+
+
+def _frame_hc() -> int:
+    """Header-checksum byte: (xxh32(FLG|BD) >> 8) & 0xFF — a constant."""
+    global _HC
+    if _HC is None:
+        _HC = (_cpu.xxh32(b"\x60\x40", 0) >> 8) & 0xFF
+    return _HC
+
+
+class TpuCodecProvider:
+    """MsgsetCodecProvider with device-offloaded lz4 + crc32c."""
+
+    name = "tpu"
+
+    def __init__(self, min_batches: int = 4, warmup: bool = True,
+                 mesh_devices: int = 0, lz4_force: bool = False,
+                 min_transport_mb_s: float = 100.0):
+        # below this many independent buffers a launch isn't worth it;
+        # fall back to the CPU provider (identical bytes either way).
+        self.min_batches = max(1, int(min_batches))
+        # tpu.mesh.devices: >1 shards block compression over a 1-D
+        # jax.sharding.Mesh (parallel/mesh.py shard_map scale-out)
+        self.mesh_devices = int(mesh_devices or 0)
+        # tpu.lz4.force: the device lz4 encoder is measured ~3 orders of
+        # magnitude slower than the native CPU path (PERF.md §3 —
+        # gather/sort-bound match search), so backend=tpu routes lz4 to
+        # CPU and keeps only CRC32C on the MXU unless explicitly forced
+        self.lz4_force = bool(lz4_force)
+        # Adaptive offload gate: CRC offload only pays when host<->device
+        # bandwidth beats the CPU provider's ~1 GB/s CRC rate by enough
+        # margin.  On a real TPU VM PCIe measures GB/s and the gate stays
+        # open; behind a slow dev tunnel (MB/s) every launch would cost
+        # more in transfer than the whole CPU checksum, so the provider
+        # self-routes to CPU.  0 disables the gate (always offload).
+        self.min_transport_mb_s = float(min_transport_mb_s)
+        self.transport_mb_s: float | None = None      # measured by probe
+        self._mesh = None
+        self._cpu = _cpu.CpuCodecProvider()
+        self._warmup_thread = None
+        if warmup:
+            # compile the fixed-shape kernels off the critical path (the
+            # 64KB lz4 block kernel costs ~20 s of XLA compile; the CRC
+            # matmul ~5 s) so first real traffic doesn't stall
+            import threading
+
+            def _warm():
+                # probe transport FIRST: when the gate is closed every
+                # launch self-routes to CPU, so the (expensive, GIL-
+                # chewing) XLA compiles would never be used — skip them.
+                # Shapes must match real traffic: the lz4 kernel caches
+                # per next_pow2(block len) — 64KB is the production
+                # block size — and the CRC matmul caches per pow2 batch
+                # bucket, so warm the full-chunk bucket too
+                try:
+                    if not self._offload_pays() and not self.lz4_force:
+                        return
+                    blk = b"\x00" * LZ4F_BLOCKSIZE
+                    if self.lz4_force:
+                        lz4_block_compress_many([blk])
+                    if self._offload_pays():
+                        _crc32c_many_mxu([blk] * self.min_batches)
+                except Exception:
+                    pass
+
+            self._warmup_thread = threading.Thread(
+                target=_warm, daemon=True, name="tpu-codec-warmup")
+            self._warmup_thread.start()
+
+    # -------------------------------------------------------------- lz4 --
+
+    def _lz4f_compress_many(self, bufs: list[bytes]) -> list[bytes]:
+        # flatten: every 64KB block of every buffer is one device-batch item
+        blocks: list[bytes] = []
+        spans: list[tuple[int, int]] = []      # (first_block, nblocks) per buf
+        for b in bufs:
+            b = bytes(b)
+            first = len(blocks)
+            for pos in range(0, len(b), LZ4F_BLOCKSIZE):
+                blocks.append(b[pos:pos + LZ4F_BLOCKSIZE])
+            spans.append((first, len(blocks) - first))
+
+        mesh = self._get_mesh()
+        if mesh is not None:
+            from ..parallel.mesh import shard_compress
+            cblocks, _, _ = shard_compress(mesh, blocks, with_crc=False)
+        else:
+            cblocks = lz4_block_compress_many(blocks)
+
+        out = []
+        hdr = struct.pack("<IBBB", LZ4F_MAGIC, 0x60, 0x40, _frame_hc())
+        for first, nb in spans:
+            parts = [hdr]
+            for k in range(nb):
+                raw = blocks[first + k]
+                comp = cblocks[first + k]
+                if len(comp) < len(raw):
+                    parts.append(struct.pack("<I", len(comp)))
+                    parts.append(comp)
+                else:                      # incompressible: store raw
+                    parts.append(struct.pack("<I", len(raw) | 0x80000000))
+                    parts.append(raw)
+            parts.append(b"\x00\x00\x00\x00")  # EndMark
+            out.append(b"".join(parts))
+        return out
+
+    def wait_warm(self, timeout: float = 120.0) -> None:
+        """Block until the async warmup (probe + kernel compiles) ends."""
+        t = getattr(self, "_warmup_thread", None)
+        if t is not None:
+            t.join(timeout)
+
+    def _probe_transport(self) -> float:
+        """Measure host<->device bandwidth once (warm path, 256KB).
+
+        The probe is a full round trip (device_put + host readback) —
+        the only sync that is reliable on every platform (a tunneled
+        device can return from block_until_ready before bytes land) —
+        so the rate counts the bytes moved in BOTH directions.  A probe
+        failure is cached as 0.0: a broken device must not re-raise
+        inside the broker serve loop on every batch."""
+        if self.transport_mb_s is None:
+            try:
+                import time
+
+                import jax
+
+                h = np.zeros((4, LZ4F_BLOCKSIZE), np.uint8)
+                np.asarray(jax.device_put(h))         # warm the path
+                t0 = time.perf_counter()
+                np.asarray(jax.device_put(h))
+                dt = max(time.perf_counter() - t0, 1e-9)
+                self.transport_mb_s = (2 * h.nbytes / (1 << 20)) / dt
+            except Exception:
+                self.transport_mb_s = 0.0
+        return self.transport_mb_s
+
+    def _offload_pays(self) -> bool:
+        """True when the measured transport clears the gate (or the gate
+        is disabled).  Probes lazily if the warmup thread hasn't yet."""
+        if self.min_transport_mb_s <= 0:
+            return True
+        return self._probe_transport() >= self.min_transport_mb_s
+
+    def _get_mesh(self):
+        if self._mesh is None and self.mesh_devices > 1:
+            import jax
+            from ..parallel.mesh import make_mesh
+            n = min(self.mesh_devices, len(jax.devices()))
+            if n > 1:
+                self._mesh = make_mesh(n)
+        return self._mesh
+
+    # -------------------------------------------------------- interface --
+
+    def compress_many(self, codec: str, bufs: list[bytes], level: int = -1
+                      ) -> list[bytes]:
+        # lz4 compresses on the native CPU path unless tpu.lz4.force:
+        # wire bytes are identical either way, and the device encoder
+        # only exists to prove bit-exactness, not to win (PERF.md §3)
+        if (codec == "lz4" and self.lz4_force
+                and len(bufs) >= self.min_batches):
+            return self._lz4f_compress_many(bufs)
+        return self._cpu.compress_many(codec, bufs, level)
+
+    def decompress_many(self, codec: str, bufs: list[bytes],
+                        size_hints: list[int] | None = None) -> list[bytes]:
+        return self._cpu.decompress_many(codec, bufs, size_hints)
+
+    def crc32c_many(self, bufs: list[bytes]) -> list[int]:
+        if len(bufs) >= self.min_batches and self._offload_pays():
+            # ONE GF(2) matmul per 64KB block on the MXU (crc32c_jax.py;
+            # 8.5x native CPU at 128x64KB in device time on v5e-1)
+            return [int(x) for x in _crc32c_many_mxu(bufs)]
+        return self._cpu.crc32c_many(bufs)

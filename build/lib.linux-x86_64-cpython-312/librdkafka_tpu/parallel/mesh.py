@@ -1,0 +1,109 @@
+"""Multi-chip scale-out of the codec sidecar over a jax.sharding.Mesh.
+
+The reference's only "distributed backend" is per-broker TCP (SURVEY.md §5)
+— network IO stays on host threads here too.  What DOES shard across chips
+is the codec work: independent per-partition batches (the vmap axis of
+SURVEY.md §3.2) are laid out along a 1-D ``batch`` mesh axis, each chip
+compresses and checksums its shard locally (zero cross-chip traffic on the
+hot path — the layout rides ICI only for the final stats reduction, a
+psum of byte counters matching the reference's atomic stats counters,
+rdatomic.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.crc32c_jax import _crc_kernel, _pick_kl, _shift_tables
+from ..ops.lz4_jax import _lz4_block_one
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), ("batch",))
+
+
+_STEP_CACHE: dict = {}
+
+
+def sharded_codec_step(mesh: Mesh, N: int, with_crc: bool = True):
+    """Build the jitted multi-chip codec step for (B, N) blocks.
+
+    Returns fn(data (B,N) uint8 right-padded, lens (B,) int32,
+    valid (B,) int32 row mask) →
+      (lz4 bytes (B,C) uint8, lz4 lens (B,), crc32c (B,) uint32,
+       total_out_bytes scalar — psum of valid rows across the mesh).
+    B must be a multiple of the mesh size. ``with_crc=False`` builds a
+    compress-only step (no CRC matmul, no psum) for callers that
+    checksum elsewhere — e.g. the codec provider, whose batch CRC
+    covers the assembled record batch, not raw blocks.
+    """
+    key = (tuple(d.id for d in mesh.devices.flat), N, with_crc)
+    cached = _STEP_CACHE.get(key)
+    if cached is not None:
+        return cached
+    K, L = _pick_kl(N)
+    shift_tab = _shift_tables(L)
+
+    def local(data, lens, valid):
+        out, olen = jax.vmap(lambda d, n: _lz4_block_one(d, n, N))(data, lens)
+        if not with_crc:
+            return out, olen
+        # the crc kernel needs LEFT-padded rows (leading zeros are a no-op
+        # under a zero register); shift each right-padded row into place
+        j = jnp.arange(N, dtype=jnp.int32)[None, :]
+        src = j - (N - lens[:, None])
+        crc_in = jnp.where(
+            src >= 0,
+            jnp.take_along_axis(data, jnp.clip(src, 0, N - 1), axis=1),
+            jnp.uint8(0))
+        crc = _crc_kernel(crc_in.reshape(-1, K, L), lens, shift_tab)
+        total = jax.lax.psum(jnp.sum(olen * valid), "batch")
+        return out, olen, crc, total
+
+    out_specs = ((P("batch", None), P("batch"), P("batch"), P())
+                 if with_crc else (P("batch", None), P("batch")))
+    shard = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P("batch", None), P("batch"), P("batch")),
+        out_specs=out_specs,
+        check_vma=False)
+    fn = jax.jit(shard)
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def shard_compress(mesh: Mesh, blocks: list[bytes], with_crc: bool = True):
+    """Compress blocks across the mesh (pads B up to a mesh multiple).
+    Returns (blocks, crcs, total) with crcs=None/total=0 when
+    with_crc=False."""
+    from ..ops.packing import next_pow2, pad_right
+
+    ndev = mesh.devices.size
+    N = next_pow2(max((len(b) for b in blocks), default=64))
+    data, lens = pad_right(blocks, N)
+    B = len(blocks)
+    Bp = ((B + ndev - 1) // ndev) * ndev
+    valid = np.ones((B,), np.int32)
+    if Bp != B:
+        data = np.concatenate([data, np.zeros((Bp - B, N), np.uint8)])
+        lens = np.concatenate([lens, np.zeros((Bp - B,), np.int32)])
+        valid = np.concatenate([valid, np.zeros((Bp - B,), np.int32)])
+    fn = sharded_codec_step(mesh, N, with_crc)
+    row = NamedSharding(mesh, P("batch"))
+    res = fn(
+        jax.device_put(data, NamedSharding(mesh, P("batch", None))),
+        jax.device_put(lens, row), jax.device_put(valid, row))
+    if with_crc:
+        out, olen, crc, total = res
+    else:
+        out, olen = res
+        crc, total = None, 0
+    out = np.asarray(out)
+    olen = np.asarray(olen)
+    return ([out[i, :olen[i]].tobytes() for i in range(B)],
+            None if crc is None else np.asarray(crc)[:B], int(total))

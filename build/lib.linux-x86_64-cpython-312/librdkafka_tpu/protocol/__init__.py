@@ -1,0 +1,1 @@
+"""librdkafka_tpu.protocol"""

@@ -1,0 +1,88 @@
+"""Offset-based socket buffer helpers (broker transport + mock cluster).
+
+The reference sends straight from segmented buffers via iovecs
+(rd_kafka_transport_socket_sendmsg, rdkafka_transport.c:109).  The
+Python analog keeps one bytearray per connection and consumes it by
+OFFSET: the previous ``del buf[:n]`` pattern memmoved the whole
+remaining buffer once per socket chunk (~16MB of GIL-held shifting per
+1MB batch).
+
+The memoryview discipline here is load-bearing: a raising ``send()``
+pins the traceback — and with it any live buffer export — so the chunk
+view must be released in a ``finally`` or a later ``buf.clear()``
+raises BufferError.
+"""
+from __future__ import annotations
+
+import ssl as _ssl
+import struct
+from typing import Optional
+
+#: consumed-prefix size at which the buffer is compacted even though it
+#: has not fully drained (sustained backpressure must not retain every
+#: byte ever sent)
+COMPACT_THRESHOLD = 1 << 20
+
+_WOULD_BLOCK = (_ssl.SSLWantReadError, _ssl.SSLWantWriteError,
+                BlockingIOError, InterruptedError)
+
+
+def send_from(sock, buf: bytearray,
+              off: int) -> tuple[int, bool, Optional[OSError]]:
+    """Send buf[off:]; returns (new_off, blocked, error)."""
+    err: Optional[OSError] = None
+    blocked = False
+    mv = memoryview(buf)
+    try:
+        total = len(mv)
+        while off < total:
+            chunk = mv[off:]
+            try:
+                off += sock.send(chunk)
+            except _WOULD_BLOCK:
+                blocked = True
+                break
+            except OSError as e:
+                err = e
+                break
+            finally:
+                chunk.release()
+    finally:
+        mv.release()
+    return off, blocked, err
+
+
+def compact_consumed(buf: bytearray, off: int) -> int:
+    """Reclaim the consumed prefix; returns the new offset."""
+    if off >= len(buf):
+        buf.clear()
+        return 0
+    if off >= COMPACT_THRESHOLD:
+        del buf[:off]
+        return 0
+    return off
+
+
+def extract_frames(buf: bytearray,
+                   max_bytes: Optional[int] = None
+                   ) -> tuple[list[bytes], Optional[int]]:
+    """Pop every complete 4-byte-length-prefixed frame off the front of
+    ``buf`` (ONE compaction per call).  Returns (frames, bad_size):
+    bad_size is the offending length when a frame exceeds max_bytes or
+    is negative — the caller decides how to die."""
+    frames: list[bytes] = []
+    off = 0
+    blen = len(buf)
+    while blen - off >= 4:
+        (n,) = struct.unpack_from(">i", buf, off)
+        if n < 0 or (max_bytes is not None and n > max_bytes):
+            if off:
+                del buf[:off]
+            return frames, n
+        if blen - off < 4 + n:
+            break
+        frames.append(bytes(buf[off + 4:off + 4 + n]))
+        off += 4 + n
+    if off:
+        del buf[:off]
+    return frames, None

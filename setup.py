@@ -1,0 +1,46 @@
+"""Wheel build: compile the native codec + enqueue-lane libraries at
+build time so installed environments never shell out to g++ on first
+import (they still can, as a fallback, when a wheel is built without a
+toolchain — ops/native/build.py keeps the mtime-cached lazy path)."""
+import importlib.util
+import os
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+from setuptools.dist import Distribution
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _native_build():
+    spec = importlib.util.spec_from_file_location(
+        "_native_build",
+        os.path.join(HERE, "librdkafka_tpu", "ops", "native", "build.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class BuildPyWithNative(build_py):
+    """Compile the .so artifacts into the source tree before build_py
+    copies package data (pyproject ships *.so as package-data)."""
+
+    def run(self):
+        try:
+            nb = _native_build()
+            nb.build()
+            nb.build_enqlane()
+        except Exception as e:      # no toolchain: fall back to lazy
+            self.announce(f"native prebuild skipped: {e}", level=3)
+        super().run()
+
+
+class BinaryDistribution(Distribution):
+    """The wheel carries compiled .so files — tag it platform-specific."""
+
+    def has_ext_modules(self):
+        return True
+
+
+setup(cmdclass={"build_py": BuildPyWithNative},
+      distclass=BinaryDistribution)

@@ -88,11 +88,12 @@ def test_token_relay_and_security_layer_exchange():
     assert cli.step(TOK_AP_REP) == b""       # AP-REP consumed, no token
     assert ctx.complete
     # phase 2: server's wrapped [bitmask|max]; client answers wrapped
-    # [LAYER_NONE << 24 | authzid]
+    # [LAYER_NONE << 24] with an EMPTY authzid (authorize as the
+    # authenticated principal — what the reference's cyrus provider
+    # sends; a mismatched authzid is rejected by the broker)
     out = cli.step(b"WRAPPED[" + SSF_NONE_1MB + b"]")
-    assert out == b"WRAPPED[" + struct.pack(">I", 0x01000000) \
-        + b"client@EXAMPLE.COM]"
-    assert ctx.wrapped_out[:4] == struct.pack(">I", 0x01000000)
+    assert out == b"WRAPPED[" + struct.pack(">I", 0x01000000) + b"]"
+    assert ctx.wrapped_out == struct.pack(">I", 0x01000000)
     # phase 3: done — outcome arrives via error_code
     assert cli.step(b"") is None
 

@@ -50,25 +50,46 @@ def test_socket_max_fails_forces_reconnect(cluster):
     p.close()
 
 
-def test_backpressure_threshold_batches_harder(cluster):
-    """With threshold=1 (default), untransmitted requests pause batch
-    formation → fewer, larger MessageSets than threshold=1000000 under
-    identical load. Assert the knob is consulted by checking a huge
-    threshold yields at least as many batches."""
+def test_backpressure_threshold_batches_harder():
+    """On a rate-limited socket (sockem), untransmitted requests back up
+    in the write buffer; threshold=1 must then pause MessageSet
+    formation → strictly fewer, larger batches than an effectively-
+    disabled threshold under identical load."""
+    import socket as _socket
+
+    from librdkafka_tpu.mock.sockem import Sockem
+
     counts = {}
     for thresh in (1, 1000000):
         c = MockCluster(num_brokers=1, topics={"bp": 1})
+        # slow proxy + tiny client send buffer: the socket genuinely
+        # backs up, so untransmitted requests sit in the broker's write
+        # buffer where the threshold can see them
+        em = Sockem(rate_bps=24 * 1024)
+
+        def connect_cb(host, port, timeout, _em=em):
+            s = _em.connect_cb(host, port, timeout)
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 4096)
+            return s
+
         p = Producer({"bootstrap.servers": c.bootstrap_servers(),
+                      "connect_cb": connect_cb,
                       "queue.buffering.backpressure.threshold": thresh,
-                      "linger.ms": 0, "batch.num.messages": 10000})
-        for i in range(2000):
+                      "linger.ms": 0, "batch.num.messages": 10000,
+                      "message.timeout.ms": 60000})
+        # pace the app thread so the serve loop runs many times during
+        # the burst — without backpressure that means many small
+        # requests piling into the choked socket
+        for i in range(300):
             p.produce("bp", value=b"y" * 100, partition=0)
-        assert p.flush(15.0) == 0
+            if i % 10 == 9:
+                time.sleep(0.002)
+        assert p.flush(60.0) == 0
         counts[thresh] = len(c.partition("bp", 0).log)
         p.close()
         c.stop()
-    # threshold=1 must not produce MORE batches than the huge threshold
-    assert counts[1] <= counts[1000000]
+    # with backpressure the producer must coalesce into FEWER requests
+    assert counts[1] < counts[1000000], counts
 
 
 def test_allow_auto_create_topics_consumer(cluster):
