@@ -203,7 +203,11 @@ class Broker:
         self._wakeup_w.setblocking(False)
         self.ops.set_wakeup_cb(self._wakeup)
         self.api_versions: dict[int, int] = {}
-        self.features: set[str] = set()
+        # None = not yet negotiated (vs set() = negotiated, no
+        # features — a 0.8.x broker); the writer must not assume v2
+        # before negotiation resolves (reference: rkb_features set by
+        # rd_kafka_broker_features_set after ApiVersions/fallback)
+        self.features: set[str] | None = None
         self._apiversion_failed = False   # broker closed on ApiVersions
         self._fallback_until = 0.0        # api.version.fallback.ms window
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
@@ -754,7 +758,7 @@ class Broker:
         batch_max = rk.conf.get("batch.num.messages")
         codec = rk.conf.get("compression.codec")
         # pre-0.11 broker: magic 0/1 path — skip V2 writer construction
-        legacy = bool(self.features) and MSGVER2 not in self.features
+        legacy = self.features is not None and MSGVER2 not in self.features
         # codec pipeline backpressure: at most `depth` launches in
         # flight; messages keep accumulating in xmit_msgq meanwhile
         if (rk.codec_worker is not None
@@ -1358,6 +1362,33 @@ class Broker:
                             Err._BAD_MSG,
                             f"{tp}: CRC mismatch at offset "
                             f"{info.base_offset}"))
+                        tp.fetch_backoff_until = time.monotonic() + 0.5
+            # legacy MsgVer0/1 blobs: per-message zlib CRC, same batched
+            # provider seam (MXU GF(2) kernel on the tpu backend;
+            # reference verifies inline, rdkafka_msgset_reader.c v0/v1)
+            from ..protocol.msgset import iter_legacy_crc_regions
+            lregions, lowners = [], []
+            for tp, pres, batches, fo, ver in ok:
+                if batches is not None:
+                    continue
+                blob = pres["records"] or b""
+                if len(blob) <= proto.V2_OF_Magic \
+                        or blob[proto.V2_OF_Magic] >= 2:
+                    continue
+                for off, crc, region in iter_legacy_crc_regions(blob):
+                    lregions.append(region)
+                    lowners.append((tp, off, crc))
+            if lregions:
+                crcs = rk.codec_provider.crc32_many(lregions)
+                for (tp, off, want), got in zip(lowners, crcs):
+                    if id(tp) in bad:
+                        continue
+                    if int(got) != want:
+                        bad.add(id(tp))
+                        rk.op_err(KafkaError(
+                            Err._BAD_MSG,
+                            f"{tp}: legacy message CRC mismatch at "
+                            f"offset {off}"))
                         tp.fetch_backoff_until = time.monotonic() + 0.5
 
         # phase C: ONE batched decompress per codec across the response.

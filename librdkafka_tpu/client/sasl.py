@@ -304,9 +304,12 @@ class GssapiClient:
         # rejected by the broker's authorize check.
         self.authzid = ""
         # sasl.kerberos.principal selects which cached credential to
-        # initiate with (the reference uses it for kinit); empty/default
-        # "kafkaclient" means the ccache default.
+        # initiate with (the reference uses it for kinit); when the app
+        # leaves the row untouched we use the ccache default — keyed on
+        # explicit-set, not the value, so configuring the literal
+        # default string still looks up that credential
         principal = rk.conf.get("sasl.kerberos.principal")
+        explicit = rk.conf.is_set("sasl.kerberos.principal")
         if ctx_factory is None:
             if not gssapi_available():
                 raise KafkaException(
@@ -314,7 +317,7 @@ class GssapiClient:
                     "GSSAPI requires the python-gssapi package")
             import gssapi
             creds = None
-            if principal and principal != "kafkaclient":
+            if explicit and principal:
                 creds = gssapi.Credentials(
                     name=gssapi.Name(principal), usage="initiate")
             name = gssapi.Name(

@@ -413,3 +413,8 @@ class CpuCodecProvider:
 
     def crc32c_many(self, bufs: list[bytes]) -> list[int]:
         return [int(x) for x in crc32c_many(bufs)]
+
+    def crc32_many(self, bufs: list[bytes]) -> list[int]:
+        """Legacy MsgVer0/1 zlib-poly CRC (reference: src/rdcrc32.c)."""
+        import zlib
+        return [zlib.crc32(bytes(b)) & 0xFFFFFFFF for b in bufs]

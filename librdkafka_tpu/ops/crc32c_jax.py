@@ -211,11 +211,23 @@ def _apply_host(cols: np.ndarray, v: int) -> int:
     return acc
 
 
-@lru_cache(maxsize=2)
-def _q_matrix(N: int = _MXU_BLOCK) -> np.ndarray:
+def _poly_tables(poly: str):
+    """(T0 single-byte table, ZERO_OP matrices) for a poly tag. Both
+    reflected init=~0 xorout=~0 CRCs share the whole affine machinery;
+    only these two constants differ (reference: crc32c.c vs rdcrc32.c)."""
+    from ..utils.crc import TABLE_CRC32, ZERO_OP_CRC32
+    if poly == "crc32c":
+        return TABLE_CRC32C[0].astype(np.uint32), ZERO_OP_CRC32C
+    if poly == "crc32":
+        return TABLE_CRC32.astype(np.uint32), ZERO_OP_CRC32
+    raise ValueError(poly)
+
+
+@lru_cache(maxsize=4)
+def _q_matrix(N: int = _MXU_BLOCK, poly: str = "crc32c") -> np.ndarray:
     """(N*8, 32) int8 bit-contribution matrix, built by one backward
     sweep advancing the 8 single-bit folds through trailing zeros."""
-    T0 = TABLE_CRC32C[0].astype(np.uint32)
+    T0, _ = _poly_tables(poly)
     c = T0[1 << np.arange(8)].astype(np.uint32)      # (8,)
     Q = np.zeros((N, 8, 32), dtype=np.int8)
     ar32 = np.arange(32, dtype=np.uint32)
@@ -225,21 +237,22 @@ def _q_matrix(N: int = _MXU_BLOCK) -> np.ndarray:
     return Q.reshape(N * 8, 32)
 
 
-def _term_host(n: int) -> int:
+def _term_host(n: int, poly: str = "crc32c") -> int:
     """f(~0, 0^n): the length-dependent affine term, host-side."""
+    _, zop = _poly_tables(poly)
     v = 0xFFFFFFFF
     k = 0
     while n:
         if n & 1:
-            v = _apply_host(ZERO_OP_CRC32C[k], v)
+            v = _apply_host(zop[k], v)
         n >>= 1
         k += 1
     return v
 
 
 @lru_cache(maxsize=16)
-def _jit_mxu(B: int, N: int = _MXU_BLOCK):
-    Q = jnp.asarray(_q_matrix(N))
+def _jit_mxu(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c"):
+    Q = jnp.asarray(_q_matrix(N, poly))
     pow2 = jnp.asarray((1 << np.arange(32)).astype(np.int64)).astype(_U32)
 
     def fn(data, terms):
@@ -257,14 +270,15 @@ def _jit_mxu(B: int, N: int = _MXU_BLOCK):
 
 
 @lru_cache(maxsize=16)
-def _jit_mxu_pallas(B: int, N: int = _MXU_BLOCK, CB: int = 2048):
+def _jit_mxu_pallas(B: int, N: int = _MXU_BLOCK, CB: int = 2048,
+                    poly: str = "crc32c"):
     """Pallas variant: bit-plane expansion fused with the matmul in VMEM
     (rows of Q reordered to (chunk, bit-plane, position))."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     NC = N // CB
-    Q = _q_matrix(N).reshape(NC, CB, 8, 32).transpose(0, 2, 1, 3)
+    Q = _q_matrix(N, poly).reshape(NC, CB, 8, 32).transpose(0, 2, 1, 3)
     Q = jnp.asarray(np.ascontiguousarray(Q.reshape(N * 8, 32)))
     pow2 = jnp.asarray((1 << np.arange(32)).astype(np.int64)).astype(_U32)
     interpret = jax.devices()[0].platform != "tpu"
@@ -304,7 +318,7 @@ def _jit_mxu_pallas(B: int, N: int = _MXU_BLOCK, CB: int = 2048):
     return jax.jit(fn)
 
 
-_FULL_TERM = None
+_FULL_TERMS: dict[str, int] = {}
 
 
 def crc32c_many_mxu(buffers: list[bytes], *,
@@ -312,10 +326,23 @@ def crc32c_many_mxu(buffers: list[bytes], *,
     """CRC32C of each buffer via ONE GF(2) matmul per 64KB block on the
     MXU, folded per buffer with crc32c_combine.  Fixed device shapes:
     one XLA compile per batch-size bucket, any buffer length."""
-    global _FULL_TERM
+    return _crc_many_mxu(buffers, poly="crc32c", pallas=pallas)
+
+
+def crc32_many_mxu(buffers: list[bytes], *,
+                   pallas: bool = False) -> np.ndarray:
+    """Legacy zlib-polynomial CRC32 (MsgVer0/1 per-message checksum,
+    reference src/rdcrc32.c) on the same one-matmul MXU kernel — the
+    GF(2)-linear decomposition is polynomial-agnostic."""
+    return _crc_many_mxu(buffers, poly="crc32", pallas=pallas)
+
+
+def _crc_many_mxu(buffers: list[bytes], *, poly: str,
+                  pallas: bool = False) -> np.ndarray:
     if not buffers:
         return np.zeros((0,), dtype=np.uint32)
-    from ..utils.crc import crc32c_combine
+    from ..utils.crc import crc32_combine, crc32c_combine
+    combine = crc32c_combine if poly == "crc32c" else crc32_combine
 
     blk = _MXU_BLOCK
     blocks: list[bytes] = []
@@ -330,8 +357,9 @@ def crc32c_many_mxu(buffers: list[bytes], *,
             blocks.append(b[pos:pos + blk])
         spans.append((first, len(blocks) - first))
 
-    if _FULL_TERM is None:
-        _FULL_TERM = _term_host(blk)
+    if poly not in _FULL_TERMS:
+        _FULL_TERMS[poly] = _term_host(blk, poly)
+    full_term = _FULL_TERMS[poly]
     crcs = np.zeros((len(blocks),), dtype=np.uint32)
     jit = _jit_mxu_pallas if pallas else _jit_mxu
     for start in range(0, len(blocks), _MXU_MAX_B):
@@ -350,9 +378,14 @@ def crc32c_many_mxu(buffers: list[bytes], *,
                 [data, np.zeros((B - len(chunk), blk), np.uint8)])
             lens = np.concatenate(
                 [lens, np.zeros((B - len(chunk),), lens.dtype)])
-        terms = np.array([_FULL_TERM if n == blk else _term_host(int(n))
+        terms = np.array([full_term if n == blk
+                          else _term_host(int(n), poly)
                           for n in lens], dtype=np.uint32)
-        out = np.asarray(jit(B)(data, terms)).astype(np.uint32)
+        if pallas:
+            out = np.asarray(jit(B, _MXU_BLOCK, 2048, poly)(data, terms))
+        else:
+            out = np.asarray(jit(B, _MXU_BLOCK, poly)(data, terms))
+        out = out.astype(np.uint32)
         crcs[start:start + len(chunk)] = out[:len(chunk)]
 
     res = np.zeros((len(buffers),), dtype=np.uint32)
@@ -364,7 +397,7 @@ def crc32c_many_mxu(buffers: list[bytes], *,
         off = blk
         for k in range(1, nb):
             ln = min(blk, len(b) - off)
-            acc = crc32c_combine(acc, int(crcs[first + k]), ln)
+            acc = combine(acc, int(crcs[first + k]), ln)
             off += blk
         res[i] = acc
     return res

@@ -70,6 +70,10 @@ class TpuCodecProvider:
         self._mesh = None
         self._cpu = _cpu.CpuCodecProvider()
         self._warmup_thread = None
+        # legacy-CRC device route opens only after its kernel compiled
+        # in the background (see crc32_many)
+        self._crc32_ready = False
+        self._crc32_warming = False
         if warmup:
             # compile the fixed-shape kernels off the critical path (the
             # 64KB lz4 block kernel costs ~20 s of XLA compile; the CRC
@@ -205,3 +209,39 @@ class TpuCodecProvider:
             # 8.5x native CPU at 128x64KB in device time on v5e-1)
             return [int(x) for x in _crc32c_many_mxu(bufs)]
         return self._cpu.crc32c_many(bufs)
+
+    def crc32_many(self, bufs: list[bytes]) -> list[int]:
+        """Legacy MsgVer0/1 zlib-poly CRC on the same MXU kernel (the
+        GF(2) decomposition is polynomial-agnostic; reference hot loop:
+        src/rdcrc32.c).
+
+        The crc32 Q-matrix + XLA compile cost seconds and the warmup
+        thread only pre-warms the (always-used) crc32c variant — so the
+        first legacy fetches serve from the CPU path while a background
+        thread compiles; the device route opens once it is ready.
+        Stalling the broker IO thread here would blow socket.timeout.ms
+        for in-flight requests."""
+        if len(bufs) >= self.min_batches and self._offload_pays():
+            if self._crc32_ready:
+                from .crc32c_jax import crc32_many_mxu
+                return [int(x) for x in crc32_many_mxu(bufs)]
+            self._warm_crc32()
+        return self._cpu.crc32_many(bufs)
+
+    def _warm_crc32(self) -> None:
+        if self._crc32_warming:
+            return
+        self._crc32_warming = True
+
+        def _warm():
+            try:
+                from .crc32c_jax import crc32_many_mxu
+                blk = b"\x00" * LZ4F_BLOCKSIZE
+                crc32_many_mxu([blk] * self.min_batches)
+                self._crc32_ready = True
+            except Exception:
+                pass        # CPU path keeps serving
+
+        import threading
+        threading.Thread(target=_warm, daemon=True,
+                         name="tpu-crc32-warmup").start()

@@ -523,6 +523,28 @@ def write_msgset_v01(msgs: Iterable[Record], *, magic: int, codec: Optional[str]
     return wrapper.as_bytes()
 
 
+def iter_legacy_crc_regions(data) -> list[tuple[int, int, bytes]]:
+    """[(offset, stored_crc, crc_region)] for each top-level message of
+    a legacy v0/v1 MessageSet. The per-message CRC (zlib polynomial,
+    reference src/rdcrc32.c) covers [Magic..end-of-message]; for a
+    compression wrapper that region includes the compressed payload, so
+    verifying top-level frames checks the whole wire blob. Partial
+    trailing messages are skipped (reference reader behavior)."""
+    out = []
+    data = bytes(data)
+    sl = Slice(data)
+    while sl.remains() >= 12:
+        offset = sl.read_i64()
+        size = sl.read_i32()
+        if size < 4 or sl.remains() < size:
+            break
+        start = sl.offset
+        crc = sl.read_u32()
+        out.append((offset, crc, data[start + 4:start + size]))
+        sl.skip(size - 4)
+    return out
+
+
 def parse_msgset_v01(data: bytes, decompress_fn=None) -> list[Record]:
     """Parse a legacy MessageSet, recursing into compression wrappers."""
     out: list[Record] = []

@@ -137,3 +137,7 @@ def crc32(data, crc: int = 0) -> int:
 #: (ops/crc_jax.py) which implements the same combine vectorized on TPU.
 ZERO_OP_CRC32C = np.array(_ZERO_OP_C, dtype=np.uint32)  # [64][32]
 TABLE_CRC32C = _TABLE8  # [8][256] uint32
+#: zlib-polynomial twins, for the legacy MsgVer0/1 per-message CRC
+#: (reference: src/rdcrc32.c) on the same MXU kernel.
+ZERO_OP_CRC32 = np.array(_ZERO_OP_Z, dtype=np.uint32)   # [64][32]
+TABLE_CRC32 = _make_table(0xEDB88320)                   # [256] uint32
