@@ -202,16 +202,20 @@ def main():
     n_msgs = int(os.environ.get("BENCH_MSGS", 40000))
     size = int(os.environ.get("BENCH_MSG_SIZE", 1024))
     toppars = int(os.environ.get("BENCH_TOPPARS", 16))
-    # median of 3: the shared host gives heavy run-to-run variance
-    host_rate = sorted(host_pipeline(n_msgs, size, toppars)
-                       for _ in range(3))[1]
+    # median of 3 per backend, INTERLEAVED cpu/tpu pairs: the shared
+    # host's load drifts minute-to-minute, and running the two backends
+    # in separate phases let that drift masquerade as a backend
+    # difference (observed both directions across driver runs).
     # backend=tpu must be >= cpu e2e: lz4 routes to the native CPU path
     # (tpu.lz4.force off) and the adaptive transport gate keeps CRC on
-    # CPU when host<->device bandwidth can't pay for the launch
-    # (same median-of-3 statistic as the cpu baseline)
-    tpu_backend_rate = sorted(
-        host_pipeline(n_msgs, size, toppars, backend="tpu")
-        for _ in range(3))[1]
+    # CPU when host<->device bandwidth can't pay for the launch.
+    cpu_rates, tpu_rates = [], []
+    for _ in range(3):
+        cpu_rates.append(host_pipeline(n_msgs, size, toppars))
+        tpu_rates.append(host_pipeline(n_msgs, size, toppars,
+                                       backend="tpu"))
+    host_rate = sorted(cpu_rates)[1]
+    tpu_backend_rate = sorted(tpu_rates)[1]
     off = codec_offload()
     print(json.dumps({
         "metric": "batched CRC32C codec offload, 128x64KB partition "

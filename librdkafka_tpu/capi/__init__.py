@@ -1,0 +1,14 @@
+"""librdkafka_tpu.capi — the C-callable binding surface.
+
+The reference ships a second-language binding (src-cpp/rdkafkacpp.h, a
+C++ wrapper over the C ABI). This package is the rebuild's equivalent
+in the opposite direction: a real C ABI (libtkafka.so + tkafka.h,
+built via cffi's embedding API) exporting producer/consumer entry
+points that drive the framework inside an embedded CPython — so C/C++
+applications can link against the TPU-native client the same way they
+link librdkafka today.
+
+Build:  python -m librdkafka_tpu.capi.build_capi  (writes libtkafka.so
+        + tkafka.h next to this file; tests/test_0115_capi.py compiles
+        and runs a real C program against it)
+"""

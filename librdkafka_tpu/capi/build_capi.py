@@ -1,0 +1,275 @@
+"""Build libtkafka.so — a C ABI over the framework via cffi embedding.
+
+API shape follows the reference's C surface in miniature
+(/root/reference/src/rdkafka.h: rd_kafka_new/producev/flush/
+consumer_poll/...), flattened to the handful of entry points a C app
+needs for produce/consume round trips. Configuration crosses the
+boundary as a JSON object string — the C caller never sees Python.
+"""
+from __future__ import annotations
+
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SO = os.path.join(HERE, "libtkafka.so")
+HEADER = os.path.join(HERE, "tkafka.h")
+
+TYPES = r"""
+typedef struct tk_msg {
+    char   *topic;      /* owned by the message; freed by tk_msg_free */
+    int32_t partition;
+    int64_t offset;
+    int64_t timestamp;  /* ms since epoch, -1 if unset */
+    char   *key;        /* NULL when the record has no key */
+    size_t  key_len;
+    char   *payload;    /* NULL only for null-value records */
+    size_t  len;
+    int     err;        /* 0 = ok */
+} tk_msg_t;
+
+/* Handles are opaque integers (0 = error; details in errstr). */
+typedef long long tk_handle_t;
+"""
+
+FUNCS = r"""
+extern tk_handle_t tk_producer_new(const char *conf_json,
+                                   char *errstr, int errstr_size);
+extern tk_handle_t tk_consumer_new(const char *conf_json,
+                                   char *errstr, int errstr_size);
+extern int  tk_produce(tk_handle_t h, const char *topic, int32_t partition,
+                       const char *key, size_t key_len,
+                       const char *payload, size_t len);
+extern int  tk_flush(tk_handle_t h, int timeout_ms);
+extern int  tk_subscribe(tk_handle_t h, const char *topics_csv);
+extern int  tk_consumer_poll(tk_handle_t h, int timeout_ms, tk_msg_t *out);
+extern void tk_msg_free(tk_msg_t *m);
+extern int  tk_mock_bootstrap(tk_handle_t h, char *buf, int size);
+extern void tk_destroy(tk_handle_t h);
+"""
+
+CDEF = TYPES + FUNCS
+
+INIT = r"""
+import json
+import threading
+
+from librdkafka_tpu import Producer, Consumer
+from tkafka_cffi import ffi  # noqa: F401  (the cffi embedding module)
+
+_handles = {}
+_next = [1]
+_lock = threading.Lock()
+
+
+def _register(obj):
+    with _lock:
+        h = _next[0]
+        _next[0] += 1
+        _handles[h] = obj
+    return h
+
+
+def _fail(errstr, errstr_size, exc):
+    msg = str(exc).encode()[: max(0, errstr_size - 1)]
+    if errstr != ffi.NULL and errstr_size > 0:
+        buf = ffi.buffer(errstr, errstr_size)
+        buf[: len(msg)] = msg
+        buf[len(msg)] = b"\0"
+    return 0
+
+
+@ffi.def_extern()
+def tk_producer_new(conf_json, errstr, errstr_size):
+    try:
+        conf = json.loads(ffi.string(conf_json).decode())
+        return _register(Producer(conf))
+    except Exception as e:
+        return _fail(errstr, errstr_size, e)
+
+
+@ffi.def_extern()
+def tk_consumer_new(conf_json, errstr, errstr_size):
+    try:
+        conf = json.loads(ffi.string(conf_json).decode())
+        return _register(Consumer(conf))
+    except Exception as e:
+        return _fail(errstr, errstr_size, e)
+
+
+@ffi.def_extern()
+def tk_produce(h, topic, partition, key, key_len, payload, length):
+    p = _handles.get(h)
+    if p is None:
+        return -1
+    try:
+        p.produce(ffi.string(topic).decode(),
+                  value=bytes(ffi.buffer(payload, length))
+                  if payload != ffi.NULL else None,
+                  key=bytes(ffi.buffer(key, key_len))
+                  if key != ffi.NULL else None,
+                  partition=partition)
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_flush(h, timeout_ms):
+    p = _handles.get(h)
+    if p is None:
+        return -1
+    try:
+        return int(p.flush(timeout_ms / 1000.0))
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_subscribe(h, topics_csv):
+    c = _handles.get(h)
+    if c is None:
+        return -1
+    try:
+        c.subscribe([t.strip() for t
+                     in ffi.string(topics_csv).decode().split(",")
+                     if t.strip()])
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_consumer_poll(h, timeout_ms, out):
+    # 1 = message filled into out, 0 = nothing, <0 = error.
+    # The caller's stack struct starts as garbage: initialize EVERY
+    # field before any early return.
+    out.err = 0
+    out.topic = ffi.NULL
+    out.key = ffi.NULL
+    out.payload = ffi.NULL
+    out.key_len = 0
+    out.len = 0
+    out.partition = -1
+    out.offset = -1
+    out.timestamp = -1
+    c = _handles.get(h)
+    if c is None:
+        return -1
+    try:
+        m = c.poll(timeout_ms / 1000.0)
+    except Exception:
+        return -2        # cffi's default-0 would read as "no message"
+    if m is None:
+        return 0
+    if m.error is not None:
+        out.err = int(m.error.code)
+        return 1
+    t = (m.topic or "").encode()
+    out.topic = lib_strdup(t)
+    out.partition = m.partition
+    out.offset = m.offset
+    out.timestamp = m.timestamp if m.timestamp else -1
+    if m.key is None:
+        out.key = ffi.NULL
+        out.key_len = 0
+    else:
+        out.key = lib_memdup(m.key)
+        out.key_len = len(m.key)
+    if m.value is None:
+        out.payload = ffi.NULL
+        out.len = 0
+    else:
+        out.payload = lib_memdup(m.value)
+        out.len = len(m.value)
+    return 1
+
+
+_allocs = {}
+
+
+def lib_memdup(b):
+    buf = ffi.new("char[]", bytes(b))
+    _allocs[int(ffi.cast("intptr_t", buf))] = buf
+    return buf
+
+
+def lib_strdup(b):
+    buf = ffi.new("char[]", bytes(b) + b"\0")
+    _allocs[int(ffi.cast("intptr_t", buf))] = buf
+    return buf
+
+
+def _release(ptr):
+    if ptr != ffi.NULL:
+        _allocs.pop(int(ffi.cast("intptr_t", ptr)), None)
+
+
+@ffi.def_extern()
+def tk_msg_free(m):
+    _release(m.topic)
+    _release(m.key)
+    _release(m.payload)
+    m.topic = m.key = m.payload = ffi.NULL
+
+
+@ffi.def_extern()
+def tk_mock_bootstrap(h, buf, size):
+    # bootstrap.servers of the handle's in-process mock cluster
+    # (test.mock.num.brokers), for wiring a second client to it
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    cluster = getattr(obj._rk, "mock_cluster", None)
+    if cluster is None:
+        return -1
+    bs = cluster.bootstrap_servers().encode()
+    if len(bs) + 1 > size:
+        return -1
+    b = ffi.buffer(buf, size)
+    b[: len(bs)] = bs
+    b[len(bs)] = b"\0"
+    return len(bs)
+
+
+@ffi.def_extern()
+def tk_destroy(h):
+    obj = _handles.pop(h, None)
+    if obj is not None:
+        try:
+            obj.close()
+        except Exception:
+            pass
+"""
+
+HEADER_TEXT = (
+    "/* tkafka.h — C API for the librdkafka_tpu framework\n"
+    " * (the rebuild's src-cpp/ equivalent: a second-language binding\n"
+    " * over the same core; reference surface: src/rdkafka.h).\n"
+    " * Link: -ltkafka  (plus the embedded CPython the .so carries). */\n"
+    "#pragma once\n"
+    "#include <stdint.h>\n"
+    "#include <stddef.h>\n"
+    "#ifdef __cplusplus\nextern \"C\" {\n#endif\n"
+    + CDEF +
+    "#ifdef __cplusplus\n}\n#endif\n")
+
+
+def build(force: bool = False) -> str:
+    if not force and os.path.exists(SO) and os.path.exists(HEADER) \
+            and os.path.getmtime(SO) >= os.path.getmtime(__file__) \
+            and os.path.getmtime(HEADER) >= os.path.getmtime(__file__):
+        return SO
+    import cffi
+    ffibuilder = cffi.FFI()
+    ffibuilder.embedding_api(CDEF)
+    # the cdef'd types must exist in the generated C too
+    ffibuilder.set_source("tkafka_cffi", TYPES)
+    ffibuilder.embedding_init_code(INIT)
+    ffibuilder.compile(tmpdir=HERE, target=SO, verbose=False)
+    with open(HEADER, "w") as f:
+        f.write(HEADER_TEXT)
+    return SO
+
+
+if __name__ == "__main__":
+    print(build(force=True))
