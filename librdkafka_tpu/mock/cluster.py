@@ -44,6 +44,10 @@ class MockPartition:
     log: list[tuple[int, bytes]] = field(default_factory=list)
     # idempotence: (pid, epoch) -> next expected base sequence
     pid_seqs: dict[tuple[int, int], int] = field(default_factory=dict)
+    # size-based retention (real brokers: log.retention.bytes); 0 = keep
+    # everything. Oldest batches are dropped and start_offset advances.
+    retention_bytes: int = 0
+    log_bytes: int = 0
 
     def append(self, blob: bytes) -> int:
         """Append a produced MessageSet verbatim; returns assigned base
@@ -68,7 +72,13 @@ class MockPartition:
                 count += 1
             count = max(count, 1)
         self.log.append((base, blob))
+        self.log_bytes += len(blob)
         self.end_offset = base + count
+        if self.retention_bytes > 0:
+            while len(self.log) > 1 and self.log_bytes > self.retention_bytes:
+                _old_base, old_blob = self.log.pop(0)
+                self.log_bytes -= len(old_blob)
+                self.start_offset = self.log[0][0]
         return base
 
     def read_from(self, offset: int, max_bytes: int) -> bytes:
@@ -138,7 +148,8 @@ class MockCluster:
                  auto_create_topics: bool = True, default_partitions: int = 4,
                  tls: Optional[dict] = None,
                  sasl_users: Optional[dict] = None,
-                 broker_version: Optional[str] = None):
+                 broker_version: Optional[str] = None,
+                 retention_bytes: int = 0):
         """``tls``: enable the TLS listener mode —
         ``{"certfile": ..., "keyfile": ..., "cafile": ...,
         "require_client_cert": bool}``. All mock brokers then speak TLS
@@ -167,6 +178,9 @@ class MockCluster:
                 tls.get("require_client_cert", False))
         self.auto_create_topics = auto_create_topics
         self.default_partitions = default_partitions
+        # per-partition size retention for long-running/benchmark use
+        # (real brokers: log.retention.bytes); 0 keeps everything
+        self.retention_bytes = retention_bytes
         self.topics: dict[str, list[MockPartition]] = {}
         self.groups: dict[str, MockGroup] = {}
         self.cluster_id = "mockCluster"
@@ -218,11 +232,14 @@ class MockCluster:
             if name in self.topics:
                 return
             n = partitions or self.default_partitions
-            self.topics[name] = [
-                MockPartition(topic=name, id=i,
-                              leader=(i % self.num_brokers) + 1,
-                              replicas=[(i % self.num_brokers) + 1])
-                for i in range(n)]
+            self.topics[name] = [self._new_partition(name, i)
+                                 for i in range(n)]
+
+    def _new_partition(self, topic: str, i: int) -> MockPartition:
+        return MockPartition(topic=topic, id=i,
+                             leader=(i % self.num_brokers) + 1,
+                             replicas=[(i % self.num_brokers) + 1],
+                             retention_bytes=self.retention_bytes)
 
     def partition(self, topic: str, part: int) -> MockPartition:
         return self.topics[topic][part]
@@ -964,10 +981,7 @@ class MockCluster:
                 else:
                     parts = self.topics[t["topic"]]
                     for i in range(len(parts), t["count"]):
-                        parts.append(MockPartition(
-                            topic=t["topic"], id=i,
-                            leader=(i % self.num_brokers) + 1,
-                            replicas=[(i % self.num_brokers) + 1]))
+                        parts.append(self._new_partition(t["topic"], i))
                     err = Err.NO_ERROR
                 out.append({"topic": t["topic"], "error_code": err.wire,
                             "error_message": None})

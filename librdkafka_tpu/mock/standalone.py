@@ -1,0 +1,56 @@
+"""Run the mock cluster as a standalone process.
+
+    python -m librdkafka_tpu.mock.standalone [--brokers N]
+        [--partitions N] [--topic NAME:PARTS ...]
+
+Prints ``bootstrap.servers`` on the first stdout line, then serves
+until killed (or until --seconds elapses). This is how external
+processes — the reference's rdkafka_performance in the interop tier,
+the benchmark's producer, or any client under test — get a broker that
+does NOT share the client's GIL/process (the role a real Kafka broker
+plays for the reference's test rig)."""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from .cluster import MockCluster
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--brokers", type=int, default=1)
+    ap.add_argument("--partitions", type=int, default=4)
+    ap.add_argument("--topic", action="append", default=[],
+                    metavar="NAME:PARTS")
+    ap.add_argument("--seconds", type=float, default=0,
+                    help="exit after this long (0 = run until killed)")
+    ap.add_argument("--retention-mb", type=int, default=0,
+                    help="per-partition log retention cap in MB "
+                         "(0 = unbounded)")
+    args = ap.parse_args(argv)
+
+    topics = {}
+    for spec in args.topic:
+        name, _, parts = spec.partition(":")
+        topics[name] = int(parts or args.partitions)
+
+    cluster = MockCluster(num_brokers=args.brokers,
+                          topics=topics or None,
+                          default_partitions=args.partitions,
+                          retention_bytes=args.retention_mb << 20)
+    print(cluster.bootstrap_servers(), flush=True)
+    try:
+        deadline = time.monotonic() + args.seconds if args.seconds else None
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
