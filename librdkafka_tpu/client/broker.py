@@ -220,6 +220,7 @@ class Broker:
         self.toppars: set = set()           # toppars led by this broker
         self._lock = threading.Lock()
         self.ts_connected = 0.0
+        self.ts_state = time.monotonic()    # last state CHANGE (stats)
         # stats
         self.c_tx = self.c_rx = self.c_tx_bytes = self.c_rx_bytes = 0
         self.c_connects = 0             # connection attempts (stats)
@@ -539,6 +540,7 @@ class Broker:
         if self.state != st:
             self.rk.dbg("broker", f"{self.name}: {self.state.value} -> {st.value}")
             self.state = st
+            self.ts_state = time.monotonic()   # stats: time in state
 
     # ------------------------------------------------------------ xmit/IO --
     def _next_corrid(self) -> int:

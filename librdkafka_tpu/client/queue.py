@@ -146,6 +146,10 @@ class OpQueue:
 
     def __len__(self) -> int:
         with self._lock:
+            # follow forwarding like rd_kafka_q_len (rkq_fwdq chain):
+            # a forwarded queue's ops live in its destination
+            if self._fwd is not None:
+                return len(self._fwd)
             return len(self._items)
 
 

@@ -70,6 +70,10 @@ class StatsCollector:
         for b in list(rk.brokers.values()):
             brokers[b.name] = {
                 "name": b.name, "nodeid": b.nodeid, "state": b.state.value,
+                "stateage": int((time.monotonic() - b.ts_state) * 1e6),
+                "connects": b.c_connects,
+                "outbuf_cnt": len(b._unsent_req_ends),
+                "waitresp_cnt": len(b.waitresp),
                 "tx": b.c_tx, "txbytes": b.c_tx_bytes,
                 "rx": b.c_rx, "rxbytes": b.c_rx_bytes,
                 "req_timeouts": b.c_req_timeouts,
@@ -84,11 +88,19 @@ class StatsCollector:
         topics = {}
         for (t, p), tp in list(rk._toppars.items()):
             topics.setdefault(t, {"topic": t, "partitions": {}})
+            # reference lag (rdkafka.c:1283-1297): end_offset (ls under
+            # read_committed) minus MAX(app, committed), clamped >= 0
+            end = (tp.ls_offset if rk.conf.get("isolation.level")
+                   == "read_committed" and tp.ls_offset >= 0
+                   else tp.hi_offset)
+            base = max(tp.app_offset, tp.committed_offset)
+            lag = max(0, end - base) if end >= 0 and base >= 0 else -1
             topics[t]["partitions"][str(p)] = {
                 "partition": p, "leader": tp.leader_id,
                 "msgq_cnt": (len(tp.msgq)
                              + (len(tp.arena) if tp.arena is not None
                                 else 0)),
+                "msgq_bytes": tp.msgq_bytes,
                 "xmit_msgq_cnt": len(tp.xmit_msgq),
                 "fetchq_cnt": tp.fetchq_cnt,
                 "fetch_state": tp.fetch_state.value,
@@ -96,6 +108,8 @@ class StatsCollector:
                 "stored_offset": tp.stored_offset,
                 "committed_offset": tp.committed_offset,
                 "hi_offset": tp.hi_offset,
+                "ls_offset": tp.ls_offset,
+                "consumer_lag": lag,
             }
         blob = {
             "name": rk.conf.get("client.id"),
@@ -104,8 +118,17 @@ class StatsCollector:
             "ts": int(time.time() * 1e6),
             "time": int(time.time()),
             "age": int((time.time() - self.ts_start) * 1e6),
+            "replyq": len(rk.rep),
             "msg_cnt": rk.msg_cnt,
+            "msg_size": rk.msg_bytes,
             "msg_max": rk.conf.get("queue.buffering.max.messages"),
+            "msg_size_max":
+                rk.conf.get("queue.buffering.max.kbytes") * 1024,
+            "tx": sum(b["tx"] for b in brokers.values()),
+            "tx_bytes": sum(b["txbytes"] for b in brokers.values()),
+            "rx": sum(b["rx"] for b in brokers.values()),
+            "rx_bytes": sum(b["rxbytes"] for b in brokers.values()),
+            "metadata_cache_cnt": len(rk.metadata.get("topics", {})),
             "txmsgs": self.c_tx_msgs, "rxmsgs": self.c_rx_msgs,
             "int_latency": self.int_latency.rollover(),
             "codec_latency": self.codec_latency.rollover(),

@@ -103,3 +103,47 @@ def test_stats_blob_latency_decomposition():
     assert with_rtt, "no broker rtt samples recorded"
     br = next(br for br in best["brokers"].values())
     assert "outbuf_latency" in br and "throttle" in br
+
+
+def test_stats_schema_fields():
+    """The emitted blob must carry the STATISTICS.md top-level, broker,
+    and partition fields (reference schema: STATISTICS.md:50-150)."""
+    import json
+    import time as _time
+
+    from librdkafka_tpu import Producer
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"st": 2})
+    blobs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "statistics.interval.ms": 100, "linger.ms": 5,
+                  "stats_cb": lambda s: blobs.append(json.loads(s))})
+    p.produce("st", value=b"schema", partition=0)
+    assert p.flush(10.0) == 0
+    deadline = _time.monotonic() + 5
+    while not blobs and _time.monotonic() < deadline:
+        p.poll(0.1)
+    p.close()
+    cluster.stop()
+    assert blobs
+    s = blobs[-1]
+    for field in ("name", "client_id", "type", "ts", "time", "age",
+                  "replyq", "msg_cnt", "msg_size", "msg_max",
+                  "msg_size_max", "tx", "tx_bytes", "rx", "rx_bytes",
+                  "metadata_cache_cnt", "txmsgs", "rxmsgs", "brokers",
+                  "topics"):
+        assert field in s, field
+    assert s["tx"] > 0 and s["rx"] > 0
+    b = next(iter(s["brokers"].values()))
+    for field in ("name", "nodeid", "state", "stateage", "connects",
+                  "outbuf_cnt", "waitresp_cnt", "tx", "txbytes", "rx",
+                  "rxbytes", "req_timeouts", "rtt", "outbuf_latency",
+                  "throttle", "toppars"):
+        assert field in b, field
+    tp = s["topics"]["st"]["partitions"]["0"]
+    for field in ("partition", "leader", "msgq_cnt", "msgq_bytes",
+                  "xmit_msgq_cnt", "fetchq_cnt", "fetch_state",
+                  "app_offset", "stored_offset", "committed_offset",
+                  "hi_offset", "ls_offset", "consumer_lag"):
+        assert field in tp, field

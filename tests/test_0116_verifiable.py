@@ -1,0 +1,73 @@
+"""Verifiable-client protocol (reference:
+examples/kafkatest_verifiable_client.cpp — the ducktape system-test
+client): both modes run as real subprocesses against a standalone mock
+broker process, and the emitted JSON protocol lines are validated."""
+import json
+import os
+import select
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLIENT = os.path.join(REPO, "examples", "verifiable_client.py")
+
+
+@pytest.fixture(scope="module")
+def mock_proc():
+    child = subprocess.Popen(
+        [sys.executable, "-m", "librdkafka_tpu.mock.standalone",
+         "--brokers", "1", "--topic", "vt:2"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO)
+    # guard the address read: a hung child must fail the fixture, not
+    # block the whole pytest session
+    ready, _, _ = select.select([child.stdout], [], [], 30)
+    bs = child.stdout.readline().strip() if ready else ""
+    if not bs:
+        child.kill()
+        pytest.fail("standalone mock failed to start: "
+                    + (child.stderr.read() or "")[-500:])
+    yield bs
+    child.kill()
+
+
+def _run(args, timeout=90):
+    r = subprocess.run(
+        [sys.executable, CLIENT, *args], cwd=REPO,
+        capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, r.stderr[-800:]
+    return [json.loads(line) for line in r.stdout.splitlines()
+            if line.strip()]
+
+
+def test_verifiable_producer(mock_proc):
+    lines = _run(["--producer", "--topic", "vt", "--max-messages", "300",
+                  "--bootstrap-server", mock_proc])
+    names = [l["name"] for l in lines]
+    assert names[0] == "startup_complete"
+    assert names[-1] == "shutdown_complete"
+    acks = [l for l in lines if l["name"] == "producer_send_success"]
+    assert len(acks) == 300
+    assert {a["topic"] for a in acks} == {"vt"}
+    tool = next(l for l in lines if l["name"] == "tool_data")
+    assert tool["sent"] == tool["acked"] == 300
+
+
+def test_verifiable_consumer(mock_proc):
+    lines = _run(["--consumer", "--topic", "vt", "--max-messages", "300",
+                  "--group-id", "vg", "--bootstrap-server", mock_proc,
+                  "--commit-interval-ms", "300"])
+    names = [l["name"] for l in lines]
+    assert names[0] == "startup_complete"
+    assert names[-1] == "shutdown_complete"
+    assert "partitions_assigned" in names
+    consumed = [l for l in lines if l["name"] == "records_consumed"]
+    assert consumed and consumed[-1]["_totcount"] == 300
+    # per-partition min/max offsets must be coherent
+    for batch in consumed:
+        for p in batch["partitions"]:
+            assert 0 <= p["minOffset"] <= p["maxOffset"]
+    commits = [l for l in lines if l["name"] == "offsets_committed"]
+    assert commits and all(c["success"] for c in commits)

@@ -169,3 +169,51 @@ def test_uncompressed_wire_bytes_bit_identical(cluster):
             (base, count, len(ours), len(ref_blob)))
         total += count
     assert total == len(msgs)
+
+
+def test_ref_idempotent_producer_sequences(cluster):
+    """Real librdkafka idempotent producer (InitProducerId + per-batch
+    BaseSequence, reference rdkafka_idempotence.c) against our mock's
+    sequence bookkeeping, read back by our consumer."""
+    rp = refclient.RefProducer(
+        cluster.bootstrap_servers(),
+        **{"enable.idempotence": "true", "linger.ms": "10",
+           "batch.num.messages": "50"})
+    for i in range(300):
+        rp.produce("interop", i % 2, b"idem-%03d" % i,
+                   timestamp_ms=BASE_TS + i)
+    assert rp.flush() == 0
+    rp.close()
+
+    # the mock recorded a real PID and contiguous sequences
+    for part in (0, 1):
+        mp = cluster.partition("interop", part)
+        assert mp.pid_seqs, "no idempotent sequence state recorded"
+        (pid_epoch, next_seq), = mp.pid_seqs.items()
+        assert pid_epoch[0] >= 1          # broker-assigned PID
+        assert next_seq == sum(1 for i in range(300) if i % 2 == part)
+
+    got = _our_consume(cluster, "interop", 300)
+    assert len(got) == 300
+    assert {m.value for m in got} == {b"idem-%03d" % i for i in range(300)}
+
+
+def test_our_producer_to_ref_perf_consumer(cluster):
+    """Our producer's wire data consumed by the reference's
+    rdkafka_performance -C binary (simple consumer over both
+    partitions), count-verified from its stdout."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 10, "compression.codec": "snappy"})
+    for i in range(500):
+        p.produce("interop", value=b"perfc-%04d" % i, partition=i % 2)
+    assert p.flush(15.0) == 0
+    p.close()
+
+    r = subprocess.run(
+        [refclient.PERF_BIN, "-C", "-t", "interop", "-p", "0", "-p", "1",
+         "-b", cluster.bootstrap_servers(), "-o", "beginning",
+         "-c", "500", "-X", "socket.timeout.ms=5000"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "500 messages" in r.stdout or "500 msgs" in r.stdout, \
+        r.stdout[-500:]
