@@ -23,7 +23,8 @@ import signal
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from librdkafka_tpu import Consumer, Producer  # noqa: E402
 
@@ -56,8 +57,15 @@ def do_producer(args):
     out({"name": "startup_complete"})
     interval = 1.0 / args.throughput if args.throughput > 0 else 0
     sent = 0
-    while run and sent < args.max_messages:
-        p.produce(args.topic, value=str(sent).encode())
+    # --max-messages < 0 = unlimited (until SIGTERM), like the consumer
+    while run and (args.max_messages < 0 or sent < args.max_messages):
+        try:
+            p.produce(args.topic, value=str(sent).encode())
+        except Exception:
+            # local queue full: serve delivery reports and retry
+            # (the reference verifiable client does the same)
+            p.poll(0.1)
+            continue
         sent += 1
         p.poll(0)
         if interval:

@@ -133,6 +133,20 @@ PROPERTIES: list[Prop] = [
        "Enable builtin unsecured JWT handler."),
     _p("sasl.kerberos.service.name", GLOBAL, "str", "kafka", "Kerberos service name."),
     _p("sasl.kerberos.principal", GLOBAL, "str", "kafkaclient", "Client principal."),
+    _p("sasl.kerberos.kinit.cmd", GLOBAL, "str",
+       'kinit -R -t "%{sasl.kerberos.keytab}" -k %{sasl.kerberos.principal}'
+       ' || kinit -t "%{sasl.kerberos.keytab}" -k'
+       ' %{sasl.kerberos.principal}',
+       "Shell command refreshing/acquiring the client's Kerberos ticket; "
+       "run at client creation and every "
+       "sasl.kerberos.min.time.before.relogin ms. %{prop} expands to "
+       "config values."),
+    _p("sasl.kerberos.keytab", GLOBAL, "str", "",
+       "Kerberos keytab path (used via %{sasl.kerberos.keytab} in "
+       "sasl.kerberos.kinit.cmd)."),
+    _p("sasl.kerberos.min.time.before.relogin", GLOBAL, "int", 60000,
+       "Minimum ms between Kerberos ticket refreshes; 0 disables.",
+       vmin=0, vmax=86400000),
     # ---- global: plugins/interceptors ----
     _p("plugin.library.paths", GLOBAL, "str", "",
        "List of plugin libraries/modules to load (module:... python entry points)."),

@@ -253,8 +253,11 @@ class Kafka:
         # misconfigured mechanism fails fast (reference: rd_kafka_new
         # sasl checks, rdkafka.c:~2000)
         if self.sasl_required():
-            from .sasl import validate_mechanism
+            from .sasl import kinit_setup, validate_mechanism
             validate_mechanism(conf)
+            # GSSAPI: run sasl.kerberos.kinit.cmd now + on the relogin
+            # timer (reference: rd_kafka_sasl_cyrus_kinit_refresh)
+            kinit_setup(self)
 
         from .stats import StatsCollector
         self.stats = StatsCollector(self)

@@ -358,3 +358,55 @@ class GssapiClient:
             self._ssf_done = True
             return self.ctx.wrap(resp, False).message
         return None                  # outcome arrives as error_code
+
+
+def render_conf_template(conf, template: str) -> str:
+    """Replace ``%{config.prop.name}`` with the property's value
+    (reference: rd_string_render used by the kinit cmd,
+    rdkafka_sasl_cyrus.c:206)."""
+    import re
+
+    def sub(m):
+        try:
+            v = conf.get(m.group(1))
+        except Exception:
+            return ""
+        return "" if v is None else str(v)
+
+    return re.sub(r"%\{([^}]+)\}", sub, template)
+
+
+def kinit_setup(rk: "Kafka") -> None:
+    """Execute sasl.kerberos.kinit.cmd at client creation and then every
+    sasl.kerberos.min.time.before.relogin ms (0 disables the timer) —
+    the ticket-refresh loop of the reference's cyrus provider
+    (rdkafka_sasl_cyrus.c:193-260, kinit_refresh_tmr). Only active for
+    the GSSAPI mechanism; failures log ERROR and auth proceeds (the
+    ccache may still hold a valid ticket)."""
+    mech = rk.conf.get("sasl.mechanisms").upper()
+    if mech not in ("GSSAPI", "KERBEROS"):
+        return
+    cmd_tmpl = rk.conf.get("sasl.kerberos.kinit.cmd")
+    if not cmd_tmpl:
+        return
+
+    def refresh():
+        import subprocess
+        cmd = render_conf_template(rk.conf, cmd_tmpl)
+        try:
+            r = subprocess.run(["/bin/sh", "-c", cmd],
+                               capture_output=True, text=True, timeout=60)
+        except Exception as e:
+            rk.log("ERROR", f"kinit execution failed: {e}")
+            return
+        if r.returncode != 0:
+            rk.log("ERROR",
+                   f"kinit returned {r.returncode}: "
+                   f"{(r.stderr or r.stdout).strip()[:256]}")
+        else:
+            rk.dbg("security", f"kinit refreshed: {cmd}")
+
+    refresh()
+    interval_ms = rk.conf.get("sasl.kerberos.min.time.before.relogin")
+    if interval_ms > 0:
+        rk.timers.add(interval_ms / 1000.0, refresh)

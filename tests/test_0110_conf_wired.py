@@ -27,6 +27,10 @@ ALLOWED_UNREAD = {
     # fall-through + Conf.topic_conf()); all external access goes
     # through those methods, never the literal name
     "default_topic_conf",
+    # consumed dynamically: the default sasl.kerberos.kinit.cmd template
+    # expands %{sasl.kerberos.keytab} via render_conf_template (the
+    # reference uses it the same way, rdkafka_conf.c keytab row)
+    "sasl.kerberos.keytab",
 }
 
 
