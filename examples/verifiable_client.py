@@ -27,6 +27,7 @@ import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from librdkafka_tpu import Consumer, Producer  # noqa: E402
+from librdkafka_tpu.client.errors import Err, KafkaException  # noqa: E402
 
 run = True
 
@@ -61,7 +62,9 @@ def do_producer(args):
     while run and (args.max_messages < 0 or sent < args.max_messages):
         try:
             p.produce(args.topic, value=str(sent).encode())
-        except Exception:
+        except KafkaException as e:
+            if e.error.code != Err._QUEUE_FULL:
+                raise       # fatal produce errors must surface, not spin
             # local queue full: serve delivery reports and retry
             # (the reference verifiable client does the same)
             p.poll(0.1)

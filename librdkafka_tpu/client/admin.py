@@ -538,14 +538,17 @@ class AdminClient:
         """Synchronous metadata snapshot: {topic: {partition: leader}}
         (reference rd_kafka_metadata)."""
         deadline = time.monotonic() + timeout
+        t0 = time.monotonic()
         self._rk.metadata_refresh("list_topics")
         while time.monotonic() < deadline:
-            md = self._rk.metadata
-            if md.get("topics") or md.get("brokers"):
-                if not self._rk._metadata_inflight:
-                    return {"brokers": dict(md["brokers"]),
-                            "controller_id": md.get("controller_id", -1),
-                            "topics": {t: dict(ps)
-                                       for t, ps in md["topics"].items()}}
+            # wait for a FULL refresh that completed at/after this call
+            # — an older in-flight (possibly partial) refresh finishing
+            # must not satisfy it with a stale snapshot
+            if self._rk._metadata_full_ts >= t0:
+                md = self._rk.metadata
+                return {"brokers": dict(md["brokers"]),
+                        "controller_id": md.get("controller_id", -1),
+                        "topics": {t: dict(ps)
+                                   for t, ps in md["topics"].items()}}
             time.sleep(0.02)
         raise KafkaException(Err._TIMED_OUT, "metadata not available")

@@ -1191,7 +1191,12 @@ class Broker:
         from .partition import FetchState
         fetch_parts = []
         for tp in list(self.toppars):
-            if tp.leader_id != self.nodeid or tp.paused:
+            # KIP-392: a delegated partition fetches from its follower;
+            # everyone else fetches from the leader
+            fetch_node = (tp.fetch_broker_id
+                          if tp.fetch_broker_id is not None
+                          else tp.leader_id)
+            if fetch_node != self.nodeid or tp.paused:
                 continue
             if tp.fetch_in_flight:
                 continue
@@ -1222,6 +1227,9 @@ class Broker:
             "max_bytes": rk.conf.get("fetch.max.bytes"),
             "isolation_level": 1 if rk.conf.get("isolation.level") ==
                                "read_committed" else 0,
+            # v11+ (KIP-392): our rack lets the broker nominate a
+            # same-rack follower via preferred_read_replica
+            "rack_id": rk.conf.get("client.rack"),
             "topics": [{"topic": t, "partitions": [
                 {"partition": tp.partition, "fetch_offset": tp.fetch_offset,
                  "max_bytes": rk.conf.get("fetch.message.max.bytes")}
@@ -1230,7 +1238,7 @@ class Broker:
         for tp in fetch_parts:
             tp.fetch_in_flight = True
         versions = {(tp.topic, tp.partition): tp.version for tp in fetch_parts}
-        fetch_ver = pick_version(self.api_versions, ApiKey.Fetch, 4)
+        fetch_ver = pick_version(self.api_versions, ApiKey.Fetch, 11)
         self._xmit(Request(ApiKey.Fetch, body, version=fetch_ver,
                            cb=lambda err, resp, parts=fetch_parts:
                            self._handle_fetch(err, resp, versions, parts)))
@@ -1288,6 +1296,16 @@ class Broker:
         for tp in parts:
             tp.fetch_in_flight = False
         if err is not None:
+            # a failed fetch to a FOLLOWER falls back to the leader
+            # (reference reverts the preferred replica on errors) —
+            # WITH backoff, or transport errors would ping-pong the
+            # partition between brokers at error rate
+            backoff = time.monotonic() + \
+                self.rk.conf.get("fetch.error.backoff.ms") / 1000.0
+            for tp in parts:
+                if tp.fetch_broker_id is not None:
+                    tp.fetch_backoff_until = backoff
+                    self.rk.revoke_fetch_delegation(tp, f"fetch: {err}")
             return
         rk = self.rk
         from .partition import FetchState
@@ -1313,6 +1331,12 @@ class Broker:
                     continue  # stale (seek/rebalance since request)
                 ec = Err.from_wire(p["error_code"])
                 if ec == Err.NO_ERROR:
+                    # v11 KIP-392: the leader may nominate a follower;
+                    # move this partition's fetching there (the
+                    # redirect response itself carries no records)
+                    pref = p.get("preferred_read_replica", -1)
+                    if pref != -1 and pref != self.nodeid:
+                        rk.delegate_fetch(tp, pref)
                     tp.hi_offset = p["high_watermark"]
                     tp.ls_offset = p.get("last_stable_offset",
                                          p["high_watermark"])
@@ -1325,16 +1349,31 @@ class Broker:
                              info.base_offset + info.last_offset_delta, full]
                             for info, payload, full in iter_batches(blob)]
                     ok.append((tp, p, batches, tp.fetch_offset, tp.version))
+                elif ec == Err.OFFSET_OUT_OF_RANGE \
+                        and tp.fetch_broker_id is not None:
+                    # a lagging follower, not a truncated log: retry
+                    # from the leader before any offset reset
+                    # (reference: rd_kafka_fetch_reply OUT_OF_RANGE on
+                    # preferred replica → revert, no reset) — with
+                    # backoff so a still-lagging follower can't
+                    # ping-pong the partition at RTT rate
+                    tp.fetch_backoff_until = time.monotonic() + \
+                        rk.conf.get("fetch.error.backoff.ms") / 1000.0
+                    rk.revoke_fetch_delegation(tp, "follower out of range")
                 elif ec == Err.OFFSET_OUT_OF_RANGE:
                     rk.offset_reset(tp, f"fetch offset {tp.fetch_offset} out of range")
                 elif ec in (Err.NOT_LEADER_FOR_PARTITION,
                             Err.UNKNOWN_TOPIC_OR_PART,
                             Err.LEADER_NOT_AVAILABLE,
                             Err.FENCED_LEADER_EPOCH):
+                    if tp.fetch_broker_id is not None:
+                        rk.revoke_fetch_delegation(tp, ec.name)
                     rk.metadata_refresh(reason=f"fetch error {ec.name}")
                     tp.fetch_backoff_until = time.monotonic() + \
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
                 else:
+                    if tp.fetch_broker_id is not None:
+                        rk.revoke_fetch_delegation(tp, ec.name)
                     tp.fetch_backoff_until = time.monotonic() + \
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
         if not ok:

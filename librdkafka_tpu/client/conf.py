@@ -51,6 +51,9 @@ PROPERTIES: list[Prop] = [
        "gzip,snappy,lz4,zstd,ssl,sasl,regex,mocks,tpu-codec",
        "Indicates builtin features for this build."),
     _p("client.id", GLOBAL, "str", "rdkafka", "Client identifier."),
+    _p("client.rack", GLOBAL, "str", "",
+       "Rack identifier sent in Fetch v11+ (KIP-392): brokers may "
+       "redirect this consumer to a same-rack follower replica."),
     _p("bootstrap.servers", GLOBAL, "str", "", "Initial list of brokers host:port,..."),
     _p("metadata.broker.list", GLOBAL, "str", "", "Alias for bootstrap.servers.",
        alias="bootstrap.servers"),

@@ -115,6 +115,12 @@ class Consumer:
         """Start/stop fetchers to match the assignment (reference:
         rd_kafka_cgrp_assign → toppar OP_FETCH_START)."""
         rk = self._rk
+        # generation stamp: an async committed-offset lookup from an
+        # OLDER apply_assignment call must not touch fetch state after
+        # an unassign/reassign bounce superseded it (it could resurrect
+        # an outdated committed offset and re-deliver messages)
+        self._assign_gen = getattr(self, "_assign_gen", 0) + 1
+        gen = self._assign_gen
         new_keys = {(t, p) for t, ps in assignment.items() for p in ps}
         # stop removed partitions
         for key in list(self._assignment):
@@ -133,12 +139,23 @@ class Consumer:
         need = [k for k in new_keys if k not in self._assignment]
         explicit = offsets or {}
 
+        # membership is registered SYNCHRONOUSLY (rd_kafka_assign sets
+        # the assignment list before any async offset resolution —
+        # assignment() and the _deliver revocation check must see it
+        # immediately); only the committed-offset lookup is async
+        for key in need:
+            tp = rk.get_toppar(*key)
+            self._assignment[key] = tp
+            tp.fetchq.forward_to(self.queue)
+
         def start(committed: dict):
+            if self._assign_gen != gen:
+                return              # superseded by a newer assignment
             for key in need:
                 t, p = key
-                tp = rk.get_toppar(t, p)
-                self._assignment[key] = tp
-                tp.fetchq.forward_to(self.queue)
+                tp = self._assignment.get(key)
+                if tp is None:
+                    continue        # unassigned while offsets resolved
                 off = explicit.get(key, proto.OFFSET_INVALID)
                 if off < 0:
                     off = committed.get(key, proto.OFFSET_INVALID)

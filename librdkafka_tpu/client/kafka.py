@@ -148,6 +148,7 @@ class Kafka:
         self._metadata_lock = threading.Lock()
         self._metadata_inflight = False
         self._metadata_refresh_queued = False
+        self._metadata_full_ts = 0.0   # completion time of last FULL refresh
         self._fast_refresh_scheduled = False
         self._addr_cache: dict = {}        # broker.address.ttl DNS cache
         self._purge_epoch = 0              # invalidates in-pipeline batches
@@ -476,6 +477,10 @@ class Kafka:
                 for name in list(self.metadata["topics"]):
                     if name not in seen:
                         del self.metadata["topics"][name]
+            if full:
+                # stamped AFTER the cache update, inside the lock:
+                # list_topics waits on this to take a coherent snapshot
+                self._metadata_full_ts = time.monotonic()
         if full and self.cgrp is not None:
             # regex subscription re-evaluation (rdkafka_pattern.c)
             self.cgrp.metadata_update(seen)
@@ -524,6 +529,9 @@ class Kafka:
     def _assign_toppar_leader(self, tp: Toppar, leader: int):
         if tp.leader_id == leader:
             return
+        # a leadership change invalidates any follower delegation
+        # (reference resets the fetch broker on leader updates)
+        self.revoke_fetch_delegation(tp, "leader change")
         old = tp.leader_id
         tp.leader_id = leader
         with self._brokers_lock:
@@ -532,6 +540,51 @@ class Kafka:
             if leader in self.brokers:
                 self.brokers[leader].add_toppar(tp)
         self.dbg("topic", f"{tp}: leader {old} -> {leader}")
+
+    # ------------------------------------------ KIP-392 follower fetch --
+    def delegate_fetch(self, tp: Toppar, broker_id: int) -> None:
+        """Move a partition's FETCH traffic to a follower replica the
+        broker nominated via preferred_read_replica (Fetch v11;
+        reference: rd_kafka_fetch_preferred_replica_handle,
+        rdkafka_broker.c:3921). Producing still targets the leader."""
+        if tp.fetch_broker_id == broker_id or broker_id == tp.leader_id:
+            if broker_id == tp.leader_id:
+                self.revoke_fetch_delegation(tp, "leader nominated")
+            return
+        with self._brokers_lock:
+            b = self.brokers.get(broker_id)
+            if b is None:
+                # unknown replica: our metadata is stale — refresh it
+                # and back the fetch off so the leader's record-less
+                # redirects don't hot-loop (reference:
+                # rd_kafka_fetch_preferred_replica_handle)
+                tp.fetch_backoff_until = time.monotonic() + \
+                    self.conf.get("fetch.error.backoff.ms") / 1000.0
+                self.metadata_refresh(
+                    reason=f"unknown preferred replica {broker_id}")
+                return
+            old = tp.fetch_broker_id
+            tp.fetch_broker_id = broker_id
+            if old is not None and old != tp.leader_id \
+                    and old in self.brokers:
+                self.brokers[old].remove_toppar(tp)
+            b.add_toppar(tp)
+        self.dbg("fetch",
+                 f"{tp}: fetching from follower {broker_id} "
+                 f"(leader {tp.leader_id})")
+
+    def revoke_fetch_delegation(self, tp: Toppar, reason: str) -> None:
+        with self._brokers_lock:     # fetch_broker_id writes stay
+            old = tp.fetch_broker_id  # ordered vs delegate_fetch
+            if old is None:
+                return
+            tp.fetch_broker_id = None
+            if old != tp.leader_id and old in self.brokers:
+                self.brokers[old].remove_toppar(tp)
+            leader = self.brokers.get(tp.leader_id)
+            if leader is not None:
+                leader._wakeup()
+        self.dbg("fetch", f"{tp}: back to leader fetch ({reason})")
 
     def _fail_unknown_partitions(self, topic: str, cnt: int):
         """Error-DR messages parked on partitions beyond the topic's real

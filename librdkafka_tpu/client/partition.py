@@ -68,6 +68,10 @@ class Toppar:
         self.paused = False
         self.fetch_backoff_until = 0.0
         self.fetch_in_flight = False   # included in an outstanding Fetch
+        # KIP-392 fetch-from-follower: broker id currently serving this
+        # partition's Fetches (None = the leader). Producing always
+        # targets the leader regardless.
+        self.fetch_broker_id = None
         self.fetchq_cnt = 0        # msgs sitting in fetchq (queued.min)
         self.fetchq_bytes = 0      # queued.max.messages.kbytes accounting
         self.eof_reported_at = proto.OFFSET_INVALID

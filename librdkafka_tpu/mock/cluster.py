@@ -48,6 +48,10 @@ class MockPartition:
     # everything. Oldest batches are dropped and start_offset advances.
     retention_bytes: int = 0
     log_bytes: int = 0
+    # KIP-392: broker id nominated as preferred read replica for v11+
+    # consumer fetches (None = leader serves); the reference mock's
+    # rd_kafka_mock_partition_set_follower equivalent
+    follower_id: Optional[int] = None
 
     def append(self, blob: bytes) -> int:
         """Append a produced MessageSet verbatim; returns assigned base
@@ -593,9 +597,17 @@ class MockCluster:
         base = part.append(blob)
         return Err.NO_ERROR, base
 
+    def set_follower(self, topic: str, partition: int,
+                     broker_id: Optional[int]) -> None:
+        """Nominate (or clear) a preferred read replica for v11+
+        fetches (reference: rd_kafka_mock_partition_set_follower)."""
+        with self._lock:
+            self.topics[topic][partition].follower_id = broker_id
+
     def _h_Fetch(self, conn, corrid, hdr, body, inject):
         now = time.monotonic()
-        resp = self._try_fetch(conn, body, inject)
+        resp = self._try_fetch(conn, body, inject,
+                               ver=hdr["api_version"])
         if resp is not None:
             return resp
         # no data yet: park until max_wait or data arrives
@@ -604,7 +616,8 @@ class MockCluster:
                                      hdr["api_version"]))
         return None
 
-    def _try_fetch(self, conn, body, inject, force: bool = False):
+    def _try_fetch(self, conn, body, inject, force: bool = False,
+                   ver: int = 4):
         """Build a fetch response, or None if empty and not forced."""
         any_data = False
         any_err = False
@@ -616,6 +629,7 @@ class MockCluster:
                     err = Err.NO_ERROR
                     records = b""
                     hwm = lso = -1
+                    preferred = -1
                     if inject:
                         err = inject
                     elif t["topic"] not in self.topics or \
@@ -623,8 +637,19 @@ class MockCluster:
                         err = Err.UNKNOWN_TOPIC_OR_PART
                     else:
                         part = self.topics[t["topic"]][p["partition"]]
-                        if part.leader != conn.broker_id:
+                        serves = (part.leader == conn.broker_id
+                                  or part.follower_id == conn.broker_id)
+                        if not serves:
                             err = Err.NOT_LEADER_FOR_PARTITION
+                        elif (part.leader == conn.broker_id
+                              and part.follower_id is not None
+                              and part.follower_id != conn.broker_id
+                              and ver >= 11):
+                            # KIP-392 redirect: the leader answers a
+                            # v11 fetch with the nominated follower and
+                            # NO records (real broker behavior)
+                            hwm = lso = part.end_offset
+                            preferred = part.follower_id
                         else:
                             hwm = lso = part.end_offset
                             off = p["fetch_offset"]
@@ -649,10 +674,13 @@ class MockCluster:
                             a for a in getattr(part, "aborted", []) or []
                             if a.get("last_offset", 1 << 62)
                             >= p["fetch_offset"]]
+                    if preferred != -1:
+                        any_data = True      # redirects return immediately
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
                          "high_watermark": hwm, "last_stable_offset": lso,
                          "aborted_transactions": aborted,
+                         "preferred_read_replica": preferred,
                          "records": records})
                 out_topics.append(tp)
         if not any_data and not any_err and not force:
@@ -664,7 +692,8 @@ class MockCluster:
         for deadline, conn, corrid, body, ver in self._parked_fetches:
             if conn.closed:
                 continue
-            resp = self._try_fetch(conn, body, None, force=(now >= deadline))
+            resp = self._try_fetch(conn, body, None,
+                                   force=(now >= deadline), ver=ver)
             if resp is not None:
                 self._respond(conn, corrid, ApiKey.Fetch, resp, version=ver)
             else:

@@ -136,6 +136,73 @@ FETCH_V4_RESP = Schema(
                 ("producer_id", Int64), ("first_offset", Int64)))),
             ("records", Bytes))))))))
 
+# Fetch v5-v11 evolution (KIP-227 sessions, KIP-392 follower fetching —
+# reference: rd_kafka_FetchRequest versioning in rdkafka_broker.c:3791+).
+# Schema `defaults` keep version-agnostic request bodies working: this
+# client always issues sessionless full fetches (session_id=0, epoch=-1)
+# like the reference (which doesn't implement KIP-227 sessions either).
+_FETCH_PART_V5 = Schema(
+    ("partition", Int32), ("fetch_offset", Int64),
+    ("log_start_offset", Int64), ("max_bytes", Int32),
+    defaults={"log_start_offset": -1})
+_FETCH_PART_V9 = Schema(
+    ("partition", Int32), ("current_leader_epoch", Int32),
+    ("fetch_offset", Int64), ("log_start_offset", Int64),
+    ("max_bytes", Int32),
+    defaults={"current_leader_epoch": -1, "log_start_offset": -1})
+_FORGOTTEN = ("forgotten_topics", Array(Schema(
+    ("topic", String), ("partitions", Array(Int32)))))
+
+
+def _fetch_req(part_schema, *, session: bool, rack: bool) -> Schema:
+    fields = [("replica_id", Int32), ("max_wait_time", Int32),
+              ("min_bytes", Int32), ("max_bytes", Int32),
+              ("isolation_level", Int8)]
+    defaults = {}
+    if session:
+        fields += [("session_id", Int32), ("session_epoch", Int32)]
+        defaults.update(session_id=0, session_epoch=-1)
+    fields.append(("topics", Array(Schema(
+        ("topic", String), ("partitions", Array(part_schema))))))
+    if session:
+        fields.append(_FORGOTTEN)
+        defaults["forgotten_topics"] = []
+    if rack:
+        fields.append(("rack_id", String))
+        defaults["rack_id"] = ""
+    return Schema(*fields, defaults=defaults)
+
+
+def _fetch_resp(*, session: bool, preferred: bool) -> Schema:
+    part_fields = [("partition", Int32), ("error_code", Int16),
+                   ("high_watermark", Int64), ("last_stable_offset", Int64),
+                   ("log_start_offset", Int64),
+                   ("aborted_transactions", Array(Schema(
+                       ("producer_id", Int64), ("first_offset", Int64))))]
+    pdef = {"log_start_offset": -1}
+    if preferred:
+        part_fields.append(("preferred_read_replica", Int32))
+        pdef["preferred_read_replica"] = -1
+    part_fields.append(("records", Bytes))
+    fields = [("throttle_time_ms", Int32)]
+    defaults = {}
+    if session:
+        fields += [("error_code", Int16), ("session_id", Int32)]
+        defaults.update(error_code=0, session_id=0)
+    fields.append(("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(*part_fields, defaults=pdef)))))))
+    return Schema(*fields, defaults=defaults)
+
+
+FETCH_V5_REQ = _fetch_req(_FETCH_PART_V5, session=False, rack=False)
+FETCH_V5_RESP = _fetch_resp(session=False, preferred=False)
+FETCH_V7_REQ = _fetch_req(_FETCH_PART_V5, session=True, rack=False)
+FETCH_V7_RESP = _fetch_resp(session=True, preferred=False)
+FETCH_V9_REQ = _fetch_req(_FETCH_PART_V9, session=True, rack=False)
+FETCH_V11_REQ = _fetch_req(_FETCH_PART_V9, session=True, rack=True)
+FETCH_V11_RESP = _fetch_resp(session=True, preferred=True)
+
 # ----------------------------------------------------------- ListOffsets --
 LISTOFFSETS_V1_REQ = Schema(
     ("replica_id", Int32),
@@ -344,7 +411,7 @@ APIS: dict[ApiKey, tuple[int, Schema, Schema]] = {
     ApiKey.ApiVersions: (0, APIVERSIONS_V0_REQ, APIVERSIONS_V0_RESP),
     ApiKey.Metadata: (4, METADATA_V4_REQ, METADATA_V4_RESP),
     ApiKey.Produce: (3, PRODUCE_V3_REQ, PRODUCE_V3_RESP),
-    ApiKey.Fetch: (4, FETCH_V4_REQ, FETCH_V4_RESP),
+    ApiKey.Fetch: (11, FETCH_V11_REQ, FETCH_V11_RESP),
     ApiKey.ListOffsets: (1, LISTOFFSETS_V1_REQ, LISTOFFSETS_V1_RESP),
     ApiKey.FindCoordinator: (1, FINDCOORDINATOR_V1_REQ, FINDCOORDINATOR_V1_RESP),
     ApiKey.JoinGroup: (5, JOINGROUP_V5_REQ, JOINGROUP_V5_RESP),
@@ -398,6 +465,13 @@ FETCH_V3_REQ = Schema(
             ("partition", Int32), ("fetch_offset", Int64),
             ("max_bytes", Int32))))))))
 VERSIONED[(ApiKey.Fetch, 3)] = (FETCH_V3_REQ, FETCH_V2_RESP)
+VERSIONED[(ApiKey.Fetch, 4)] = (FETCH_V4_REQ, FETCH_V4_RESP)
+VERSIONED[(ApiKey.Fetch, 5)] = (FETCH_V5_REQ, FETCH_V5_RESP)
+VERSIONED[(ApiKey.Fetch, 6)] = (FETCH_V5_REQ, FETCH_V5_RESP)
+VERSIONED[(ApiKey.Fetch, 7)] = (FETCH_V7_REQ, FETCH_V7_RESP)
+VERSIONED[(ApiKey.Fetch, 8)] = (FETCH_V7_REQ, FETCH_V7_RESP)
+VERSIONED[(ApiKey.Fetch, 9)] = (FETCH_V9_REQ, FETCH_V7_RESP)
+VERSIONED[(ApiKey.Fetch, 10)] = (FETCH_V9_REQ, FETCH_V7_RESP)
 
 # --- group / offset APIs for pre-1.0 brokers (all subset schemas: the
 # client builds one superset body dict; a version's schema writes only

@@ -44,17 +44,26 @@ SAMPLES = {
                          {"partition": 0, "error_code": 0, "base_offset": 12,
                           "log_append_time": -1}]}],
                       "throttle_time_ms": 0}),
+    # v11 shape: the session/epoch/rack/log_start_offset fields are
+    # spelled out because parse returns them (builders may omit them —
+    # the schema defaults cover that, proven by the version-sweep test)
     ApiKey.Fetch: ({"replica_id": -1, "max_wait_time": 100, "min_bytes": 1,
                     "max_bytes": 1 << 20, "isolation_level": 1,
+                    "session_id": 0, "session_epoch": -1,
+                    "forgotten_topics": [], "rack_id": "",
                     "topics": [{"topic": "t", "partitions": [
-                        {"partition": 0, "fetch_offset": 0,
+                        {"partition": 0, "current_leader_epoch": -1,
+                         "fetch_offset": 0, "log_start_offset": -1,
                          "max_bytes": 1 << 20}]}]},
-                   {"throttle_time_ms": 0,
+                   {"throttle_time_ms": 0, "error_code": 0,
+                    "session_id": 0,
                     "topics": [{"topic": "t", "partitions": [
                         {"partition": 0, "error_code": 0,
                          "high_watermark": 10, "last_stable_offset": 10,
+                         "log_start_offset": -1,
                          "aborted_transactions": [
                              {"producer_id": 1, "first_offset": 4}],
+                         "preferred_read_replica": -1,
                          "records": b"RECORDS"}]}]}),
     ApiKey.ListOffsets: ({"replica_id": -1, "topics": [
                              {"topic": "t", "partitions": [

@@ -1,0 +1,103 @@
+"""KIP-392 fetch-from-follower (reference:
+tests/0104-fetch_from_follower_mock.c + the preferred_read_replica
+handling at rdkafka_broker.c:3921): a v11 Fetch to the leader gets a
+redirect to the nominated follower; the consumer moves its fetching
+there, keeps producing to the leader, and falls back to the leader when
+the follower stops serving."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.protocol.proto import ApiKey
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=2, topics={"ff": 1})   # leader = broker 1
+    yield c
+    c.stop()
+
+
+def _produce(cluster, n, start=0):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5})
+    for i in range(start, start + n):
+        p.produce("ff", value=b"ff-%03d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+
+def _fetch_brokers(cluster):
+    return [b for b, api in cluster.request_log if api == ApiKey.Fetch]
+
+
+def test_fetch_moves_to_follower_and_back(cluster):
+    _produce(cluster, 40)
+    cluster.set_follower("ff", 0, 2)
+
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gff", "auto.offset.reset": "earliest",
+                  "client.rack": "rack-b", "fetch.wait.max.ms": 50})
+    c.subscribe(["ff"])
+    got = []
+    deadline = time.monotonic() + 20
+    while len(got) < 40 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got.append(m.value)
+    assert sorted(got) == sorted(b"ff-%03d" % i for i in range(40))
+    # the data fetches must have been served by the FOLLOWER
+    assert 2 in _fetch_brokers(cluster), "no fetch ever hit the follower"
+
+    # follower withdrawn: NOT_LEADER from broker 2 → revert to leader
+    cluster.set_follower("ff", 0, None)
+    cluster.request_log.clear()
+    _produce(cluster, 20, start=40)
+    got2 = []
+    deadline = time.monotonic() + 20
+    while len(got2) < 20 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got2.append(m.value)
+    c.close()
+    assert sorted(got2) == sorted(b"ff-%03d" % i for i in range(40, 60))
+    assert 1 in _fetch_brokers(cluster), "never reverted to leader fetch"
+
+
+def test_pre_v11_broker_never_redirects():
+    """Against a broker speaking < Fetch v11 the leader serves data
+    itself even with a follower nominated (the redirect field does not
+    exist on the wire)."""
+    cluster = MockCluster(num_brokers=2, topics={"ff": 1},
+                          broker_version="0.11.0")
+    try:
+        cluster.set_follower("ff", 0, 2)
+        _produce(cluster, 15)
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "gff-old",
+                      "auto.offset.reset": "earliest",
+                      "fetch.wait.max.ms": 50})
+        c.subscribe(["ff"])
+        got = []
+        deadline = time.monotonic() + 15
+        while len(got) < 15 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got.append(m.value)
+        c.close()
+        assert len(got) == 15
+        assert 2 not in _fetch_brokers(cluster)
+    finally:
+        cluster.stop()
+
+
+def test_producer_keeps_targeting_leader(cluster):
+    """Fetch delegation must not move PRODUCE traffic (KIP-392 affects
+    consumption only)."""
+    cluster.set_follower("ff", 0, 2)
+    _produce(cluster, 10)
+    produce_brokers = [b for b, api in cluster.request_log
+                       if api == ApiKey.Produce]
+    assert produce_brokers and set(produce_brokers) == {1}
