@@ -109,6 +109,48 @@ def host_pipeline(n_msgs: int, size: int, toppars: int,
     return rate
 
 
+def consumer_pipeline(n_msgs: int, size: int, toppars: int) -> float:
+    """End-to-end consumer msgs/s with check.crcs (batched fetch-side
+    CRC verify + decompress; the rdkafka_performance -C analog /
+    BASELINE config 4) against the external mock."""
+    import time as _t
+
+    from librdkafka_tpu import Consumer, Producer
+
+    bs = _external_mock(toppars)
+    p = Producer({"bootstrap.servers": bs, "compression.codec": "lz4",
+                  "batch.num.messages": 10000, "linger.ms": 50,
+                  "queue.buffering.max.messages": 2_000_000})
+    vals = _payloads(4096, size)
+    for i in range(n_msgs):
+        p.produce("cbench", value=vals[i % len(vals)],
+                  partition=i % toppars)
+    if p.flush(120.0) != 0:
+        raise RuntimeError("consumer-bench produce did not drain")
+    p.close()
+
+    c = Consumer({"bootstrap.servers": bs, "group.id": "bench-c",
+                  "auto.offset.reset": "earliest", "check.crcs": True,
+                  "queued.min.messages": 1000000})
+    c.subscribe(["cbench"])
+    # first message = assignment + fetch warmup; then time the drain
+    got = 0
+    deadline = _t.monotonic() + 60
+    while got < 1 and _t.monotonic() < deadline:
+        if c.poll(0.2) is not None:
+            got = 1
+    t0 = _t.perf_counter()
+    while got < n_msgs and _t.monotonic() < deadline:
+        m = c.poll(0.5)
+        if m is not None and m.error is None:
+            got += 1
+    rate = (got - 1) / max(_t.perf_counter() - t0, 1e-9)
+    c.close()
+    if got < n_msgs:
+        raise RuntimeError(f"consumer bench incomplete: {got}/{n_msgs}")
+    return rate
+
+
 def _sync(x) -> np.ndarray:
     """True device synchronization: a host readback (block_until_ready
     does not synchronize through the axon tunnel)."""
@@ -248,11 +290,23 @@ def main():
             cpu_rates.append(host_pipeline(n_msgs, size, toppars))
             tpu_rates.append(host_pipeline(n_msgs, size, toppars,
                                            backend="tpu"))
+    except BaseException:
+        if _MOCK_PROC is not None:
+            _MOCK_PROC.kill()
+        raise
+    host_rate = sorted(cpu_rates)[1]
+    tpu_backend_rate = sorted(tpu_rates)[1]
+    consumer_rate = None
+    try:
+        rates = [consumer_pipeline(n_msgs, size, toppars)
+                 for _ in range(3)]
+        consumer_rate = sorted(rates)[1]
+    except Exception as e:
+        # null in the JSON must be diagnosable, never silent
+        print(f"consumer_pipeline failed: {e!r}", file=sys.stderr)
     finally:
         if _MOCK_PROC is not None:
             _MOCK_PROC.kill()
-    host_rate = sorted(cpu_rates)[1]
-    tpu_backend_rate = sorted(tpu_rates)[1]
     off = codec_offload()
     print(json.dumps({
         "metric": "batched CRC32C codec offload, 128x64KB partition "
@@ -265,6 +319,8 @@ def main():
         "vs_baseline": off["speedup"],
         "host_pipeline_msgs_s": round(host_rate, 1),
         "host_pipeline_tpu_backend_msgs_s": round(tpu_backend_rate, 1),
+        "consumer_pipeline_msgs_s":
+            round(consumer_rate, 1) if consumer_rate is not None else None,
         "detail": off,
     }))
 

@@ -135,8 +135,15 @@ class Consumer:
             rk.cgrp.assignment = assignment
         if not new_keys:
             return
-        # gather committed offsets if in a group
-        need = [k for k in new_keys if k not in self._assignment]
+        # gather committed offsets for every partition whose fetcher
+        # hasn't STARTED — not merely "not registered": a registered
+        # partition whose async offset lookup was superseded (gen
+        # guard below) still needs a restart or it would sit in
+        # FetchState.NONE forever
+        need = [k for k in new_keys
+                if k not in self._assignment
+                or self._assignment[k].fetch_state
+                in (FetchState.NONE, FetchState.STOPPED)]
         explicit = offsets or {}
 
         # membership is registered SYNCHRONOUSLY (rd_kafka_assign sets
@@ -144,7 +151,7 @@ class Consumer:
         # assignment() and the _deliver revocation check must see it
         # immediately); only the committed-offset lookup is async
         for key in need:
-            tp = rk.get_toppar(*key)
+            tp = self._assignment.get(key) or rk.get_toppar(*key)
             self._assignment[key] = tp
             tp.fetchq.forward_to(self.queue)
 

@@ -114,3 +114,29 @@ def test_flush_with_event_api_accounts_drs():
     finally:
         p.close()
         cluster.stop()
+
+
+def test_overlapping_assign_starts_all_partitions():
+    """A second assign() that overlaps a pending committed-offset lookup
+    must still start every partition's fetcher (the superseded lookup is
+    gen-guarded; the new call re-resolves carried-over partitions)."""
+    cluster = MockCluster(num_brokers=1, topics={"ov": 2})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(30):
+        p.produce("ov", value=b"b%02d" % i, partition=i % 2)
+    assert p.flush(10.0) == 0
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gov", "auto.offset.reset": "earliest"})
+    c.assign([TopicPartition("ov", 0)])
+    c.assign([TopicPartition("ov", 0), TopicPartition("ov", 1)])
+    got = 0
+    deadline = time.monotonic() + 15
+    while got < 30 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got += 1
+    c.close()
+    p.close()
+    cluster.stop()
+    assert got == 30, f"only {got}/30 delivered — partition stranded"
