@@ -1311,6 +1311,7 @@ class Broker:
         from .partition import FetchState
         from ..protocol.msgset import iter_batches
 
+        from ..protocol.msgset import split_msgset_segments
         # phase A: collect OK partitions; split v2 blobs into batches so
         # CRC verify and decompress each run as ONE batched provider
         # call across the whole Fetch response — the consumer-side
@@ -1342,12 +1343,23 @@ class Broker:
                                          p["high_watermark"])
                     blob = p["records"] or b""
                     batches = None
-                    if (len(blob) > proto.V2_OF_Magic
-                            and blob[proto.V2_OF_Magic] == 2):
-                        batches = [
-                            [info, payload,
-                             info.base_offset + info.last_offset_delta, full]
-                            for info, payload, full in iter_batches(blob)]
+                    if blob:
+                        # ONE frame walk per partition response: its
+                        # result feeds the mixed/legacy decisions here,
+                        # the legacy CRC verify (phase B), and the reply
+                        # handler (via pres["_segments"])
+                        segs = split_msgset_segments(blob)
+                        p["_segments"] = segs
+                        if len(segs) == 1 and segs[0][0] == "v2":
+                            batches = [
+                                [info, payload,
+                                 info.base_offset + info.last_offset_delta,
+                                 full]
+                                for info, payload, full in
+                                iter_batches(blob)]
+                        # mixed or legacy blobs: the reply handler
+                        # splits/processes inline — precomputed batches
+                        # would silently drop the legacy run
                     ok.append((tp, p, batches, tp.fetch_offset, tp.version))
                 elif ec == Err.OFFSET_OUT_OF_RANGE \
                         and tp.fetch_broker_id is not None:
@@ -1406,19 +1418,20 @@ class Broker:
                         tp.fetch_backoff_until = time.monotonic() + 0.5
             # legacy MsgVer0/1 blobs: per-message zlib CRC, same batched
             # provider seam (MXU GF(2) kernel on the tpu backend;
-            # reference verifies inline, rdkafka_msgset_reader.c v0/v1)
+            # reference verifies inline, rdkafka_msgset_reader.c v0/v1).
+            # The phase-A segment split keeps v2 batches out of the
+            # legacy frame walk.
             from ..protocol.msgset import iter_legacy_crc_regions
             lregions, lowners = [], []
             for tp, pres, batches, fo, ver in ok:
                 if batches is not None:
                     continue
-                blob = pres["records"] or b""
-                if len(blob) <= proto.V2_OF_Magic \
-                        or blob[proto.V2_OF_Magic] >= 2:
-                    continue
-                for off, crc, region in iter_legacy_crc_regions(blob):
-                    lregions.append(region)
-                    lowners.append((tp, off, crc))
+                for kind, seg in pres.get("_segments") or []:
+                    if kind != "legacy":
+                        continue
+                    for off, crc, region in iter_legacy_crc_regions(seg):
+                        lregions.append(region)
+                        lowners.append((tp, off, crc))
             if lregions:
                 crcs = rk.codec_provider.crc32_many(lregions)
                 for (tp, off, want), got in zip(lowners, crcs):

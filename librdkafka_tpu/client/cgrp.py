@@ -394,36 +394,44 @@ class ConsumerGroup:
 
     def commit_offsets(self, offsets: dict[tuple[str, int], int],
                        cb) -> bool:
+        # values may be plain offsets or (offset, metadata) — the
+        # commit-metadata string of rd_kafka_topic_partition_t
+        # (reference test 0099-commit_metadata); normalize here
+        offsets = {k: (v if isinstance(v, tuple) else (v, None))
+                   for k, v in offsets.items()}
         # legacy file store split (offset.store.method=file,
         # rdkafka_offset.c:98-330): file-backed topics commit locally
         rk = self.rk
-        all_offsets = dict(offsets)      # full set for offset_commit_cb
+        all_offsets = {k: v[0] for k, v in offsets.items()}
         store = rk.offset_store
         if store is not None:
             file_items = {k: v for k, v in offsets.items()
                           if store.uses_file(k[0])}
             if file_items:
-                store.commit_all(file_items)
-                for (t, p), off in file_items.items():
+                # plain-int offset dict: callbacks/interceptors keep the
+                # pre-metadata contract on every path
+                file_plain = {k: v[0] for k, v in file_items.items()}
+                store.commit_all(file_plain)
+                for (t, p), off in file_plain.items():
                     tp = rk.get_toppar(t, p, create=False)
                     if tp is not None:
                         tp.committed_offset = off
                 if rk.interceptors:
-                    rk.interceptors.on_commit(file_items)
+                    rk.interceptors.on_commit(file_plain)
                 offsets = {k: v for k, v in offsets.items()
                            if k not in file_items}
                 if not offsets:
                     if cb:
-                        cb(None, self._synth_offset_resp(file_items, False))
+                        cb(None, self._synth_offset_resp(file_plain, False))
                     occb = rk.conf.get("offset_commit_cb")
                     if occb:
-                        occb(None, file_items)
+                        occb(None, file_plain)
                     return True
                 # mixed commit: report file-backed partitions alongside
                 # the broker result in both cb's response and occb
                 orig_cb = cb
 
-                def cb(err, resp, _orig=orig_cb, _file=file_items):
+                def cb(err, resp, _orig=orig_cb, _file=file_plain):
                     if err is None and resp is not None:
                         resp = dict(resp)
                         resp["topics"] = (
@@ -437,14 +445,15 @@ class ConsumerGroup:
                 cb(KafkaError(Err._WAIT_COORD, "no coordinator"), None)
             return False
         by_topic: dict[str, list] = {}
-        for (t, p), off in offsets.items():
+        for (t, p), (off, meta) in offsets.items():
             by_topic.setdefault(t, []).append(
-                {"partition": p, "offset": off, "metadata": None,
+                {"partition": p, "offset": off, "metadata": meta,
                  "timestamp": -1})    # OffsetCommit v1 field; v2 ignores
 
         def on_commit(err, resp):
             if err is None and self.rk.interceptors:
-                self.rk.interceptors.on_commit(offsets)
+                self.rk.interceptors.on_commit(
+                    {k: v[0] for k, v in offsets.items()})
             if err is None:
                 for tpc in resp["topics"]:
                     for pres in tpc["partitions"]:
@@ -454,7 +463,7 @@ class ConsumerGroup:
                         if tp is not None and pres["error_code"] == 0:
                             tp.committed_offset = offsets.get(
                                 (tpc["topic"], pres["partition"]),
-                                tp.committed_offset)
+                                (tp.committed_offset, None))[0]
             if cb:
                 cb(err, resp)
             occb = self.rk.conf.get("offset_commit_cb")

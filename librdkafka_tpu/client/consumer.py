@@ -33,6 +33,10 @@ class TopicPartition:
     partition: int
     offset: int = proto.OFFSET_INVALID
     error: Optional[KafkaError] = None
+    #: app-supplied commit metadata (rd_kafka_topic_partition_t.metadata,
+    #: reference test 0099-commit_metadata); round-trips via
+    #: commit(offsets=...) / committed()
+    metadata: Optional[str] = None
 
     def __hash__(self):
         return hash((self.topic, self.partition))
@@ -307,7 +311,8 @@ class Consumer:
         if message is not None:
             to_commit = {(message.topic, message.partition): message.offset + 1}
         elif offsets is not None:
-            to_commit = {(o.topic, o.partition): o.offset for o in offsets}
+            to_commit = {(o.topic, o.partition): (o.offset, o.metadata)
+                         for o in offsets}
         else:
             to_commit = self.stored_offsets()
         if not to_commit:
@@ -340,7 +345,8 @@ class Consumer:
             if err is None:
                 for tr in resp["topics"]:
                     for pr in tr["partitions"]:
-                        result[(tr["topic"], pr["partition"])] = pr["offset"]
+                        result[(tr["topic"], pr["partition"])] = (
+                            pr["offset"], pr.get("metadata"))
             done.append(err)
 
         self._rk.cgrp.fetch_committed(
@@ -348,10 +354,13 @@ class Consumer:
         deadline = time.monotonic() + timeout
         while not done and time.monotonic() < deadline:
             time.sleep(0.005)
-        return [TopicPartition(p.topic, p.partition,
-                               result.get((p.topic, p.partition),
-                                          proto.OFFSET_INVALID))
-                for p in partitions]
+        out = []
+        for p in partitions:
+            off, meta = result.get((p.topic, p.partition),
+                                   (proto.OFFSET_INVALID, None))
+            out.append(TopicPartition(p.topic, p.partition, off,
+                                      metadata=meta))
+        return out
 
     # ------------------------------------------------------ seek & pause --
     def seek(self, partition: TopicPartition):

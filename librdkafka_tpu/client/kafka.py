@@ -207,7 +207,10 @@ class Kafka:
             self._produce_slow, self._wake_leader,
             conf.get("queue.buffering.max.messages"),
             conf.get("queue.buffering.max.kbytes") * 1024,
-            conf.get("message.copy.max.bytes"))
+            # also capped at message.max.bytes so oversize records always
+            # reach the slow path's MSG_SIZE_TOO_LARGE check
+            min(conf.get("message.copy.max.bytes"),
+                conf.get("message.max.bytes")))
         self.produce = self._lane.produce
         conf.add_listener(self._recompute_fast_lane)
         self._recompute_fast_lane()
@@ -691,6 +694,13 @@ class Kafka:
         if self.fatal_error:
             raise KafkaException(self.fatal_error)
         sz = (len(value) if value else 0) + (len(key) if key else 0)
+        # reference: rd_kafka_msg_new0 rejects oversize messages up
+        # front with MSG_SIZE_TOO_LARGE (test 0003-msgmaxsize)
+        if sz > self.conf.get("message.max.bytes"):
+            raise KafkaException(
+                Err.MSG_SIZE_TOO_LARGE,
+                f"message size {sz} exceeds message.max.bytes "
+                f"{self.conf.get('message.max.bytes')}")
         # lock keeps check+claim atomic on this Python path (the C lane
         # does both inside one GIL-atomic call)
         with self._msg_cnt_lock:
@@ -1149,7 +1159,12 @@ class Kafka:
         took when it decided this response is current; all skip/parse
         decisions use the snapshot so a concurrent seek() can't desync
         them, and deliveries are stamped with ``ver`` so post-seek ops
-        get discarded by the consumer's staleness filter."""
+        get discarded by the consumer's staleness filter.
+
+        Returns False when the range errored without advancing
+        fetch_offset (CRC/decompress failure) — a mixed-segment caller
+        must then stop, or it would advance past the failed range and
+        lose it. True otherwise."""
         if fo is None:
             fo = tp.fetch_offset
         if ver is None:
@@ -1164,7 +1179,7 @@ class Kafka:
                 m.offset = fo
                 m.error = KafkaError(Err._PARTITION_EOF, "partition EOF")
                 tp.fetchq.push(Op(OpType.FETCH, payload=(tp, [m], ver)))
-            return
+            return True
         check_crcs = self.conf.get("check.crcs")
         read_committed = (self.conf.get("isolation.level") == "read_committed")
         aborted_list = pres.get("aborted_transactions") or []
@@ -1175,6 +1190,28 @@ class Kafka:
         active_aborts: set[int] = set()
         msgs: list[Message] = []
         next_offset = fo
+        # mixed-format logs (written across a 0.11 upgrade): process
+        # each same-format run in order; the single-format common case
+        # falls through to the batched paths below untouched
+        from ..protocol.msgset import split_msgset_segments
+        segs = pres.pop("_segments", None) \
+            if isinstance(pres.get("_segments"), list) else None
+        if segs is None:
+            segs = split_msgset_segments(blob)
+        if len(segs) > 1:
+            for _kind, seg in segs:
+                if tp.version != ver:
+                    return True
+                sub = dict(pres)
+                sub["records"] = seg
+                if not self.fetch_reply_handle(tp, sub, broker,
+                                               batches=None, fo=fo,
+                                               ver=ver):
+                    # segment errored without advancing: stop here so
+                    # the failed range is re-fetched, not skipped over
+                    return False
+                fo = tp.fetch_offset
+            return True
         is_v2 = (len(blob) > proto.V2_OF_Magic and blob[proto.V2_OF_Magic] == 2)
         if is_v2:
             if batches is None:
@@ -1189,7 +1226,7 @@ class Kafka:
                                 f"{tp}: CRC mismatch at offset "
                                 f"{info.base_offset}"))
                             tp.fetch_backoff_until = time.monotonic() + 0.5
-                            return
+                            return False
                         if info.codec:
                             try:
                                 payload = self.codec_provider.decompress_many(
@@ -1201,7 +1238,7 @@ class Kafka:
                                     f"{e!r}"))
                                 tp.fetch_backoff_until = \
                                     time.monotonic() + 0.5
-                                return
+                                return False
                     batches.append((info, payload, last))
             for info, payload, last in batches:
                 if last < fo:
@@ -1236,7 +1273,7 @@ class Kafka:
                         f"{tp}: decompress ({info.codec}) failed at "
                         f"offset {info.base_offset}"))
                     tp.fetch_backoff_until = time.monotonic() + 0.5
-                    return
+                    return False
                 for r in parse_records_v2(info, payload):
                     if r.offset < fo:
                         continue
@@ -1259,7 +1296,7 @@ class Kafka:
                 next_offset = max(next_offset, r.offset + 1)
 
         if tp.version != ver:
-            return      # seek/rebalance raced this response: drop it
+            return True  # seek/rebalance raced this response: drop it
         tp.fetch_offset = next_offset
         tp.eof_reported_at = proto.OFFSET_INVALID
         if self.interceptors:
@@ -1275,6 +1312,7 @@ class Kafka:
             tp.fetchq.push(Op(OpType.FETCH, payload=(tp, msgs, ver)))
         if self.stats:
             self.stats.c_rx_msgs += len(msgs)
+        return True
 
     def offset_reset(self, tp: Toppar, reason: str):
         """Apply auto.offset.reset (reference: rdkafka_offset.c

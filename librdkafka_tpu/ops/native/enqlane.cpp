@@ -474,10 +474,11 @@ static PyObject *lane_produce(Lane *l, PyObject *const *args,
                 int64_t vl = (value && value != Py_None)
                                  ? PyBytes_GET_SIZE(value) : -1;
                 int64_t sz = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
-                if (vl > l->copy_max || kl > l->copy_max)
-                    goto fallback;      // message.copy.max.bytes: keep a
-                                        // reference (Message path), don't
-                                        // copy into the arena
+                if (sz > l->copy_max)
+                    goto fallback;      // message.copy.max.bytes (and the
+                                        // message.max.bytes cap the caller
+                                        // folds in): keep a reference /
+                                        // let the slow path size-check
                 if (l->msg_cnt >= l->max_msgs
                     || l->msg_bytes + sz > l->max_bytes)
                     goto fallback;      // slow path raises _QUEUE_FULL

@@ -523,6 +523,36 @@ def write_msgset_v01(msgs: Iterable[Record], *, magic: int, codec: Optional[str]
     return wrapper.as_bytes()
 
 
+def split_msgset_segments(data) -> list[tuple[str, bytes]]:
+    """Split a fetch records blob into maximal same-format runs —
+    ("legacy", bytes) for v0/v1 messagesets, ("v2", bytes) for
+    RecordBatches — preserving order. Logs written across a 0.11
+    upgrade hold both; the reference reader dispatches per MessageSet
+    from each header's MsgVersion (rdkafka_msgset_reader.c:1410).
+    Both formats share the [i64 offset][i32 size] frame prefix with the
+    magic byte at offset 16, so one uniform walk discriminates.
+    A partial trailing frame is dropped (broker may truncate)."""
+    data = bytes(data)
+    segs: list[tuple[str, bytes]] = []
+    off, n = 0, len(data)
+    start = 0
+    cur: Optional[str] = None
+    while n - off >= 17:
+        size = int.from_bytes(data[off + 8:off + 12], "big", signed=True)
+        if size < 5 or off + 12 + size > n:
+            break                       # partial/garbled tail
+        kind = "v2" if data[off + 16] == 2 else "legacy"
+        if cur is None:
+            cur = kind
+        elif kind != cur:
+            segs.append((cur, data[start:off]))
+            start, cur = off, kind
+        off += 12 + size
+    if cur is not None and off > start:
+        segs.append((cur, data[start:off]))
+    return segs
+
+
 def iter_legacy_crc_regions(data) -> list[tuple[int, int, bytes]]:
     """[(offset, stored_crc, crc_region)] for each top-level message of
     a legacy v0/v1 MessageSet. The per-message CRC (zlib polynomial,

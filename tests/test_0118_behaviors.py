@@ -1,0 +1,196 @@
+"""Reference behavioral parity batch (one test per numbered reference
+test not yet covered elsewhere): 0002-unkpart, 0003-msgmaxsize,
+0008-reqacks, 0013-null-msgs, 0061-consumer_lag, 0092-mixed_msgver,
+0095-all_brokers_down, 0099-commit_metadata."""
+import json
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.consumer import TopicPartition
+from librdkafka_tpu.client.errors import Err, KafkaException
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.protocol.msgset import Record, write_msgset_v01
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"bh": 2})
+    yield c
+    c.stop()
+
+
+def test_unknown_partition_fails_delivery(cluster):
+    """0002-unkpart: produce to a partition beyond the topic's count
+    gets an error delivery report, not silence."""
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2, "message.timeout.ms": 3000,
+                  "dr_msg_cb": lambda e, m: drs.append((e, m))})
+    p.produce("bh", value=b"nope", partition=99)
+    assert p.flush(10.0) == 0
+    p.close()
+    assert len(drs) == 1
+    err, _m = drs[0]
+    # reference fails these with the LOCAL unknown-partition error
+    # (rd_kafka_topic_partition_cnt_update → _UNKNOWN_PARTITION DRs)
+    assert err is not None and err.code == Err._UNKNOWN_PARTITION
+
+
+def test_msg_size_too_large(cluster):
+    """0003-msgmaxsize: oversize messages are rejected at produce()."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "message.max.bytes": 5000})
+    with pytest.raises(KafkaException) as ei:
+        p.produce("bh", value=b"Z" * 6000, partition=0)
+    assert ei.value.error.code == Err.MSG_SIZE_TOO_LARGE
+    p.produce("bh", value=b"ok" * 100, partition=0)   # under the limit
+    assert p.flush(10.0) == 0
+    p.close()
+
+
+@pytest.mark.parametrize("acks", [-1, 0, 1])
+def test_required_acks(cluster, acks):
+    """0008-reqacks: every acks level delivers (acks=0 without waiting
+    for a broker response)."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "acks": acks, "linger.ms": 2})
+    for i in range(20):
+        p.produce("bh", value=b"a%d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+    blobs = cluster.partition("bh", 0).log
+    assert blobs, f"nothing stored with acks={acks}"
+
+
+def test_null_key_and_value_round_trip(cluster):
+    """0013-null-msgs: None key/value survive the wire as None."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    p.produce("bh", value=None, key=b"onlykey", partition=0)
+    p.produce("bh", value=b"onlyvalue", key=None, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gnull", "auto.offset.reset": "earliest"})
+    c.subscribe(["bh"])
+    got = []
+    deadline = time.monotonic() + 15
+    while len(got) < 2 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got.append((m.key, m.value))
+    c.close()
+    assert (b"onlykey", None) in got
+    assert (None, b"onlyvalue") in got
+
+
+def test_consumer_lag_stat(cluster):
+    """0061-consumer_lag: the stats blob reports end-minus-consumed."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(10):
+        p.produce("bh", value=b"l%d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    blobs = []
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "glag", "auto.offset.reset": "earliest",
+                  "statistics.interval.ms": 100,
+                  "stats_cb": lambda s: blobs.append(json.loads(s))})
+    c.subscribe(["bh"])
+    # consume slowly (slower than the stats interval) so blobs capture
+    # intermediate positions; every blob must satisfy
+    # lag == hi_offset - app_offset (clamped), and the final one is 0
+    got = 0
+    deadline = time.monotonic() + 30
+    while got < 10 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got += 1
+            time.sleep(0.15)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 1:
+        c.poll(0.1)
+    c.close()
+    assert got == 10
+    checked = mid_stream = 0
+    for b in blobs:
+        part = b.get("topics", {}).get("bh", {}) \
+                .get("partitions", {}).get("0")
+        if not part or part["hi_offset"] < 0 or part["app_offset"] < 0:
+            continue
+        want = max(0, part["hi_offset"]
+                   - max(part["app_offset"], part["committed_offset"]))
+        assert part["consumer_lag"] == want, part
+        checked += 1
+        if part["consumer_lag"] > 0:
+            mid_stream += 1
+    assert checked > 0 and mid_stream > 0, \
+        f"no mid-stream lag observed across {len(blobs)} blobs"
+    final = blobs[-1]["topics"]["bh"]["partitions"]["0"]
+    assert final["consumer_lag"] == 0, final
+
+
+def test_mixed_msgver_log(cluster):
+    """0092-mixed_msgver: one partition log holding legacy v1 messagesets
+    followed by v2 batches parses end to end."""
+    legacy = write_msgset_v01(
+        [Record(key=b"k%d" % i, value=b"old-%d" % i, timestamp=1_690_000_000_000)
+         for i in range(3)], magic=1, codec=None, now_ms=1_690_000_000_000)
+    cluster.partition("bh", 1).append(legacy)
+
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(3):
+        p.produce("bh", value=b"new-%d" % i, partition=1)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gmix", "auto.offset.reset": "earliest",
+                  "check.crcs": True})
+    c.subscribe(["bh"])
+    got = []
+    deadline = time.monotonic() + 15
+    while len(got) < 6 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None and m.partition == 1:
+            got.append(m.value)
+    c.close()
+    assert got == [b"old-0", b"old-1", b"old-2",
+                   b"new-0", b"new-1", b"new-2"]
+
+
+def test_all_brokers_down_event():
+    """0095-all_brokers_down: connecting to nothing surfaces
+    _ALL_BROKERS_DOWN via error_cb."""
+    errs = []
+    p = Producer({"bootstrap.servers": "127.0.0.1:1",
+                  "reconnect.backoff.ms": 50,
+                  "error_cb": lambda e: errs.append(e)})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            not any(e.code == Err._ALL_BROKERS_DOWN for e in errs):
+        p.poll(0.1)
+    p.close()
+    assert any(e.code == Err._ALL_BROKERS_DOWN for e in errs), errs
+
+
+def test_commit_metadata_round_trip(cluster):
+    """0099-commit_metadata: app-supplied commit metadata survives
+    commit() → committed()."""
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gmeta"})
+    c.subscribe(["bh"])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not c.assignment():
+        c.poll(0.2)
+    c.commit(offsets=[TopicPartition("bh", 0, 7,
+                                     metadata="checkpoint-alpha")])
+    out = c.committed([TopicPartition("bh", 0)], timeout=10)
+    c.close()
+    assert out[0].offset == 7
+    assert out[0].metadata == "checkpoint-alpha"
