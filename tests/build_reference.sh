@@ -11,10 +11,18 @@ if [ -e "$DST/src/librdkafka.so.1" ]; then
     echo "reference already built at $DST"
     exit 0
 fi
-mkdir -p "$DST"
-cp -r "$REF"/* "$DST"/
-cd "$DST"
+BUILD="$DST-build"      # transient (gitignored); removed on ANY exit —
+                        # reference source copies must never persist
+rm -rf "$BUILD" && mkdir -p "$BUILD"
+trap 'rm -rf "$BUILD"' EXIT
+cp -r "$REF"/* "$BUILD"/
+cd "$BUILD"
 ./configure
 make -j"$(nproc)" libs
 make -C examples rdkafka_performance
+# keep only the built artifacts: the interop tier needs just these, and
+# keeping reference SOURCE copies inside the repo tree is off-limits
+mkdir -p "$DST/src" "$DST/examples"
+cp "$BUILD/src/librdkafka.so.1" "$DST/src/"
+cp "$BUILD/examples/rdkafka_performance" "$DST/examples/"
 echo "reference built: $DST/src/librdkafka.so.1"
