@@ -71,3 +71,64 @@ def test_verifiable_consumer(mock_proc):
             assert 0 <= p["minOffset"] <= p["maxOffset"]
     commits = [l for l in lines if l["name"] == "offsets_committed"]
     assert commits and all(c["success"] for c in commits)
+
+
+def test_verifiable_two_consumer_rebalance(mock_proc):
+    """The ducktape scenario the protocol exists for: a second consumer
+    joins the same group mid-stream — both sides emit the rebalance
+    protocol events and the partition set splits disjointly."""
+    import time
+
+    def read_until(proc, name, timeout=30):
+        """Read protocol lines from proc until `name` appears."""
+        lines = []
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(json.loads(line))
+            if lines[-1]["name"] == name:
+                return lines
+        raise AssertionError(
+            f"never saw {name}: {[l['name'] for l in lines]}")
+
+    _run(["--producer", "--topic", "vt", "--max-messages", "400",
+          "--bootstrap-server", mock_proc])
+    c1 = c2 = None
+    try:
+        c1 = subprocess.Popen(
+            [sys.executable, CLIENT, "--consumer", "--topic", "vt",
+             "--group-id", "vreb", "--bootstrap-server", mock_proc,
+             "--commit-interval-ms", "300"],
+            stdout=subprocess.PIPE, text=True, cwd=REPO)
+        # deterministic: wait for c1's FIRST assignment before c2 joins
+        l1 = read_until(c1, "partitions_assigned")
+        c2 = subprocess.Popen(
+            [sys.executable, CLIENT, "--consumer", "--topic", "vt",
+             "--group-id", "vreb", "--bootstrap-server", mock_proc,
+             "--commit-interval-ms", "300"],
+            stdout=subprocess.PIPE, text=True, cwd=REPO)
+        # the join must revoke c1's assignment and re-assign both sides
+        l1 += read_until(c1, "partitions_revoked")
+        l1 += read_until(c1, "partitions_assigned")
+        l2 = read_until(c2, "partitions_assigned")
+        c1.terminate()
+        c2.terminate()
+        out1, _ = c1.communicate(timeout=30)
+        out2, _ = c2.communicate(timeout=30)
+        l1 += [json.loads(x) for x in out1.splitlines() if x.strip()]
+        l2 += [json.loads(x) for x in out2.splitlines() if x.strip()]
+    finally:
+        for proc in (c1, c2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+    n1 = [x["name"] for x in l1]
+    n2 = [x["name"] for x in l2]
+    assert n1[-1] == "shutdown_complete" and n2[-1] == "shutdown_complete"
+    # after the rebalance each holds ONE of the two partitions
+    last1 = [x for x in l1 if x["name"] == "partitions_assigned"][-1]
+    last2 = [x for x in l2 if x["name"] == "partitions_assigned"][-1]
+    p1 = {(p["topic"], p["partition"]) for p in last1["partitions"]}
+    p2 = {(p["topic"], p["partition"]) for p in last2["partitions"]}
+    assert p1 and p2 and not (p1 & p2), (p1, p2)
