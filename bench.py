@@ -58,7 +58,14 @@ def _external_mock(toppars: int) -> str:
     (measured 77k vs 129k msgs/s, 1KB lz4)."""
     global _MOCK_PROC, _MOCK_BS
     if _MOCK_BS is None:
+        import select
         import subprocess
+        import tempfile
+        # stderr goes to a FILE, not a PIPE: a pipe nobody drains fills
+        # its ~64KB buffer and blocks the mock mid-benchmark; the file is
+        # read back only on startup failure.
+        errf = tempfile.NamedTemporaryFile(
+            mode="w+", prefix="tk_mock_err_", suffix=".log", delete=False)
         _MOCK_PROC = subprocess.Popen(
             [sys.executable, "-m", "librdkafka_tpu.mock.standalone",
              "--brokers", "2", "--partitions", str(toppars),
@@ -66,12 +73,22 @@ def _external_mock(toppars: int) -> str:
              # broker process unboundedly (memory pressure slows later
              # trials and biases the cpu-vs-tpu comparison)
              "--retention-mb", "32"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            stdout=subprocess.PIPE, stderr=errf, text=True,
             cwd=os.path.dirname(os.path.abspath(__file__)))
-        line = _MOCK_PROC.stdout.readline().strip()
-        if not line:        # child died before printing its address
-            err = _MOCK_PROC.stderr.read()
+        # guard the address read: if the child neither prints nor exits,
+        # readline() would block the whole bench forever
+        r, _, _ = select.select([_MOCK_PROC.stdout], [], [], 30.0)
+        line = _MOCK_PROC.stdout.readline().strip() if r else ""
+        if not line:        # child died (or hung) before its address
+            _MOCK_PROC.kill()
+            errf.flush()
+            err = open(errf.name).read()
+            errf.close()
             raise RuntimeError(f"standalone mock failed to start: {err}")
+        # success: the mock inherited the fd; drop ours and the name —
+        # warnings it writes later just go to the (unlinked) file
+        errf.close()
+        os.unlink(errf.name)
         _MOCK_BS = line
     return _MOCK_BS
 

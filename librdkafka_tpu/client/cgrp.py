@@ -19,7 +19,7 @@ from .assignor import (ASSIGNORS, assignment_decode, assignment_encode,
                        subscription_decode, subscription_encode)
 from .broker import Request
 from .errors import Err, KafkaError
-from .queue import Op, OpType
+from .queue import Op, OpType, SyncReply
 
 if TYPE_CHECKING:
     from .kafka import Kafka
@@ -52,6 +52,10 @@ class ConsumerGroup:
         self._wait_rebalance_cb = False
         self._auto_commit_next = 0.0
         self.terminated = False
+        # posted when the coordinator FSM reaches "up": sync callers
+        # (commit/committed on a consumer that hasn't subscribed yet)
+        # block here instead of failing with _WAIT_COORD
+        self.coord_ready = SyncReply()
 
     # ------------------------------------------------------------ public --
     def subscribe(self, topics: list[str]):
@@ -126,22 +130,31 @@ class ConsumerGroup:
     # ------------------------------------------------------------- serve --
     def serve(self):
         """Called from the main thread loop (rd_kafka_cgrp_serve)."""
-        if self.terminated or not self.subscription:
+        if self.terminated:
             return
         now = time.monotonic()
-        # max.poll.interval.ms enforcement (reference :2742)
-        mpi = self.rk.conf.get("max.poll.interval.ms") / 1000.0
-        if (self.join_state == "steady" and not self.max_poll_exceeded
-                and now - self.last_poll > mpi):
-            self.max_poll_exceeded = True
-            self.rk.op_err(KafkaError(
-                Err._MAX_POLL_EXCEEDED,
-                f"application maximum poll interval ({int(mpi * 1000)}ms) "
-                "exceeded"))
-            self._leave()
-            return
+        if self.subscription:
+            # max.poll.interval.ms enforcement (reference :2742) — runs
+            # regardless of coordinator state: a stalled app thread must
+            # be detected even while the coordinator is being re-queried
+            mpi = self.rk.conf.get("max.poll.interval.ms") / 1000.0
+            if (self.join_state == "steady" and not self.max_poll_exceeded
+                    and now - self.last_poll > mpi):
+                self.max_poll_exceeded = True
+                self.rk.op_err(KafkaError(
+                    Err._MAX_POLL_EXCEEDED,
+                    f"application maximum poll interval "
+                    f"({int(mpi * 1000)}ms) exceeded"))
+                self._leave()
+                return
         if self.state != "up":
+            # the coordinator lookup runs even without a subscription:
+            # commit()/committed() on an assign()-based or fresh consumer
+            # still needs the group coordinator (reference:
+            # rd_kafka_cgrp_serve drives the coord FSM unconditionally)
             self._coord_query(now)
+            return
+        if not self.subscription:
             return
         if self._pending:
             return
@@ -184,6 +197,7 @@ class ConsumerGroup:
             self.state = "init"
             return
         self.state = "up"
+        self.coord_ready.post()
         self.rk.dbg("cgrp", f"coordinator is broker {self.coord_id}")
 
     def _coord_broker(self):
@@ -404,9 +418,20 @@ class ConsumerGroup:
         rk = self.rk
         all_offsets = {k: v[0] for k, v in offsets.items()}
         store = rk.offset_store
+        file_items = {}
         if store is not None:
             file_items = {k: v for k, v in offsets.items()
                           if store.uses_file(k[0])}
+        if (len(file_items) < len(offsets)
+                and self._coord_broker() is None):
+            # broker-backed partitions present but no coordinator: fail
+            # BEFORE the file-store side effects so the sync commit()
+            # retry loop doesn't re-run store.commit_all/on_commit per
+            # attempt — nothing is committed on _WAIT_COORD
+            if cb:
+                cb(KafkaError(Err._WAIT_COORD, "no coordinator"), None)
+            return False
+        if store is not None:
             if file_items:
                 # plain-int offset dict: callbacks/interceptors keep the
                 # pre-metadata contract on every path
@@ -547,6 +572,16 @@ class ConsumerGroup:
         self.terminated = True
         offsets = self.rk.consumer.stored_offsets()
         if offsets and self.rk.conf.get("enable.auto.commit"):
-            self.commit_offsets(offsets, None)
-            time.sleep(0.05)  # give the commit a beat to transmit
+            # final auto-commit must reach the wire before LeaveGroup
+            # (reference: rd_kafka_cgrp_terminate waits for the commit
+            # reply) — block on the reply instead of sleeping
+            done = []
+            reply = SyncReply()
+
+            def _cb(err, resp):
+                done.append(err)
+                reply.post()
+
+            self.commit_offsets(offsets, _cb)
+            reply.wait(lambda: bool(done), 1.0)
         self._leave()

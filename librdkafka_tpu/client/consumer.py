@@ -23,7 +23,7 @@ from .errors import Err, KafkaError, KafkaException
 from .kafka import CONSUMER, Kafka
 from .msg import Message
 from .partition import FetchState, Toppar
-from .queue import Op, OpQueue, OpType
+from .queue import Op, OpQueue, OpType, SyncReply
 
 
 @dataclass
@@ -321,15 +321,36 @@ class Consumer:
             self._rk.cgrp.commit_offsets(to_commit, None)
             return None
         done = []
+        reply = SyncReply()
 
         def cb(err, resp):
             done.append(err)
+            reply.post()
 
-        self._rk.cgrp.commit_offsets(to_commit, cb)
+        cgrp = self._rk.cgrp
         deadline = time.monotonic() + 10
-        while not done and time.monotonic() < deadline:
-            time.sleep(0.005)
-        if done and done[0] is not None:
+        while True:
+            if cgrp.commit_offsets(to_commit, cb):
+                reply.wait(lambda: bool(done),
+                           max(0.0, deadline - time.monotonic()))
+                break
+            # coordinator not known yet (fresh/assign()-based consumer):
+            # commit_offsets already reported _WAIT_COORD into `done` —
+            # drop it, wait for the coord FSM (driven by the main-thread
+            # serve loop) to come up, and retry until the deadline
+            done.clear()
+            if time.monotonic() >= deadline:
+                done.append(KafkaError(Err._WAIT_COORD, "no coordinator"))
+                break
+            cgrp.coord_ready.wait(
+                lambda: cgrp.state == "up",
+                min(0.5, max(0.0, deadline - time.monotonic())))
+        if not done:
+            # request sent but no reply within the deadline — surface it
+            # (reference rd_kafka_commit returns _TIMED_OUT), never imply
+            # a successful commit the broker may not have applied
+            raise KafkaException(Err._TIMED_OUT, "commit reply timed out")
+        if done[0] is not None:
             raise KafkaException(done[0])
         return [TopicPartition(t, p, off)
                 for (t, p), off in to_commit.items()]
@@ -340,6 +361,7 @@ class Consumer:
             raise KafkaException(Err._UNKNOWN_GROUP, "requires group.id")
         result = {}
         done = []
+        reply = SyncReply()
 
         def cb(err, resp):
             if err is None:
@@ -348,12 +370,26 @@ class Consumer:
                         result[(tr["topic"], pr["partition"])] = (
                             pr["offset"], pr.get("metadata"))
             done.append(err)
+            reply.post()
 
-        self._rk.cgrp.fetch_committed(
-            [(p.topic, p.partition) for p in partitions], cb)
+        cgrp = self._rk.cgrp
+        keys = [(p.topic, p.partition) for p in partitions]
         deadline = time.monotonic() + timeout
-        while not done and time.monotonic() < deadline:
-            time.sleep(0.005)
+        while time.monotonic() < deadline:
+            if cgrp.fetch_committed(keys, cb):
+                reply.wait(lambda: bool(done),
+                           max(0.0, deadline - time.monotonic()))
+                break
+            # no coordinator yet — wait for the FSM and retry (the
+            # failed attempt resets cgrp.state, so this doesn't spin)
+            cgrp.coord_ready.wait(
+                lambda: cgrp.state == "up",
+                min(0.5, max(0.0, deadline - time.monotonic())))
+        if not done:
+            raise KafkaException(Err._TIMED_OUT,
+                                 "committed offsets not available")
+        if done[0] is not None:
+            raise KafkaException(done[0])
         out = []
         for p in partitions:
             off, meta = result.get((p.topic, p.partition),
@@ -433,6 +469,7 @@ class Consumer:
         offset -1 with NO error (reference semantics)."""
         rk = self._rk
         results: dict = {}
+        reply = SyncReply()
         deadline = time.monotonic() + timeout   # ONE budget for the call
 
         def make_cb(keys):
@@ -449,18 +486,21 @@ class Consumer:
                 else:
                     for k in keys:
                         results[k] = (-1, proto.OFFSET_INVALID)
+                reply.post()
             return cb
 
         # group by leader broker like the fetch path
         by_broker: dict = {}
         for tpo in partitions:
             tp = rk.get_toppar(tpo.topic, tpo.partition)
-            i = 0
             while tp.leader_id < 0 and time.monotonic() < deadline:
-                if i % 10 == 0:     # refresh at ~0.5s cadence, not 50ms
-                    rk.metadata_refresh("offsets_for_times")
-                i += 1
-                time.sleep(0.05)
+                # block on the metadata condvar (notified on every
+                # metadata update) instead of sleep-polling; the 0.5s
+                # cap re-issues the refresh if an update didn't help
+                rk.metadata_refresh("offsets_for_times")
+                rk.metadata_wait(
+                    lambda: tp.leader_id >= 0,
+                    min(0.5, max(0.0, deadline - time.monotonic())))
             by_broker.setdefault(tp.leader_id, []).append(tpo)
         for leader, tpos in by_broker.items():
             b = rk.brokers.get(leader)
@@ -478,9 +518,8 @@ class Consumer:
             keys = [(tpo.topic, tpo.partition) for tpo in tpos]
             b.enqueue_request(Request(ApiKey.ListOffsets, body,
                                       retries_left=2, cb=make_cb(keys)))
-        while (len(results) < len(partitions)
-               and time.monotonic() < deadline):
-            time.sleep(0.01)
+        reply.wait(lambda: len(results) >= len(partitions),
+                   max(0.0, deadline - time.monotonic()))
         out = []
         for tpo in partitions:
             key = (tpo.topic, tpo.partition)

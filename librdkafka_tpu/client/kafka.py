@@ -146,6 +146,11 @@ class Kafka:
         self._toppars_lock = threading.Lock()
         self.metadata: dict = {"brokers": {}, "topics": {}}
         self._metadata_lock = threading.Lock()
+        # notified (under _metadata_lock) after every metadata cache
+        # update; sync callers (list_topics, offsets_for_times leader
+        # wait) block here instead of sleep-polling (reference pattern:
+        # replyq pop in rd_kafka_metadata, rdkafka.c)
+        self._metadata_cond = threading.Condition(self._metadata_lock)
         self._metadata_inflight = False
         self._metadata_refresh_queued = False
         self._metadata_full_ts = 0.0   # completion time of last FULL refresh
@@ -171,6 +176,10 @@ class Kafka:
         # serializes COMPOUND transitions (msg_cnt release + dr_cnt
         # claim) against flush()'s combined read
         self._msg_cnt_lock = threading.Lock()
+        # flush() blocks here in DR-event mode; outstanding-count
+        # decrements notify it only while flushing is set (one bool
+        # check on the hot path, no wakeups otherwise)
+        self._outq_cond = threading.Condition(self._msg_cnt_lock)
         self.cgrp = None                       # set by Consumer
         self.consumer = None                   # back-ref set by Consumer
         self.interceptors = conf.get("interceptors") or None
@@ -484,6 +493,7 @@ class Kafka:
                 # stamped AFTER the cache update, inside the lock:
                 # list_topics waits on this to take a coherent snapshot
                 self._metadata_full_ts = time.monotonic()
+            self._metadata_cond.notify_all()
         if full and self.cgrp is not None:
             # regex subscription re-evaluation (rdkafka_pattern.c)
             self.cgrp.metadata_update(seen)
@@ -528,6 +538,23 @@ class Kafka:
                 if tp is not None:
                     self._assign_toppar_leader(tp, p["leader"])
         self._migrate_ua_msgs()
+        # second notify AFTER toppar leader assignment: waiters whose
+        # predicate is tp.leader_id >= 0 (offsets_for_times) observe the
+        # assignment, not just the raw cache update above
+        with self._metadata_cond:
+            self._metadata_cond.notify_all()
+
+    def metadata_wait(self, predicate, timeout: float) -> bool:
+        """Block until ``predicate()`` holds or ``timeout`` elapses,
+        waking on every metadata cache update (condvar, no polling)."""
+        deadline = time.monotonic() + timeout
+        with self._metadata_cond:
+            while not predicate():
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._metadata_cond.wait(remain)
+            return True
 
     def _assign_toppar_leader(self, tp: Toppar, leader: int):
         if tp.leader_id == leader:
@@ -557,21 +584,25 @@ class Kafka:
         with self._brokers_lock:
             b = self.brokers.get(broker_id)
             if b is None:
-                # unknown replica: our metadata is stale — refresh it
-                # and back the fetch off so the leader's record-less
-                # redirects don't hot-loop (reference:
-                # rd_kafka_fetch_preferred_replica_handle)
+                # unknown replica: our metadata is stale — back the fetch
+                # off so the leader's record-less redirects don't hot-loop
+                # (reference: rd_kafka_fetch_preferred_replica_handle).
+                # The refresh itself happens below, after the lock is
+                # released: metadata_refresh → any_up_broker re-acquires
+                # _brokers_lock, which is non-reentrant.
                 tp.fetch_backoff_until = time.monotonic() + \
                     self.conf.get("fetch.error.backoff.ms") / 1000.0
-                self.metadata_refresh(
-                    reason=f"unknown preferred replica {broker_id}")
-                return
-            old = tp.fetch_broker_id
-            tp.fetch_broker_id = broker_id
-            if old is not None and old != tp.leader_id \
-                    and old in self.brokers:
-                self.brokers[old].remove_toppar(tp)
-            b.add_toppar(tp)
+            else:
+                old = tp.fetch_broker_id
+                tp.fetch_broker_id = broker_id
+                if old is not None and old != tp.leader_id \
+                        and old in self.brokers:
+                    self.brokers[old].remove_toppar(tp)
+                b.add_toppar(tp)
+        if b is None:
+            self.metadata_refresh(
+                reason=f"unknown preferred replica {broker_id}")
+            return
         self.dbg("fetch",
                  f"{tp}: fetching from follower {broker_id} "
                  f"(leader {tp.leader_id})")
@@ -853,6 +884,8 @@ class Kafka:
         if isinstance(msgs, ArenaBatch):
             with self._msg_cnt_lock:
                 self._lane.acct(-msgs.count, -msgs.nbytes)
+                if self.flushing:
+                    self._outq_cond.notify_all()
             return
         if err is not None:
             for m in msgs:
@@ -874,6 +907,8 @@ class Kafka:
         with self._msg_cnt_lock:
             self._lane.acct(-len(msgs), -sum(m.size for m in msgs))
             self.dr_cnt += len(out)
+            if self.flushing and not out:
+                self._outq_cond.notify_all()
         if out:
             # one DR op per batch, not per message (queue-push overhead)
             self.rep.push(Op(OpType.DR, payload=out))
@@ -905,6 +940,8 @@ class Kafka:
         """A DR op reached the app (callback fired / event popped)."""
         with self._msg_cnt_lock:
             self.dr_cnt -= n
+            if self.flushing:
+                self._outq_cond.notify_all()
 
     def _serve_rep_op(self, op: Op):
         if op.type == OpType.DR:
@@ -975,9 +1012,19 @@ class Kafka:
                     return 0
                 self._wake_all_brokers()
                 if dr_event_mode:
-                    time.sleep(0.01)
+                    # block on the outq condvar (notified by every
+                    # outstanding-count decrement while flushing); the
+                    # 100ms cap re-wakes brokers if progress stalls
+                    with self._msg_cnt_lock:
+                        if self.msg_cnt + self.dr_cnt == 0:
+                            return 0
+                        self._outq_cond.wait(
+                            min(0.1, max(0.0,
+                                         deadline - time.monotonic())))
                 else:
-                    self.poll(0.01)
+                    # poll() itself blocks on the reply-queue condvar;
+                    # the short cap keeps the outer progress checks live
+                    self.poll(0.05)
             with self._msg_cnt_lock:
                 return self.msg_cnt + self.dr_cnt
         finally:

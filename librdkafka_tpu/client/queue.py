@@ -153,6 +153,37 @@ class OpQueue:
             return len(self._items)
 
 
+class SyncReply:
+    """Condvar-blocking reply slot for synchronous request/response
+    calls — the reference's pattern of enqueuing an op with a replyq
+    and blocking in rd_kafka_q_serve on it (rdkafka_queue.c:431),
+    without the op-object overhead: response callbacks call
+    :meth:`post` after recording their result; the caller blocks in
+    :meth:`wait` until its predicate holds or the deadline passes.
+    Replaces the sleep-polled waits flagged in rounds 2-3."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def post(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def wait(self, predicate: Callable[[], bool],
+             timeout: float) -> bool:
+        """Block until ``predicate()`` is true; returns False on
+        timeout. The predicate is evaluated under the condvar lock, so
+        a post() between check and wait cannot be lost."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not predicate():
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._cond.wait(remain)
+            return True
+
+
 @dataclass(order=True)
 class _Timer:
     next_fire: float

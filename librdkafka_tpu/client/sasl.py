@@ -85,8 +85,13 @@ def sasl_client_start(rk: "Kafka", broker: "Broker") -> None:
     elif mech == "GSSAPI":
         try:
             client = GssapiClient(rk, broker.host)
-        except KafkaException as e:
-            broker.sasl_done(e.error)
+        except Exception as e:
+            # python-gssapi raises gssapi.GSSError from Credentials/
+            # Name/SecurityContext construction (e.g. no ticket in the
+            # ccache); normalize it to a clean _AUTHENTICATION failure
+            # instead of letting it escape as a generic _FAIL
+            # disconnect/reconnect loop.
+            broker.sasl_done(_auth_error(e))
             return
     else:
         broker.sasl_done(KafkaError(
