@@ -76,6 +76,12 @@ class _PyLane:
         return (self.msg_cnt >= self.max_msgs
                 or self.msg_bytes + sz > self.max_bytes)
 
+    def map_set(self, topic, partition, entry):
+        self.map[(topic, partition)] = entry
+
+    def map_del(self, topic, partition):
+        return self.map.pop((topic, partition), None)
+
     def produce(self, *args, **kwargs):
         return self._fallback(*args, **kwargs)
 

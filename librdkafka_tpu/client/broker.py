@@ -819,7 +819,13 @@ class Broker:
                     if now < tp.retry_backoff_until:
                         continue
                     first_us = tp.arena.first_enq_us()
-                    full = len(tp.arena) >= batch_max
+                    # full by count OR by bytes: one message.max.bytes
+                    # worth is a complete wire batch — lingering past it
+                    # buys nothing (reference size gate in
+                    # rd_kafka_toppar_producer_serve, rdkafka_broker.c:3453)
+                    full = (len(tp.arena) >= batch_max
+                            or tp.arena.nbytes()
+                            >= rk.conf.get("message.max.bytes"))
                     lingered = (first_us >= 0
                                 and now - first_us / 1e6 >= linger)
                     if not (full or lingered or rk.flushing):

@@ -418,20 +418,15 @@ class ConsumerGroup:
         rk = self.rk
         all_offsets = {k: v[0] for k, v in offsets.items()}
         store = rk.offset_store
-        file_items = {}
+        # NOTE: file-backed items commit locally BEFORE the coordinator
+        # check — async/terminate callers get the partial file commit
+        # even during a coordinator outage (the reference's file store
+        # is purely local).  The sync commit() retry loop strips
+        # file-backed keys after the first attempt so they are not
+        # re-committed per retry.
         if store is not None:
             file_items = {k: v for k, v in offsets.items()
                           if store.uses_file(k[0])}
-        if (len(file_items) < len(offsets)
-                and self._coord_broker() is None):
-            # broker-backed partitions present but no coordinator: fail
-            # BEFORE the file-store side effects so the sync commit()
-            # retry loop doesn't re-run store.commit_all/on_commit per
-            # attempt — nothing is committed on _WAIT_COORD
-            if cb:
-                cb(KafkaError(Err._WAIT_COORD, "no coordinator"), None)
-            return False
-        if store is not None:
             if file_items:
                 # plain-int offset dict: callbacks/interceptors keep the
                 # pre-metadata contract on every path

@@ -328,6 +328,12 @@ class Consumer:
             reply.post()
 
         cgrp = self._rk.cgrp
+        # offsets= entries carry (offset, metadata) tuples internally;
+        # the returned TopicPartitions must carry the plain offset
+        result = [TopicPartition(t, p, off[0] if isinstance(off, tuple)
+                                 else off)
+                  for (t, p), off in to_commit.items()]
+        store = self._rk.offset_store
         deadline = time.monotonic() + 10
         while True:
             if cgrp.commit_offsets(to_commit, cb):
@@ -337,8 +343,17 @@ class Consumer:
             # coordinator not known yet (fresh/assign()-based consumer):
             # commit_offsets already reported _WAIT_COORD into `done` —
             # drop it, wait for the coord FSM (driven by the main-thread
-            # serve loop) to come up, and retry until the deadline
+            # serve loop) to come up, and retry until the deadline.
+            # File-backed items were committed locally by the failed
+            # attempt (commit_offsets does those before the coordinator
+            # check) — strip them so retries don't redo the side effects
             done.clear()
+            if store is not None:
+                to_commit = {k: v for k, v in to_commit.items()
+                             if not store.uses_file(k[0])}
+                if not to_commit:      # everything was file-backed: done
+                    done.append(None)
+                    break
             if time.monotonic() >= deadline:
                 done.append(KafkaError(Err._WAIT_COORD, "no coordinator"))
                 break
@@ -352,8 +367,7 @@ class Consumer:
             raise KafkaException(Err._TIMED_OUT, "commit reply timed out")
         if done[0] is not None:
             raise KafkaException(done[0])
-        return [TopicPartition(t, p, off)
-                for (t, p), off in to_commit.items()]
+        return result
 
     def committed(self, partitions: list[TopicPartition],
                   timeout: float = 10.0) -> list[TopicPartition]:

@@ -18,8 +18,9 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..protocol import apis, proto
-from ..protocol.msgset import (iter_batches, parse_msgset_v01,
-                               parse_records_v2, verify_crc_v2)
+from ..protocol.msgset import (iter_batches, parse_fetch_messages_v2,
+                               parse_msgset_v01, parse_records_v2,
+                               verify_crc_v2)
 from ..protocol.proto import ApiKey
 from .arena import ArenaBatch, arena_new, batch_msgids, lane_new
 from .broker import Broker, Request
@@ -629,7 +630,7 @@ class Kafka:
                    if t == topic and p >= cnt]
         for tp in tps:
             self._fast_tp.pop((tp.topic, tp.partition), None)
-            self._lane.map.pop((tp.topic, tp.partition), None)
+            self._lane.map_del(tp.topic, tp.partition)
             failed: list[Message] = []
             fast_cnt = fast_bytes = 0
             with tp.lock:
@@ -840,8 +841,9 @@ class Kafka:
                 return False
         self._fast_tp[(topic, partition)] = tp
         # register with the C entry point: subsequent produces for this
-        # toppar never enter a Python frame
-        self._lane.map[(topic, partition)] = (a, tp)
+        # toppar never enter a Python frame (map_set keeps the lane's
+        # last-topic lookup cache coherent — never mutate map directly)
+        self._lane.map_set(topic, partition, (a, tp))
         if a.append(key, value) == 1:
             self._wake_leader(tp)
         return True
@@ -865,7 +867,7 @@ class Kafka:
         it from the C entry's map FIRST so no new fast-lane records land
         while the arena drains into the msgq (FIFO preserved)."""
         key = (tp.topic, tp.partition)
-        self._lane.map.pop(key, None)
+        self._lane.map_del(tp.topic, tp.partition)
         self._fast_tp.pop(key, None)
         tp.demote_arena()
 
@@ -1236,6 +1238,7 @@ class Kafka:
                    for a in aborted_list}
         active_aborts: set[int] = set()
         msgs: list[Message] = []
+        msgs_bytes = 0
         next_offset = fo
         # mixed-format logs (written across a 0.11 upgrade): process
         # each same-format run in order; the single-format common case
@@ -1321,15 +1324,12 @@ class Kafka:
                         f"offset {info.base_offset}"))
                     tp.fetch_backoff_until = time.monotonic() + 0.5
                     return False
-                for r in parse_records_v2(info, payload):
-                    if r.offset < fo:
-                        continue
-                    m = Message(tp.topic, value=r.value, key=r.key,
-                                partition=tp.partition,
-                                headers=r.headers, timestamp=r.timestamp)
-                    m.offset = r.offset
-                    m.timestamp_type = r.timestamp_type
-                    msgs.append(m)
+                # direct Message materialization off the native field
+                # walk (no intermediate Record; ~1.5 us/msg on this path)
+                ms, mbytes = parse_fetch_messages_v2(
+                    info, payload, tp.topic, tp.partition, fo)
+                msgs.extend(ms)
+                msgs_bytes += mbytes
                 next_offset = last + 1
         else:
             dec = lambda codec, b: self.codec_provider.decompress_many(codec, [b])[0]
@@ -1340,6 +1340,7 @@ class Kafka:
                             partition=tp.partition, timestamp=r.timestamp)
                 m.offset = r.offset
                 msgs.append(m)
+                msgs_bytes += m.size
                 next_offset = max(next_offset, r.offset + 1)
 
         if tp.version != ver:
@@ -1352,7 +1353,7 @@ class Kafka:
         # accounting BEFORE the push: the app thread may drain the op
         # (decrements clamp at 0) the instant it becomes visible
         tp.fetchq_cnt += len(msgs)
-        tp.fetchq_bytes += sum(m.size for m in msgs)
+        tp.fetchq_bytes += msgs_bytes
         if msgs:
             # ONE op per parsed partition response (per-message op
             # push/pop dominated the consume profile)

@@ -62,6 +62,12 @@ class Message:
     def __len__(self) -> int:
         return self.size
 
+    def __bool__(self) -> bool:
+        # an empty message (size 0) must not be falsy: the idiomatic
+        # `m = c.poll(...); if m and not m.error:` loop would silently
+        # drop empty-value records via __len__ otherwise
+        return True
+
     def __repr__(self):
         return (f"Message({self.topic}[{self.partition}]@{self.offset}"
                 f"{' err=' + self.error.code.name if self.error else ''})")

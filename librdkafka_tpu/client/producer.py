@@ -32,17 +32,41 @@ class Producer:
     def produce_batch(self, topic: str, msgs: list[dict],
                       partition: int = PARTITION_UA) -> int:
         """Batch produce (reference: rd_kafka_produce_batch,
-        rdkafka_msg.c:478). Returns number enqueued."""
+        rdkafka_msg.c:478). Returns the number enqueued; like the
+        reference sets ``rkmessages[i].err``, each failed input dict
+        gets an ``"error"`` key with the per-message KafkaError (e.g.
+        MSG_SIZE_TOO_LARGE, _QUEUE_FULL) instead of being silently
+        dropped."""
+        from .errors import Err, KafkaError, KafkaException
+
         n = 0
-        for m in msgs:
+        i = 0
+        lane = self._rk._lane
+        batch_c = getattr(lane, "produce_batch", None)
+        total = len(msgs)
+        while i < total:
+            if batch_c is not None and isinstance(msgs, list):
+                # native run: eligible records append straight into
+                # their arenas with no Python frame per record; the C
+                # side stops at the first item needing this path
+                nxt, appended = batch_c(topic, msgs, i, partition)
+                n += appended
+                i = nxt
+                if i >= total:
+                    break
+            m = msgs[i]
+            i += 1
             try:
                 self.produce(topic, value=m.get("value"), key=m.get("key"),
                              partition=m.get("partition", partition),
                              headers=m.get("headers", ()),
                              timestamp=m.get("timestamp", 0))
                 n += 1
-            except Exception:
-                pass
+                m.pop("error", None)
+            except KafkaException as e:
+                m["error"] = e.error
+            except Exception as e:
+                m["error"] = KafkaError(Err._FAIL, repr(e))
         return n
 
     def poll(self, timeout: float = 0.0) -> int:

@@ -19,10 +19,17 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#if defined(__x86_64__) || defined(__i386__)
+#include <cpuid.h>
+#endif
 
 #define EXPORT extern "C" __attribute__((visibility("default")))
 
 // ---------------------------------------------------------------- crc32c --
+// Runtime hw/sw dispatch like the reference (crc32c.c:39 SSE4.2 path,
+// :138 runtime detect): the x86 crc32 instruction computes this exact
+// (Castagnoli, reflected) polynomial at ~1 cycle per 8 bytes vs ~3-4
+// cycles for the slice-by-8 table fold.
 
 static uint32_t crc32c_tab[8][256];
 static bool crc32c_init_done = false;
@@ -41,7 +48,7 @@ static void crc32c_init() {
     crc32c_init_done = true;
 }
 
-EXPORT uint32_t tk_crc32c(const uint8_t *p, int64_t n, uint32_t crc) {
+static uint32_t crc32c_sw(const uint8_t *p, int64_t n, uint32_t crc) {
     crc32c_init();
     crc = ~crc;
     while (n >= 8) {
@@ -56,6 +63,116 @@ EXPORT uint32_t tk_crc32c(const uint8_t *p, int64_t n, uint32_t crc) {
     }
     while (n-- > 0) crc = crc32c_tab[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
     return ~crc;
+}
+
+// GF(2) zero-advance: zshift[k] = columns of M^(2^k), where M advances
+// a raw CRC register through one zero byte (the combine math of
+// crc32c_combine, utils/crc.py, in C). Used to stitch the 3-stream
+// hardware fold back together.
+static uint32_t crc32c_zshift[64][32];
+static bool crc32c_zshift_done = false;
+
+static void crc32c_zshift_init() {
+    if (crc32c_zshift_done) return;
+    crc32c_init();
+    for (int j = 0; j < 32; j++) {       // M^1: one zero byte
+        uint32_t reg = 1u << j;
+        crc32c_zshift[0][j] = crc32c_tab[0][reg & 0xFF] ^ (reg >> 8);
+    }
+    for (int k = 1; k < 64; k++)         // M^(2^k) = (M^(2^(k-1)))^2
+        for (int j = 0; j < 32; j++) {
+            uint32_t v = crc32c_zshift[k - 1][j], acc = 0;
+            for (int b = 0; v; b++, v >>= 1)
+                if (v & 1) acc ^= crc32c_zshift[k - 1][b];
+            crc32c_zshift[k][j] = acc;
+        }
+    crc32c_zshift_done = true;
+}
+
+// advance raw register `reg` through `n` zero bytes
+static uint32_t crc32c_shift(uint32_t reg, int64_t n) {
+    crc32c_zshift_init();
+    for (int k = 0; n; k++, n >>= 1) {
+        if (n & 1) {
+            uint32_t acc = 0, v = reg;
+            for (int b = 0; v; b++, v >>= 1)
+                if (v & 1) acc ^= crc32c_zshift[k][b];
+            reg = acc;
+        }
+    }
+    return reg;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+static uint64_t crc32c_hw_fold(const uint8_t *p, int64_t n, uint64_t c) {
+    while (n >= 8) {
+        uint64_t v;
+        memcpy(&v, p, 8);
+        c = __builtin_ia32_crc32di(c, v);
+        p += 8; n -= 8;
+    }
+    uint32_t cc = (uint32_t)c;
+    while (n-- > 0) cc = __builtin_ia32_crc32qi(cc, *p++);
+    return cc;
+}
+
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(const uint8_t *p, int64_t n, uint32_t crc) {
+    uint64_t c0 = ~crc;
+    // the crc32 instruction is 1/cycle throughput but 3-cycle latency:
+    // a single dependent chain runs at 1/3 peak. Three independent
+    // contiguous thirds fold in parallel and are stitched with the
+    // GF(2) zero-advance (same math as crc32c_combine).
+    if (n >= 3 * 64) {
+        int64_t L = (n / 3) & ~7LL;          // 8-byte aligned lane length
+        const uint8_t *a = p, *b = p + L, *cst = p + 2 * L;
+        uint64_t ca = c0, cb = 0, cc = 0;
+        for (int64_t i = 0; i < L; i += 8) {
+            uint64_t va, vb, vc;
+            memcpy(&va, a + i, 8);
+            memcpy(&vb, b + i, 8);
+            memcpy(&vc, cst + i, 8);
+            ca = __builtin_ia32_crc32di(ca, va);
+            cb = __builtin_ia32_crc32di(cb, vb);
+            cc = __builtin_ia32_crc32di(cc, vc);
+        }
+        int64_t tail = n - 3 * L;            // fold [3L, n) into lane C
+        cc = crc32c_hw_fold(p + 3 * L, tail, cc);
+        uint32_t reg = crc32c_shift((uint32_t)ca, L + L + tail)
+                     ^ crc32c_shift((uint32_t)cb, L + tail)
+                     ^ (uint32_t)cc;
+        return ~reg;
+    }
+    return ~(uint32_t)crc32c_hw_fold(p, n, c0);
+}
+
+static bool cpu_has_sse42() {
+    unsigned a = 0, b = 0, c = 0, d = 0;
+    if (!__get_cpuid(1, &a, &b, &c, &d)) return false;
+    return (c & (1u << 20)) != 0;
+}
+#endif
+
+typedef uint32_t (*crc32c_fn)(const uint8_t *, int64_t, uint32_t);
+
+static crc32c_fn crc32c_pick() {
+#if defined(__x86_64__)
+    if (cpu_has_sse42()) return crc32c_hw;
+#endif
+    return crc32c_sw;
+}
+
+static crc32c_fn crc32c_impl = nullptr;
+
+EXPORT uint32_t tk_crc32c(const uint8_t *p, int64_t n, uint32_t crc) {
+    if (!crc32c_impl) crc32c_impl = crc32c_pick();
+    return crc32c_impl(p, n, crc);
+}
+
+// sw path kept callable for tests (hw/sw bit-exactness cross-check)
+EXPORT uint32_t tk_crc32c_sw(const uint8_t *p, int64_t n, uint32_t crc) {
+    return crc32c_sw(p, n, crc);
 }
 
 // Batched CRC over many slices of one base buffer (one call per launch).
@@ -172,6 +289,116 @@ EXPORT int64_t tk_lz4_block_compress(const uint8_t *src, int64_t n,
     return o;
 }
 
+// -------------------------------------------------- LZ4 block encode, fast --
+//
+// Throughput-first encoder for the CPU provider's default path. Same
+// public LZ4 block format (any decoder accepts it), different parse:
+//   - 13-bit hash over 5 bytes, insert only at sequence starts plus two
+//     interior anchor points (match interiors are skipped — on
+//     compressible streams this is the difference between ~100 MB/s for
+//     the insert-all deterministic spec above and >500 MB/s here)
+//   - miss acceleration: step grows every 64 consecutive misses
+//   - 8-bytes-at-a-time match extension (XOR + count-trailing-zeros)
+// The deterministic insert-all encoder above remains the
+// compression.backend=tpu bit-exactness contract; this one is what the
+// broker hot path uses (reference ships vendored lz4 fast mode for the
+// same role, rdkafka_lz4.c + lz4.c).
+
+static const int LZ4F_HASH_BITS = 13;
+
+static inline uint32_t lz4_hash5(uint64_t x) {
+    return (uint32_t)(((x << 24) * 889523592379ULL) >> (64 - LZ4F_HASH_BITS));
+}
+
+EXPORT int64_t tk_lz4_block_compress_fast(const uint8_t *src, int64_t n,
+                                          uint8_t *dst, int64_t cap) {
+    if (n < 0 || cap < tk_lz4_block_bound(n)) return -1;
+    if (n < 13) {   // too short for the main loop: all-literal block
+        int64_t o = 0;
+        uint8_t *tok = dst + o++;
+        *tok = (uint8_t)(n << 4);
+        memcpy(dst + o, src, n);
+        return o + n;
+    }
+    int32_t table[1 << LZ4F_HASH_BITS];
+    memset(table, -1, sizeof(table));
+    int64_t anchor = 0, p = 0, o = 0;
+    const int64_t mflimit = n - 12;      // last match must start before
+    int64_t misses = 1 << 6;
+    while (p <= mflimit) {
+        uint64_t seq8;
+        memcpy(&seq8, src + p, 8);
+        uint32_t h = lz4_hash5(seq8);
+        int64_t cand = table[h];
+        table[h] = (int32_t)p;
+        if (cand < 0 || p - cand > 65535
+            || (uint32_t)seq8 != rd32le(src + cand)) {
+            p += (misses++ >> 6);
+            continue;
+        }
+        misses = 1 << 6;
+        // back-extend over pending literals (free compression)
+        while (p > anchor && cand > 0 && src[p - 1] == src[cand - 1]) {
+            p--; cand--;
+        }
+        // forward extension, 8 bytes at a time
+        int64_t mlen = 4;
+        const int64_t safe = n - 5;      // last 5 bytes must be literals
+        {
+            int64_t q = p + 4, c = cand + 4;
+            while (q + 8 <= safe) {
+                uint64_t a, b;
+                memcpy(&a, src + q, 8);
+                memcpy(&b, src + c, 8);
+                uint64_t x = a ^ b;
+                if (x) { mlen += __builtin_ctzll(x) >> 3; goto emit; }
+                q += 8; c += 8; mlen += 8;
+            }
+            while (q < safe && src[q] == src[c]) { q++; c++; mlen++; }
+        }
+    emit:;
+        int64_t lit = p - anchor;
+        uint8_t *tok = dst + o++;
+        if (lit >= 15) {
+            *tok = 0xF0;
+            int64_t rem = lit - 15;
+            while (rem >= 255) { dst[o++] = 255; rem -= 255; }
+            dst[o++] = (uint8_t)rem;
+        } else *tok = (uint8_t)(lit << 4);
+        memcpy(dst + o, src + anchor, lit); o += lit;
+        uint16_t off = (uint16_t)(p - cand);
+        dst[o++] = off & 0xFF; dst[o++] = off >> 8;
+        int64_t mrem = mlen - 4;
+        if (mrem >= 15) {
+            *tok |= 0x0F;
+            mrem -= 15;
+            while (mrem >= 255) { dst[o++] = 255; mrem -= 255; }
+            dst[o++] = (uint8_t)mrem;
+        } else *tok |= (uint8_t)mrem;
+        // two interior anchors keep long-range matches findable without
+        // the insert-all tax
+        if (p + 2 + 8 <= n)
+            { uint64_t v; memcpy(&v, src + p + 2, 8);
+              table[lz4_hash5(v)] = (int32_t)(p + 2); }
+        p += mlen;
+        if (p - 2 >= 0 && p - 2 + 8 <= n)
+            { uint64_t v; memcpy(&v, src + p - 2, 8);
+              table[lz4_hash5(v)] = (int32_t)(p - 2); }
+        anchor = p;
+    }
+    // final literal run
+    int64_t lit = n - anchor;
+    uint8_t *tok = dst + o++;
+    if (lit >= 15) {
+        *tok = 0xF0;
+        int64_t rem = lit - 15;
+        while (rem >= 255) { dst[o++] = 255; rem -= 255; }
+        dst[o++] = (uint8_t)rem;
+    } else *tok = (uint8_t)(lit << 4);
+    memcpy(dst + o, src + anchor, lit); o += lit;
+    return o;
+}
+
 // ------------------------------------------------------- LZ4 block decode --
 
 // hist = decoded bytes present before dst (for linked-block frames whose
@@ -201,7 +428,15 @@ static int64_t lz4_block_decompress_hist(const uint8_t *src, int64_t n,
         }
         if (o + mlen > cap) return -4;
         const uint8_t *m = dst + o - off;
-        for (int64_t k = 0; k < mlen; k++) dst[o + k] = m[k];  // overlap-safe
+        if (off >= 8) {
+            // non-overlapping at word granularity: 8-byte strided copy
+            // (the byte loop measured ~0.6 GB/s on the fetch path)
+            int64_t k = 0;
+            for (; k + 8 <= mlen; k += 8) memcpy(dst + o + k, m + k, 8);
+            for (; k < mlen; k++) dst[o + k] = m[k];
+        } else {
+            for (int64_t k = 0; k < mlen; k++) dst[o + k] = m[k];  // overlap
+        }
         o += mlen;
     }
     return o;
@@ -230,8 +465,10 @@ EXPORT int64_t tk_lz4f_bound(int64_t n) {
     return 7 + n + n / 255 + blocks * 20 + 8;
 }
 
-EXPORT int64_t tk_lz4f_compress(const uint8_t *src, int64_t n,
-                                uint8_t *dst, int64_t cap) {
+static int64_t lz4f_compress_impl(const uint8_t *src, int64_t n,
+                                  uint8_t *dst, int64_t cap,
+                                  int64_t (*block)(const uint8_t *, int64_t,
+                                                   uint8_t *, int64_t)) {
     if (cap < tk_lz4f_bound(n)) return -1;
     int64_t o = 0;
     uint32_t magic = LZ4F_MAGIC;
@@ -241,8 +478,7 @@ EXPORT int64_t tk_lz4f_compress(const uint8_t *src, int64_t n,
     dst[o] = (uint8_t)(tk_xxh32(dst + 4, 2, 0) >> 8); o++;  // HC
     for (int64_t pos = 0; pos < n; pos += LZ4F_BLOCKSIZE) {
         int64_t blen = n - pos < LZ4F_BLOCKSIZE ? n - pos : LZ4F_BLOCKSIZE;
-        int64_t csize = tk_lz4_block_compress(src + pos, blen, dst + o + 4,
-                                              cap - o - 4);
+        int64_t csize = block(src + pos, blen, dst + o + 4, cap - o - 4);
         if (csize < 0) return -1;
         uint32_t hdr;
         if (csize < blen) {
@@ -257,6 +493,18 @@ EXPORT int64_t tk_lz4f_compress(const uint8_t *src, int64_t n,
     uint32_t endmark = 0;
     memcpy(dst + o, &endmark, 4); o += 4;
     return o;
+}
+
+EXPORT int64_t tk_lz4f_compress(const uint8_t *src, int64_t n,
+                                uint8_t *dst, int64_t cap) {
+    return lz4f_compress_impl(src, n, dst, cap, tk_lz4_block_compress);
+}
+
+// Fast-parse frame: same spec-compliant wire format, throughput-first
+// block encoder (the broker hot path's default).
+EXPORT int64_t tk_lz4f_compress_fast(const uint8_t *src, int64_t n,
+                                     uint8_t *dst, int64_t cap) {
+    return lz4f_compress_impl(src, n, dst, cap, tk_lz4_block_compress_fast);
 }
 
 EXPORT int64_t tk_lz4f_decompress(const uint8_t *src, int64_t n,
@@ -504,10 +752,11 @@ EXPORT int64_t tk_frame_v2(const uint8_t *base, const int32_t *klens,
 #include <atomic>
 #include <vector>
 
-EXPORT void tk_lz4f_compress_many(const uint8_t *base, const int64_t *offs,
-                                  const int64_t *lens, int n,
-                                  uint8_t *outbase, const int64_t *out_offs,
-                                  int64_t *out_lens, int nthreads) {
+static void lz4f_compress_many_impl(
+    const uint8_t *base, const int64_t *offs, const int64_t *lens, int n,
+    uint8_t *outbase, const int64_t *out_offs, int64_t *out_lens,
+    int nthreads,
+    int64_t (*one)(const uint8_t *, int64_t, uint8_t *, int64_t)) {
     if (n <= 0) return;
     unsigned hw = std::thread::hardware_concurrency();
     int nt = nthreads > 0 ? nthreads : (hw ? (int)hw : 4);
@@ -516,15 +765,31 @@ EXPORT void tk_lz4f_compress_many(const uint8_t *base, const int64_t *offs,
     auto work = [&]() {
         int i;
         while ((i = next.fetch_add(1)) < n) {
-            out_lens[i] = tk_lz4f_compress(base + offs[i], lens[i],
-                                           outbase + out_offs[i],
-                                           tk_lz4f_bound(lens[i]));
+            out_lens[i] = one(base + offs[i], lens[i],
+                              outbase + out_offs[i],
+                              tk_lz4f_bound(lens[i]));
         }
     };
     if (nt == 1) { work(); return; }
     std::vector<std::thread> ts;
     for (int t = 0; t < nt; t++) ts.emplace_back(work);
     for (auto &t : ts) t.join();
+}
+
+EXPORT void tk_lz4f_compress_many(const uint8_t *base, const int64_t *offs,
+                                  const int64_t *lens, int n,
+                                  uint8_t *outbase, const int64_t *out_offs,
+                                  int64_t *out_lens, int nthreads) {
+    lz4f_compress_many_impl(base, offs, lens, n, outbase, out_offs,
+                            out_lens, nthreads, tk_lz4f_compress);
+}
+
+EXPORT void tk_lz4f_compress_many_fast(
+    const uint8_t *base, const int64_t *offs, const int64_t *lens, int n,
+    uint8_t *outbase, const int64_t *out_offs, int64_t *out_lens,
+    int nthreads) {
+    lz4f_compress_many_impl(base, offs, lens, n, outbase, out_offs,
+                            out_lens, nthreads, tk_lz4f_compress_fast);
 }
 
 EXPORT void tk_snappy_compress_many(const uint8_t *base, const int64_t *offs,

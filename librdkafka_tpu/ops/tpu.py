@@ -191,9 +191,11 @@ class TpuCodecProvider:
 
     def compress_many(self, codec: str, bufs: list[bytes], level: int = -1
                       ) -> list[bytes]:
-        # lz4 compresses on the native CPU path unless tpu.lz4.force:
-        # wire bytes are identical either way, and the device encoder
-        # only exists to prove bit-exactness, not to win (PERF.md §3)
+        # lz4 compresses on the native CPU path unless tpu.lz4.force.
+        # The forced device encoder emits the deterministic insert-all
+        # spec, bit-identical to cpu.lz4f_compress_many(
+        # deterministic=True) — it exists to prove bit-exactness, not to
+        # win (PERF.md §3); the default route is the CPU fast parse.
         if (codec == "lz4" and self.lz4_force
                 and len(bufs) >= self.min_batches):
             return self._lz4f_compress_many(bufs)

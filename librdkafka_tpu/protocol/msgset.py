@@ -397,6 +397,92 @@ def _parse_records_v2_native(info: BatchInfo,
     return out
 
 
+def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
+                            topic: str, partition: int,
+                            fo: int) -> tuple[list, int]:
+    """Fetch hot path: build delivery-ready client Message objects
+    straight off the native field walk — no intermediate Record and no
+    Message.__init__ (its two clock reads and len() calls cost ~1.5
+    us/record against the ~2.5 us/msg consume budget). Records below
+    ``fo`` are skipped here so the caller doesn't re-walk the list.
+    Returns (messages, payload_bytes_total).
+
+    Falls back to the Record path when the native walk is unavailable.
+    (Late client import: the client layer imports protocol at module
+    level, so this call-time import cannot cycle.)"""
+    from ..client.msg import Message, MsgStatus
+
+    import ctypes
+
+    import numpy as np
+
+    from ..ops import cpu as _cpu
+    try:
+        L = _cpu.lib()
+    except Exception:
+        out0, total0 = [], 0
+        for r in parse_records_v2(info, records_bytes):
+            if r.offset < fo:
+                continue
+            m = Message(topic, value=r.value, key=r.key,
+                        partition=partition, headers=r.headers,
+                        timestamp=r.timestamp)
+            m.offset = r.offset
+            m.timestamp_type = r.timestamp_type
+            out0.append(m)
+            total0 += m.size
+        return out0, total0
+    n = info.record_count
+    if n <= 0:
+        return [], 0
+    if n > len(records_bytes) / 7 + 1:
+        raise CrcMismatch(
+            f"record_count {n} impossible for {len(records_bytes)} bytes")
+    fields = np.empty((n, 8), dtype=np.int64)
+    got = L.tk_parse_v2(
+        records_bytes, len(records_bytes), n,
+        fields.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if got != n:
+        raise CrcMismatch(f"malformed v2 records: parsed {got} of {n}")
+    tstype = (proto.TSTYPE_LOG_APPEND_TIME
+              if info.attrs & proto.ATTR_TIMESTAMP_TYPE
+              else proto.TSTYPE_CREATE_TIME)
+    base_ts = info.first_timestamp
+    base_off = info.base_offset
+    not_persisted = MsgStatus.NOT_PERSISTED
+    new = Message.__new__
+    out = []
+    append = out.append
+    total = 0
+    for ts_d, off_d, ko, kl, vo, vl, ho, nh in fields.tolist():
+        off = base_off + off_d
+        if off < fo:
+            continue
+        m = new(Message)
+        m.topic = topic
+        m.partition = partition
+        m.key = records_bytes[ko:ko + kl] if kl >= 0 else None
+        m.value = records_bytes[vo:vo + vl] if vl >= 0 else None
+        m.headers = _parse_headers(records_bytes, ho, nh) if nh else []
+        m.offset = off
+        m.timestamp = base_ts + ts_d
+        m.timestamp_type = tstype
+        m.error = None
+        m.opaque = None
+        m.msgid = 0
+        m.retries = 0
+        m.status = not_persisted
+        m.enq_time = 0.0
+        m.ts_backoff = 0.0
+        m.latency_us = 0
+        m.on_delivery = None
+        sz = (vl if vl > 0 else 0) + (kl if kl > 0 else 0)
+        m.size = sz
+        total += sz
+        append(m)
+    return out, total
+
+
 def _parse_headers(buf: bytes, off: int, nh: int) -> list:
     sl = Slice(buf)
     sl.skip(off)

@@ -113,6 +113,36 @@ def test_lz4_frame_vs_system_decoder(lz4lib, name):
         lz4lib.LZ4F_freeDecompressionContext(ctx)
 
 
+@pytest.mark.parametrize("name", IDS)
+def test_lz4_fast_block_vs_system_decoder(lz4lib, name):
+    """The throughput-first fast-parse encoder (the broker hot path's
+    default, tk_lz4_block_compress_fast) must also emit spec-compliant
+    streams the REAL liblz4 decodes byte-exactly."""
+    data = CORPORA[name]
+    if len(data) > 65536:
+        data = data[:65536]
+    L = cpu.lib()
+    cap = L.tk_lz4_block_bound(len(data))
+    buf = ctypes.create_string_buffer(cap)
+    p = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+    n = L.tk_lz4_block_compress_fast(bytes(data), len(data), p, cap)
+    assert n >= 0
+    comp = buf.raw[:n]
+    dst = ctypes.create_string_buffer(max(len(data), 1))
+    r = lz4lib.LZ4_decompress_safe(comp, dst, len(comp), len(data))
+    assert r == len(data)
+    assert dst.raw[:r] == data
+
+
+@pytest.mark.parametrize("name", IDS)
+def test_lz4_fast_frame_roundtrip(name):
+    data = CORPORA[name]
+    comp, = cpu.lz4f_compress_many([data])          # fast default
+    assert cpu.lz4_decompress(comp, len(data)) == data
+    det, = cpu.lz4f_compress_many([data], deterministic=True)
+    assert det == cpu.lz4_compress(data)            # spec anchor intact
+
+
 def test_lz4_frame_decode_foreign(lz4lib):
     """Our decoder must read frames produced by the real liblz4 too."""
     data = CORPORA["json_like"]

@@ -150,8 +150,15 @@ def test_compress_many_batched(tpu_provider):
     bufs = [CORPORA["json_like"], CORPORA["over_64k"], b"tiny",
             CORPORA["random_100k"], CORPORA["near_64k"]]
     got = tpu_provider.compress_many("lz4", bufs)
-    want = cpu.CpuCodecProvider().compress_many("lz4", bufs)
+    # the forced device encoder's contract is the DETERMINISTIC spec
+    # (the CPU provider's default hot path is the fast parse — same
+    # wire format, different bytes)
+    want = cpu.lz4f_compress_many(bufs, deterministic=True)
     assert got == want
+    # and both decode to the originals
+    for g, b in zip(cpu.CpuCodecProvider().compress_many("lz4", bufs),
+                    bufs):
+        assert cpu.lz4_decompress(g, len(b)) == bytes(b)
 
 
 def test_other_codecs_fall_back(tpu_provider):

@@ -1,0 +1,56 @@
+"""produce_batch per-message error parity (reference:
+rd_kafka_produce_batch sets rkmessages[i].err, rdkafka_msg.c:478):
+a mixed batch must report which messages failed and why, not silently
+drop them."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.client.errors import Err
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={"t0121": 2})
+    yield c
+    c.stop()
+
+
+def test_produce_batch_per_message_errors(cluster):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "message.max.bytes": 1000})
+    msgs = [
+        {"value": b"ok-1", "partition": 0},
+        {"value": b"x" * 2000, "partition": 0},        # oversize
+        {"value": b"ok-2", "key": b"k", "partition": 1},
+        {"value": b"x" * 5000, "partition": 1},        # oversize
+        {"value": b"ok-3", "partition": 0},
+    ]
+    n = p.produce_batch("t0121", msgs)
+    assert n == 3
+    assert "error" not in msgs[0]
+    assert msgs[1]["error"].code == Err.MSG_SIZE_TOO_LARGE
+    assert "error" not in msgs[2]
+    assert msgs[3]["error"].code == Err.MSG_SIZE_TOO_LARGE
+    assert "error" not in msgs[4]
+    assert p.flush(10) == 0
+    p.close()
+
+
+def test_produce_batch_queue_full():
+    # tiny queue: overflow must surface _QUEUE_FULL per message, and the
+    # count must reflect only the enqueued ones.  No broker: nothing
+    # drains the queue mid-batch.
+    p = Producer({"bootstrap.servers": "127.0.0.1:1",
+                  "queue.buffering.max.messages": 5,
+                  "message.timeout.ms": 100})
+    msgs = [{"value": b"v%d" % i, "partition": 0} for i in range(8)]
+    n = p.produce_batch("t0121q", msgs)
+    assert n == 5
+    errs = [m.get("error") for m in msgs]
+    assert [e.code for e in errs if e] == [Err._QUEUE_FULL] * 3
+    p.purge(in_queue=True)
+    p.flush(2)
+    p.close()
