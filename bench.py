@@ -99,8 +99,19 @@ def _external_mock(toppars: int) -> str:
     return _MOCK_BS
 
 
+def _reset_mock():
+    """Kill the cached external mock so the next pipeline call starts a
+    fresh one (e.g. with a different partition count)."""
+    global _MOCK_PROC, _MOCK_BS
+    if _MOCK_PROC is not None:
+        _MOCK_PROC.kill()
+    _MOCK_PROC = None
+    _MOCK_BS = None
+
+
 def host_pipeline(n_msgs: int, size: int, toppars: int,
-                  backend: str = "cpu") -> float:
+                  backend: str = "cpu",
+                  extra_conf: dict | None = None) -> float:
     """End-to-end producer msgs/s against an external mock broker
     process (the rdkafka_performance -P analog)."""
     from librdkafka_tpu import Producer
@@ -112,6 +123,7 @@ def host_pipeline(n_msgs: int, size: int, toppars: int,
         "batch.num.messages": 10000,
         "linger.ms": 50,
         "queue.buffering.max.messages": 2_000_000,
+        **(extra_conf or {}),
     })
     vals = _payloads(min(n_msgs, 4096), size)
     if backend == "tpu":
@@ -210,13 +222,18 @@ def codec_offload():
     blocks = [rng.integers(0, 256, blk, dtype=np.uint8).tobytes()
               for _ in range(B)]
 
-    # --- CPU provider (median of 5; same statistic as the TPU side) -----
+    # --- CPU provider: pinned statistic (r3 verdict weak #4: the CPU
+    # side swung 5.6-13.7x with shared-host load). 11 trials, report
+    # BOTH the median (the loaded-host number the run actually saw) and
+    # the min (the idle-host capability) so vs_baseline is attributable;
+    # vs_baseline uses the MIN — the conservative comparison point.
     cpu_times = []
-    for _ in range(5):
+    for _ in range(11):
         t0 = time.perf_counter()
         ref = cpu.crc32c_many(blocks)
         cpu_times.append((time.perf_counter() - t0) * 1000)
-    cpu_ms = sorted(cpu_times)[2]
+    cpu_ms_median = sorted(cpu_times)[5]
+    cpu_ms = min(cpu_times)
 
     # --- transport probe -------------------------------------------------
     h = np.zeros((4, blk), np.uint8)
@@ -292,6 +309,7 @@ def codec_offload():
     mb = B * blk / (1 << 20)
     return {
         "cpu_crc_ms": round(cpu_ms, 3),
+        "cpu_crc_ms_median": round(cpu_ms_median, 3),
         "tpu_crc_device_ms": round(tpu_crc_ms, 3),
         "tpu_crc_mb_s": round(mb / (tpu_crc_ms / 1000), 1),
         "cpu_crc_mb_s": round(mb / (cpu_ms / 1000), 1),
@@ -336,16 +354,26 @@ def main():
     except Exception as e:
         # null in the JSON must be diagnosable, never silent
         print(f"consumer_pipeline failed: {e!r}", file=sys.stderr)
+    # BASELINE config 5: 64-toppar idempotent producer (fresh mock with
+    # 64 partitions; PID FSM + per-batch sequence numbering in play)
+    idem_rate = None
+    try:
+        _reset_mock()
+        idem_rate = host_pipeline(
+            n_msgs, size, 64,
+            extra_conf={"enable.idempotence": True})
+    except Exception as e:
+        print(f"idempotent_64tp failed: {e!r}", file=sys.stderr)
     finally:
-        if _MOCK_PROC is not None:
-            _MOCK_PROC.kill()
+        _reset_mock()
     off = codec_offload()
     print(json.dumps({
         "metric": "batched CRC32C codec offload, 128x64KB partition "
-                  "batches (64 toppars x 2 blocks): TPU one-matmul MXU "
-                  "kernel device time vs native CPU provider (bit-exact; "
-                  "see PERF.md — the dev tunnel is 2-3 MB/s so e2e "
-                  "offload measures transport, not kernels)",
+                  "batches (64 toppars x 2 blocks): TPU plane-split MXU "
+                  "kernel device rate, bit-exact vs the native CPU "
+                  "provider (vs_baseline = idle-host CPU time / device "
+                  "time; see PERF.md — the dev tunnel is 2-3 MB/s so "
+                  "e2e offload measures transport, not kernels)",
         "value": off["tpu_crc_mb_s"],
         "unit": "MB/s",
         "vs_baseline": off["speedup"],
@@ -353,6 +381,8 @@ def main():
         "host_pipeline_tpu_backend_msgs_s": round(tpu_backend_rate, 1),
         "consumer_pipeline_msgs_s":
             round(consumer_rate, 1) if consumer_rate is not None else None,
+        "idempotent_64tp_msgs_s":
+            round(idem_rate, 1) if idem_rate is not None else None,
         "detail": off,
     }))
 

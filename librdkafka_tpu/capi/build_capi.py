@@ -25,10 +25,17 @@ typedef struct tk_msg {
     char   *payload;    /* NULL only for null-value records */
     size_t  len;
     int     err;        /* 0 = ok */
+    char   *headers;    /* JSON [[name, value|null], ...] (values are
+                         * latin-1-mapped bytes); NULL when none */
 } tk_msg_t;
 
 /* Handles are opaque integers (0 = error; details in errstr). */
 typedef long long tk_handle_t;
+
+/* Per-message delivery report trampoline (reference dr_msg_cb):
+ * err 0 = delivered; opaque is the value passed to tk_produce2. */
+typedef void (*tk_dr_cb_t)(long long opaque, int err,
+                           int32_t partition, int64_t offset);
 """
 
 FUNCS = r"""
@@ -39,9 +46,37 @@ extern tk_handle_t tk_consumer_new(const char *conf_json,
 extern int  tk_produce(tk_handle_t h, const char *topic, int32_t partition,
                        const char *key, size_t key_len,
                        const char *payload, size_t len);
+extern int  tk_produce2(tk_handle_t h, const char *topic,
+                        int32_t partition,
+                        const char *key, size_t key_len,
+                        const char *payload, size_t len,
+                        int64_t timestamp_ms,
+                        const char **hdr_names, const char **hdr_vals,
+                        const size_t *hdr_val_lens, int hdr_cnt,
+                        long long opaque);
+extern long long tk_produce_batch(tk_handle_t h, const char *topic,
+                                  int32_t partition, const char *base,
+                                  const int32_t *klens,
+                                  const int32_t *vlens, int count);
+extern int  tk_set_dr_cb(tk_handle_t h, tk_dr_cb_t cb);
+extern int  tk_poll(tk_handle_t h, int timeout_ms);
+extern long long tk_outq_len(tk_handle_t h);
 extern int  tk_flush(tk_handle_t h, int timeout_ms);
 extern int  tk_subscribe(tk_handle_t h, const char *topics_csv);
+extern int  tk_assign(tk_handle_t h, const char *topic,
+                      const int32_t *partitions,
+                      const int64_t *offsets, int nparts);
+extern int  tk_unassign(tk_handle_t h);
 extern int  tk_consumer_poll(tk_handle_t h, int timeout_ms, tk_msg_t *out);
+extern int  tk_commit(tk_handle_t h, int async_flag);
+extern long long tk_committed(tk_handle_t h, const char *topic,
+                              int32_t partition, int timeout_ms);
+extern int  tk_seek(tk_handle_t h, const char *topic, int32_t partition,
+                    int64_t offset);
+extern int  tk_create_topic(tk_handle_t h, const char *topic,
+                            int num_partitions, int timeout_ms);
+extern int  tk_delete_topic(tk_handle_t h, const char *topic,
+                            int timeout_ms);
 extern void tk_msg_free(tk_msg_t *m);
 extern int  tk_mock_bootstrap(tk_handle_t h, char *buf, int size);
 extern void tk_destroy(tk_handle_t h);
@@ -124,6 +159,264 @@ def tk_flush(h, timeout_ms):
         return -1
 
 
+_dr_cbs = {}     # handle -> C function pointer (tk_dr_cb_t)
+
+
+@ffi.def_extern()
+def tk_set_dr_cb(h, cb):
+    if _handles.get(h) is None:
+        return -1
+    _dr_cbs[h] = cb
+    return 0
+
+
+def _dr_trampoline(h, opaque):
+    cb = _dr_cbs.get(h)
+    if cb is None or cb == ffi.NULL:
+        return None
+
+    def on_delivery(err, m, _cb=cb, _op=opaque):
+        _cb(_op, int(err.code) if err is not None else 0,
+            m.partition, m.offset if m.offset is not None else -1)
+    return on_delivery
+
+
+@ffi.def_extern()
+def tk_produce2(h, topic, partition, key, key_len, payload, length,
+                timestamp_ms, hdr_names, hdr_vals, hdr_val_lens,
+                hdr_cnt, opaque):
+    # produce with headers / timestamp / per-message opaque + DR
+    # callback (reference rd_kafka_producev with RD_KAFKA_V_HEADER /
+    # V_OPAQUE / V_TIMESTAMP).
+    p = _handles.get(h)
+    if p is None:
+        return -1
+    try:
+        headers = []
+        for i in range(hdr_cnt):
+            name = ffi.string(hdr_names[i]).decode()
+            if hdr_vals[i] == ffi.NULL:
+                headers.append((name, None))
+            else:
+                headers.append((name, bytes(
+                    ffi.buffer(hdr_vals[i], hdr_val_lens[i]))))
+        p.produce(ffi.string(topic).decode(),
+                  value=bytes(ffi.buffer(payload, length))
+                  if payload != ffi.NULL else None,
+                  key=bytes(ffi.buffer(key, key_len))
+                  if key != ffi.NULL else None,
+                  partition=partition,
+                  timestamp=int(timestamp_ms) if timestamp_ms > 0 else 0,
+                  headers=headers,
+                  on_delivery=_dr_trampoline(h, opaque))
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_produce_batch(h, topic, partition, base, klens, vlens, count):
+    # Arena-layout batch produce: base = concatenated key||value
+    # bytes, klens/vlens int32 arrays (-1 = null) -- the same memory
+    # layout the enqueue lane's Arena uses internally, so the whole run
+    # appends in ONE native pass (reference rd_kafka_produce_batch,
+    # rdkafka_msg.c:478). Returns records enqueued.
+    p = _handles.get(h)
+    if p is None:
+        return -1
+    done = 0
+    try:
+        t = ffi.string(topic).decode()
+        lane = p._rk._lane
+        raw = getattr(lane, "produce_raw", None)
+        import numpy as _np
+        ka = _np.frombuffer(bytes(ffi.buffer(klens, count * 4)),
+                            dtype=_np.int32)
+        va = _np.frombuffer(bytes(ffi.buffer(vlens, count * 4)),
+                            dtype=_np.int32)
+        total = int((_np.where(ka > 0, ka, 0)
+                     + _np.where(va > 0, va, 0)).sum())
+        blob = bytes(ffi.buffer(base, total))
+        off = 0
+        while done < count:
+            if raw is not None:
+                n = raw(t, int(partition),
+                        int(ffi.cast("intptr_t", base)) + off,
+                        int(ffi.cast("intptr_t", klens)) + done * 4,
+                        int(ffi.cast("intptr_t", vlens)) + done * 4,
+                        count - done)
+                if n > 0:
+                    for i in range(done, done + n):
+                        off += (ka[i] if ka[i] > 0 else 0) \
+                            + (va[i] if va[i] > 0 else 0)
+                    done += n
+                    continue
+            # first-sight (toppar not registered) or ineligible: route
+            # ONE record through the Python path, then retry the lane
+            kl, vl = int(ka[done]), int(va[done])
+            k = blob[off:off + kl] if kl >= 0 else None
+            off += max(kl, 0)
+            v = blob[off:off + vl] if vl >= 0 else None
+            off += max(vl, 0)
+            p.produce(t, value=v, key=k, partition=int(partition))
+            done += 1
+        return done
+    except Exception:
+        return done    # records enqueued before the failure
+
+
+@ffi.def_extern()
+def tk_poll(h, timeout_ms):
+    # Serve the handle's reply queue (DR trampolines fire here or in
+    # tk_flush; reference rd_kafka_poll). On a consumer handle this
+    # serves the NON-message ops (errors/stats) like rd_kafka_poll on a
+    # consumer -- messages come via tk_consumer_poll.
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    try:
+        if isinstance(obj, Consumer):
+            return int(obj.poll_kafka(timeout_ms / 1000.0))
+        return int(obj.poll(timeout_ms / 1000.0))
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_outq_len(h):
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    try:
+        return len(obj)
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_assign(h, topic, partitions, offsets, nparts):
+    # Simple-consumer assignment with optional start offsets
+    # (reference rd_kafka_assign; offsets NULL or -1001 = stored/auto).
+    c = _handles.get(h)
+    if c is None:
+        return -1
+    try:
+        from librdkafka_tpu.client.consumer import TopicPartition
+        t = ffi.string(topic).decode()
+        tps = []
+        for i in range(nparts):
+            off = -1001 if offsets == ffi.NULL else int(offsets[i])
+            tps.append(TopicPartition(t, int(partitions[i]), off))
+        c.assign(tps)
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_unassign(h):
+    c = _handles.get(h)
+    if c is None:
+        return -1
+    try:
+        c.unassign()
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_commit(h, async_flag):
+    c = _handles.get(h)
+    if c is None:
+        return -1
+    try:
+        c.commit(asynchronous=bool(async_flag))
+        return 0
+    except Exception:
+        return -2
+
+
+@ffi.def_extern()
+def tk_committed(h, topic, partition, timeout_ms):
+    # Committed offset for one partition; -1001 = none, < -2000000 =
+    # error (code folded in).
+    c = _handles.get(h)
+    if c is None:
+        return -2000001
+    try:
+        from librdkafka_tpu.client.consumer import TopicPartition
+        tp = TopicPartition(ffi.string(topic).decode(), int(partition))
+        res = c.committed([tp], timeout=timeout_ms / 1000.0)
+        return int(res[0].offset)
+    except Exception:
+        return -2000002
+
+
+@ffi.def_extern()
+def tk_seek(h, topic, partition, offset):
+    c = _handles.get(h)
+    if c is None:
+        return -1
+    try:
+        from librdkafka_tpu.client.consumer import TopicPartition
+        c.seek(TopicPartition(ffi.string(topic).decode(),
+                              int(partition), int(offset)))
+        return 0
+    except Exception:
+        return -1
+
+
+def _admin_for(h):
+    # Lazy AdminClient against the handle's cluster (its in-process
+    # mock, or its bootstrap.servers).
+    obj = _handles.get(h)
+    if obj is None:
+        return None
+    a = getattr(obj, "_tk_admin", None)
+    if a is None:
+        from librdkafka_tpu.client.admin import AdminClient
+        cluster = getattr(obj._rk, "mock_cluster", None)
+        bs = (cluster.bootstrap_servers() if cluster is not None
+              else obj._rk.conf.get("bootstrap.servers"))
+        a = AdminClient({"bootstrap.servers": bs})
+        obj._tk_admin = a
+    return a
+
+
+@ffi.def_extern()
+def tk_create_topic(h, topic, num_partitions, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        from librdkafka_tpu.client.admin import NewTopic
+        futs = a.create_topics(
+            [NewTopic(ffi.string(topic).decode(),
+                      num_partitions=num_partitions)],
+            operation_timeout=timeout_ms / 1000.0)
+        for f in futs.values():
+            f.result(timeout_ms / 1000.0)
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_delete_topic(h, topic, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        futs = a.delete_topics([ffi.string(topic).decode()],
+                               operation_timeout=timeout_ms / 1000.0)
+        for f in futs.values():
+            f.result(timeout_ms / 1000.0)
+        return 0
+    except Exception:
+        return -1
+
+
 @ffi.def_extern()
 def tk_subscribe(h, topics_csv):
     c = _handles.get(h)
@@ -147,6 +440,7 @@ def tk_consumer_poll(h, timeout_ms, out):
     out.topic = ffi.NULL
     out.key = ffi.NULL
     out.payload = ffi.NULL
+    out.headers = ffi.NULL
     out.key_len = 0
     out.len = 0
     out.partition = -1
@@ -181,6 +475,12 @@ def tk_consumer_poll(h, timeout_ms, out):
     else:
         out.payload = lib_memdup(m.value)
         out.len = len(m.value)
+    if m.headers:
+        # JSON [[name, value|null], ...]; byte values are latin-1-
+        # mapped (lossless 0-255 <-> codepoint) for C-side parsing
+        hs = [[k, v.decode("latin-1") if isinstance(v, bytes) else v]
+              for k, v in m.headers]
+        out.headers = lib_strdup(json.dumps(hs).encode())
     return 1
 
 
@@ -209,7 +509,8 @@ def tk_msg_free(m):
     _release(m.topic)
     _release(m.key)
     _release(m.payload)
-    m.topic = m.key = m.payload = ffi.NULL
+    _release(m.headers)
+    m.topic = m.key = m.payload = m.headers = ffi.NULL
 
 
 @ffi.def_extern()

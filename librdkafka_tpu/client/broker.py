@@ -415,6 +415,31 @@ class Broker:
             cert = self.sock.getpeercert()
         except (ValueError, OSError):
             pass
+        # ssl.certificate.verify_cb: app veto over the peer certificate
+        # (reference rd_kafka_conf_set_ssl_cert_verify_cb; called after
+        # OpenSSL's own verification with its result — returning False
+        # rejects the connection as an SSL failure)
+        vcb = self.rk.conf.get("ssl.certificate.verify_cb")
+        if vcb is not None:
+            try:
+                der = self.sock.getpeercert(binary_form=True)
+            except (ValueError, OSError):
+                der = None
+            try:
+                # openssl_ok: whether OpenSSL actually VERIFIED the
+                # chain — getpeercert() returns {} (truthy-empty) for a
+                # presented-but-unverified cert under CERT_NONE
+                ok = vcb(self.name, self.nodeid, 0, der, bool(cert))
+            except Exception as e:
+                ok = False
+                self.rk.log("ERROR",
+                            f"{self.name}: verify_cb raised: {e!r}")
+            if not ok:
+                self._disconnect(KafkaError(
+                    Err._SSL,
+                    "broker certificate rejected by "
+                    "ssl.certificate.verify_cb"))
+                return
         self.rk.dbg("security",
                     f"{self.name}: TLS established "
                     f"({self.sock.version()}, peer={'verified' if cert else 'unverified'})")
@@ -495,11 +520,16 @@ class Broker:
 
     def _connect_failed(self, reason: str):
         self._set_state(BrokerState.DOWN)
-        jitter = 1.0 + random.uniform(-0.2, 0.2)
-        self._next_connect = time.monotonic() + self.reconnect_backoff * jitter
-        self.reconnect_backoff = min(
-            self.reconnect_backoff * 2,
-            self.rk.conf.get("reconnect.backoff.max.ms") / 1000.0)
+        # -25%..+50% jitter, capped at reconnect.backoff.max.ms — the
+        # reference's exact scheme (rd_kafka_broker_update_reconnect_
+        # backoff, rdkafka_broker.c:1708; reconnect.backoff.jitter.ms
+        # is a deprecated no-op there too)
+        backoff_max = self.rk.conf.get("reconnect.backoff.max.ms") / 1000.0
+        backoff = min(self.reconnect_backoff * random.uniform(0.75, 1.5),
+                      backoff_max)
+        self._next_connect = time.monotonic() + backoff
+        self.reconnect_backoff = min(self.reconnect_backoff * 2,
+                                     backoff_max)
         self.rk.broker_down(self, KafkaError(Err._TRANSPORT, reason))
 
     def _disconnect(self, err: KafkaError, quiet: bool = False):
@@ -513,7 +543,13 @@ class Broker:
         elif self.sock is not None and not self.terminate:
             self.rk.log("INFO", f"{self.name}: disconnected: {err.reason}")
         if self.sock:
+            # closesocket_cb: app-supplied close hook, paired with
+            # connect_cb/socket_cb (reference closesocket_cb,
+            # rdkafka_conf.c:520)
+            ccb = self.rk.conf.get("closesocket_cb")
             try:
+                if ccb:
+                    ccb(self.sock)
                 self.sock.close()
             except OSError:
                 pass

@@ -38,6 +38,8 @@ class Prop:
     enum: Optional[tuple] = None
     alias: Optional[str] = None          # alias target property name
     validator: Optional[Callable[[Any], bool]] = None
+    deprecated: bool = False             # accepted no-op (reference
+                                         # _RK_DEPRECATED rows)
 
 
 def _p(*args, **kw) -> Prop:
@@ -94,7 +96,15 @@ PROPERTIES: list[Prop] = [
     _p("broker.address.ttl", GLOBAL, "int", 1000, "DNS resolve cache ttl ms.", vmin=0, vmax=86400000),
     _p("broker.address.family", GLOBAL, "enum", "any", "Address family.",
        enum=("any", "v4", "v6")),
-    _p("reconnect.backoff.ms", GLOBAL, "int", 100, "Initial reconnect backoff.",
+    _p("reconnect.backoff.jitter.ms", GLOBAL, "int", 0,
+       "No longer used: a fixed -25%..+50% jitter is applied to every "
+       "reconnect backoff (see reconnect.backoff.ms / "
+       "reconnect.backoff.max.ms). Accepted for conf compatibility "
+       "(reference deprecates it the same way, rdkafka_conf.c:437).",
+       vmin=0, vmax=3600000, deprecated=True),
+    _p("reconnect.backoff.ms", GLOBAL, "int", 100,
+       "Initial reconnect backoff; doubled per failure up to "
+       "reconnect.backoff.max.ms, with -25%..+50% jitter per attempt.",
        vmin=0, vmax=3600000),
     _p("reconnect.backoff.max.ms", GLOBAL, "int", 10000, "Max reconnect backoff.",
        vmin=0, vmax=3600000),
@@ -117,15 +127,48 @@ PROPERTIES: list[Prop] = [
     _p("security.protocol", GLOBAL, "enum", "plaintext", "Protocol to talk to brokers.",
        enum=("plaintext", "ssl", "sasl_plaintext", "sasl_ssl")),
     _p("ssl.cipher.suites", GLOBAL, "str", "", "Cipher suites."),
+    _p("ssl.curves.list", GLOBAL, "str", "",
+       "Colon-separated supported curves/groups in preference order "
+       "(OpenSSL SSL_CTX_set1_groups_list; reference rdkafka_conf.c "
+       "ssl.curves.list)."),
+    _p("ssl.sigalgs.list", GLOBAL, "str", "",
+       "Colon-separated signature algorithms in preference order "
+       "(OpenSSL SSL_CTX_set1_sigalgs_list)."),
     _p("ssl.key.location", GLOBAL, "str", "", "Client private key path (PEM)."),
     _p("ssl.key.password", GLOBAL, "str", "", "Key passphrase."),
+    _p("ssl.key.pem", GLOBAL, "str", "",
+       "Client private key as a PEM string (in-memory alternative to "
+       "ssl.key.location; reference ssl.key.pem)."),
+    _p("ssl_key", GLOBAL, "ptr", None,
+       "Client private key as in-memory PEM/DER bytes (the "
+       "rd_kafka_conf_set_ssl_cert analog)."),
     _p("ssl.certificate.location", GLOBAL, "str", "", "Client cert path (PEM)."),
+    _p("ssl.certificate.pem", GLOBAL, "str", "",
+       "Client certificate as a PEM string (in-memory alternative to "
+       "ssl.certificate.location)."),
+    _p("ssl_certificate", GLOBAL, "ptr", None,
+       "Client certificate as in-memory PEM/DER bytes."),
     _p("ssl.ca.location", GLOBAL, "str", "", "CA bundle path."),
+    _p("ssl_ca", GLOBAL, "ptr", None,
+       "CA certificate(s) as in-memory PEM/DER bytes."),
+    _p("ssl.crl.location", GLOBAL, "str", "",
+       "CRL file for broker certificate revocation checking."),
     _p("ssl.keystore.location", GLOBAL, "str", "", "PKCS#12 keystore path."),
     _p("ssl.keystore.password", GLOBAL, "str", "", "Keystore password."),
     _p("enable.ssl.certificate.verification", GLOBAL, "bool", True, "Verify broker cert."),
     _p("ssl.endpoint.identification.algorithm", GLOBAL, "enum", "none",
        "Endpoint identification.", enum=("none", "https")),
+    _p("ssl.certificate.verify_cb", GLOBAL, "ptr", None,
+       "Certificate verification callback: cb(broker_name, broker_id, "
+       "depth, der_bytes, openssl_ok) -> bool; returning False rejects "
+       "the connection (reference ssl.certificate.verify_cb)."),
+    _p("open_cb", GLOBAL, "ptr", None,
+       "File-open hook: cb(path, os_flags) -> OS fd or file object; "
+       "used by the file offset store (reference open_cb opens files "
+       "with CLOEXEC)."),
+    _p("closesocket_cb", GLOBAL, "ptr", None,
+       "Socket-close hook: cb(socket) called before every broker "
+       "socket close (pairs with connect_cb; reference closesocket_cb)."),
     _p("sasl.mechanisms", GLOBAL, "str", "GSSAPI",
        "SASL mechanism: GSSAPI, PLAIN, SCRAM-SHA-256, SCRAM-SHA-512, OAUTHBEARER."),
     _p("sasl.mechanism", GLOBAL, "str", "GSSAPI", "Alias.", alias="sasl.mechanisms"),
@@ -229,6 +272,13 @@ PROPERTIES: list[Prop] = [
        "Only failed DRs.", app=P),
     _p("dr_cb", GLOBAL, "ptr", None, "Delivery report callback.", app=P),
     _p("dr_msg_cb", GLOBAL, "ptr", None, "Per-message delivery report callback.", app=P),
+    _p("consume_cb", GLOBAL, "ptr", None,
+       "Message consume callback for callback-based consumption "
+       "(Consumer.consume_callback; reference rd_kafka_consume_callback).",
+       app=C),
+    _p("consume.callback.max.messages", GLOBAL, "int", 0,
+       "Maximum number of messages dispatched per consume_callback "
+       "call (0 = unlimited).", vmin=0, vmax=1000000, app=C),
     # ---- TPU codec sidecar knobs (new; SURVEY.md §5 config section) ----
     _p("compression.backend", GLOBAL, "enum", "cpu",
        "Codec provider for MessageSet compression + CRC32C: 'cpu' uses the "
@@ -487,6 +537,8 @@ def generate_configuration_md() -> str:
             elif prop.enum:
                 rng = ", ".join(prop.enum)
             doc = prop.doc if not prop.alias else f"Alias for `{prop.alias}`: {prop.doc}"
+            if prop.deprecated:
+                doc = f"**DEPRECATED** {doc}"
             out.append(f"{prop.name} | {prop.app} | {rng} | {prop.default} | {doc}")
         out.append("")
     return "\n".join(out)

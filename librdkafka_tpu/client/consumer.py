@@ -224,6 +224,36 @@ class Consumer:
             if time.monotonic() >= deadline:
                 return None
 
+    def consume_callback(self, timeout: float = 1.0, consume_cb=None,
+                         max_messages: Optional[int] = None) -> int:
+        """Callback-based consume mode (reference:
+        rd_kafka_consume_callback, rdkafka.h): dispatch messages to
+        ``consume_cb`` (argument, or the ``consume_cb`` conf property)
+        instead of returning them. Waits up to ``timeout`` for the
+        first message, then drains without waiting, capped by
+        ``max_messages`` (argument, or ``consume.callback.max.messages``
+        conf; 0 = unlimited). Returns the number dispatched."""
+        cb = consume_cb or self._rk.conf.get("consume_cb")
+        if cb is None:
+            raise KafkaException(
+                Err._INVALID_ARG,
+                "consume_callback requires a consume_cb (argument or "
+                "conf property)")
+        cap = max_messages if max_messages is not None else \
+            self._rk.conf.get("consume.callback.max.messages")
+        if not cap:
+            cap = float("inf")
+        n = 0
+        t = timeout
+        while n < cap:
+            m = self.poll(t)
+            if m is None:
+                break
+            t = 0.0          # drain without waiting after the first
+            cb(m)
+            n += 1
+        return n
+
     def consume(self, num_messages: int = 1, timeout: float = 1.0
                 ) -> list[Message]:
         out = []

@@ -21,20 +21,36 @@ if TYPE_CHECKING:
 
 
 class _OffsetFile:
-    __slots__ = ("path", "fd", "last_sync", "dirty")
+    __slots__ = ("path", "fd", "last_sync", "dirty", "open_cb", "_fobj")
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, open_cb=None):
         self.path = path
         self.fd: Optional[int] = None
         self.last_sync = 0.0
         self.dirty = False
+        self.open_cb = open_cb
+        self._fobj = None       # keeps a cb-returned file object alive
 
     def open(self):
         if self.fd is None:
             d = os.path.dirname(self.path)
             if d:
                 os.makedirs(d, exist_ok=True)
-            self.fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+            if self.open_cb is not None:
+                # app-supplied file-open hook (reference open_cb,
+                # rdkafka_conf.c:524 — used for the offset store's
+                # opens): cb(path, os_flags) -> OS fd or file object.
+                # A file object must be HELD, not just fileno()'d —
+                # dropping the last reference would close the fd
+                f = self.open_cb(self.path, os.O_CREAT | os.O_RDWR)
+                if isinstance(f, int):
+                    self.fd = f
+                else:
+                    self._fobj = f
+                    self.fd = f.fileno()
+            else:
+                self.fd = os.open(self.path,
+                                  os.O_CREAT | os.O_RDWR, 0o644)
 
     def read(self) -> Optional[int]:
         self.open()
@@ -69,7 +85,11 @@ class _OffsetFile:
                     os.fsync(self.fd)
                 except OSError:
                     pass
-            os.close(self.fd)
+            if self._fobj is not None:
+                self._fobj.close()        # owns the fd
+                self._fobj = None
+            else:
+                os.close(self.fd)
             self.fd = None
 
 
@@ -91,7 +111,7 @@ class FileOffsetStore:
                     path = os.path.join(base, f"{topic}-{partition}.offset")
                 else:
                     path = base
-                f = _OffsetFile(path)
+                f = _OffsetFile(path, self.rk.conf.get("open_cb"))
                 self._files[key] = f
             return f
 

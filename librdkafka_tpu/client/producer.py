@@ -39,6 +39,14 @@ class Producer:
         dropped."""
         from .errors import Err, KafkaError, KafkaException
 
+        # per-message errors are recorded INTO the dicts; validate the
+        # shape up front so a stray non-dict fails fast instead of
+        # aborting the batch midway with no error recorded
+        for m in msgs:
+            if not isinstance(m, dict):
+                raise TypeError(
+                    f"produce_batch messages must be dicts, got "
+                    f"{type(m).__name__}")
         n = 0
         i = 0
         lane = self._rk._lane

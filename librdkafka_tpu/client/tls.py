@@ -49,6 +49,7 @@ def make_client_ctx(conf) -> Optional[ssl.SSLContext]:
     ctx.verify_mode = ssl.CERT_REQUIRED if verify else ssl.CERT_NONE
 
     ca = conf.get("ssl.ca.location")
+    ca_mem = conf.get("ssl_ca")               # in-memory PEM/DER bytes
     if ca:
         try:
             if os.path.isdir(ca):
@@ -57,17 +58,34 @@ def make_client_ctx(conf) -> Optional[ssl.SSLContext]:
                 ctx.load_verify_locations(cafile=ca)
         except (ssl.SSLError, OSError) as e:
             raise KafkaException(Err._SSL, f"ssl.ca.location {ca!r}: {e}")
+    elif ca_mem:
+        try:
+            # load_verify_locations(cadata=...) takes PEM str or DER bytes
+            if isinstance(ca_mem, bytes) and b"-----BEGIN" in ca_mem:
+                ca_mem = ca_mem.decode()
+            ctx.load_verify_locations(cadata=ca_mem)
+        except (ssl.SSLError, ValueError) as e:
+            raise KafkaException(Err._SSL, f"ssl_ca: {e}")
     elif verify:
         ctx.load_default_certs(ssl.Purpose.SERVER_AUTH)
 
-    cert = conf.get("ssl.certificate.location")
-    key = conf.get("ssl.key.location")
-    if cert:
+    crl = conf.get("ssl.crl.location")
+    if crl:
+        if not verify:
+            # OpenSSL ignores verify_flags entirely under CERT_NONE —
+            # a CRL that can never be consulted must not pass silently
+            raise KafkaException(
+                Err._INVALID_ARG,
+                "ssl.crl.location requires "
+                "enable.ssl.certificate.verification=true (revocation "
+                "checking is part of verification)")
         try:
-            ctx.load_cert_chain(cert, keyfile=key or None,
-                                password=conf.get("ssl.key.password") or None)
+            ctx.verify_flags |= ssl.VERIFY_CRL_CHECK_LEAF
+            ctx.load_verify_locations(cafile=crl)
         except (ssl.SSLError, OSError) as e:
-            raise KafkaException(Err._SSL, f"client certificate: {e}")
+            raise KafkaException(Err._SSL, f"ssl.crl.location {crl!r}: {e}")
+
+    _load_client_cert(ctx, conf)
 
     ks = conf.get("ssl.keystore.location")
     if ks:
@@ -79,7 +97,158 @@ def make_client_ctx(conf) -> Optional[ssl.SSLContext]:
             ctx.set_ciphers(ciphers)
         except ssl.SSLError as e:
             raise KafkaException(Err._SSL, f"ssl.cipher.suites: {e}")
+    curves = conf.get("ssl.curves.list")
+    if curves:
+        _ctx_ctrl_str(ctx, _SSL_CTRL_SET_GROUPS_LIST, curves,
+                      "ssl.curves.list")
+    sigalgs = conf.get("ssl.sigalgs.list")
+    if sigalgs:
+        _ctx_ctrl_str(ctx, _SSL_CTRL_SET_SIGALGS_LIST, sigalgs,
+                      "ssl.sigalgs.list")
     return ctx
+
+
+def _load_client_cert(ctx: ssl.SSLContext, conf) -> None:
+    """Client cert+key from file paths, in-memory PEM strings
+    (ssl.certificate.pem / ssl.key.pem), or in-memory bytes
+    (ssl_certificate / ssl_key — the rd_kafka_conf_set_ssl_cert analog,
+    reference rdkafka_cert.c:1-556). Python's ssl module only ingests
+    cert chains from files, so in-memory material goes through a
+    transient file deleted right after the load (same pattern as the
+    PKCS#12 path)."""
+    cert = conf.get("ssl.certificate.location")
+    key = conf.get("ssl.key.location")
+    pw = conf.get("ssl.key.password") or None
+    if cert:
+        try:
+            ctx.load_cert_chain(cert, keyfile=key or None, password=pw)
+        except (ssl.SSLError, OSError) as e:
+            raise KafkaException(Err._SSL, f"client certificate: {e}")
+        return
+    cert_mem = conf.get("ssl.certificate.pem") or conf.get("ssl_certificate")
+    key_mem = conf.get("ssl.key.pem") or conf.get("ssl_key")
+    if not cert_mem:
+        if key_mem:
+            # key without a certificate is as much a config error as the
+            # mirror case below — failing here beats an opaque
+            # handshake rejection at connect time
+            raise KafkaException(
+                Err._INVALID_ARG,
+                "ssl.key.pem / ssl_key requires ssl.certificate.pem / "
+                "ssl_certificate (or ssl.certificate.location)")
+        return
+    if not key_mem and not key:
+        raise KafkaException(
+            Err._INVALID_ARG,
+            "in-memory client certificate requires ssl.key.pem / "
+            "ssl_key (or ssl.key.location)")
+    blob = b""
+    for part in (cert_mem, key_mem):
+        if part is None:
+            continue
+        if isinstance(part, str):
+            part = part.encode()
+        if b"-----BEGIN" not in part:
+            raise KafkaException(
+                Err._INVALID_ARG,
+                "in-memory certificate/key must be PEM (DER client "
+                "material: use ssl.keystore.location)")
+        blob += part if part.endswith(b"\n") else part + b"\n"
+    fd, tmp = tempfile.mkstemp(suffix=".pem")
+    try:
+        os.write(fd, blob)
+        os.close(fd)
+        try:
+            ctx.load_cert_chain(tmp, keyfile=key or None, password=pw)
+        except (ssl.SSLError, OSError) as e:
+            raise KafkaException(Err._SSL,
+                                 f"in-memory client certificate: {e}")
+    finally:
+        os.unlink(tmp)
+
+
+# OpenSSL SSL_CTX_ctrl sub-commands (public ABI constants; the Python
+# ssl module has no API for groups/sigalgs, so these reach the already-
+# loaded libssl through the process symbol table)
+_SSL_CTRL_SET_GROUPS_LIST = 92
+_SSL_CTRL_SET_SIGALGS_LIST = 98
+
+_libssl_handle = None
+
+
+def _libssl(ctypes):
+    """Handle to the libssl the interpreter's _ssl module already
+    mapped (CDLL(None) can't see it: _ssl loads it RTLD_LOCAL)."""
+    global _libssl_handle
+    if _libssl_handle is None:
+        path = None
+        try:
+            with open("/proc/self/maps") as f:
+                for line in f:
+                    if "libssl" in line:
+                        path = line.split()[-1]
+                        break
+        except OSError:
+            pass
+        _libssl_handle = ctypes.CDLL(path)   # None falls back to process
+    return _libssl_handle
+
+
+def _ctx_ctrl_str(ctx: ssl.SSLContext, cmd: int, value: str,
+                  propname: str) -> None:
+    """Apply an SSL_CTX_ctrl string option (curves/sigalgs lists) to the
+    context's underlying SSL_CTX. CPython's _ssl.PySSLContext stores the
+    SSL_CTX* directly after PyObject_HEAD; a bad list makes
+    SSL_CTX_ctrl return 0 and raises, so misconfiguration cannot pass
+    silently. If the runtime layout/symbols are unavailable the
+    property fails loudly rather than being ignored."""
+    import ctypes
+
+    class _PySSLContext(ctypes.Structure):
+        _fields_ = [("ob_refcnt", ctypes.c_ssize_t),
+                    ("ob_type", ctypes.c_void_p),
+                    ("ctx", ctypes.c_void_p)]
+
+    import sys
+    import sysconfig
+    if (sys.implementation.name != "cpython"
+            or sysconfig.get_config_var("Py_GIL_DISABLED")
+            or sysconfig.get_config_var("Py_TRACE_REFS")):
+        # the struct layout below is standard-CPython-specific; on other
+        # builds the pointer extraction would be garbage — refuse
+        # loudly instead of dereferencing it
+        raise KafkaException(
+            Err._NOT_IMPLEMENTED,
+            f"{propname}: unsupported on this Python build "
+            f"({sys.implementation.name}, free-threaded/debug)")
+    try:
+        libssl = _libssl(ctypes)
+        fn = libssl.SSL_CTX_ctrl
+        fn.restype = ctypes.c_long
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_long,
+                       ctypes.c_char_p]
+        raw = _PySSLContext.from_address(id(ctx)).ctx
+        # layout sanity probe before the real call: SSL_CTX_get_timeout
+        # on a correctly-extracted context returns the default session
+        # timeout (7200s) — garbage pointers fail this cheaply instead
+        # of crashing inside SSL_CTX_ctrl
+        get_timeout = libssl.SSL_CTX_get_timeout
+        get_timeout.restype = ctypes.c_long
+        get_timeout.argtypes = [ctypes.c_void_p]
+        if not raw or not (0 < get_timeout(raw) < (1 << 31)):
+            raise KafkaException(
+                Err._NOT_IMPLEMENTED,
+                f"{propname}: SSL_CTX layout probe failed on this "
+                f"runtime")
+        ok = fn(raw, cmd, 0, value.encode())
+    except (OSError, AttributeError) as e:
+        raise KafkaException(
+            Err._NOT_IMPLEMENTED,
+            f"{propname}: cannot reach SSL_CTX_ctrl in this runtime "
+            f"({e})")
+    if ok != 1:
+        raise KafkaException(Err._INVALID_ARG,
+                             f"{propname}: OpenSSL rejected {value!r}")
 
 
 def _load_pkcs12(ctx: ssl.SSLContext, path: str, password: str) -> None:

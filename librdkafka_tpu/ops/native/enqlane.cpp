@@ -307,12 +307,16 @@ typedef struct {
     PyObject *map;        // dict {(topic, partition) -> (Arena, toppar)}
     PyObject *fallback;   // rk._produce_slow(topic, value, key, ...)
     PyObject *wake;       // rk._wake_fast(toppar) on empty->non-empty
-    // hot-path lookup cache: entries of the LAST topic produced to,
-    // indexed by partition (the tuple-pack + dict-hash per produce()
-    // measured ~40% of the enqueue cost).  Maintained by map_set/
-    // map_del — Python must mutate the map through those, not directly.
+    // hot-path lookup cache: per-topic partition-indexed entry lists
+    // (the tuple-pack + dict-hash per produce() measured ~40% of the
+    // enqueue cost). cache_topic/cache_entries are the last-used fast
+    // slot (pointer-identity hit); cache_map keeps every topic's list
+    // so multi-topic round-robin pays one str-keyed dict get per
+    // switch, not a list rebuild. Maintained by map_set/map_del —
+    // Python must mutate the map through those, not directly.
     PyObject *cache_topic;    // strong ref, may be NULL
     PyObject *cache_entries;  // strong PyList of entry|None, may be NULL
+    PyObject *cache_map;      // strong dict {topic -> PyList}, may be NULL
     int64_t msg_cnt, msg_bytes;
     int64_t max_msgs, max_bytes;
     int64_t copy_max;     // message.copy.max.bytes: larger values keep a
@@ -332,6 +336,7 @@ static PyObject *lane_new(PyTypeObject *type, PyObject *args,
     l->wake = NULL;
     l->cache_topic = NULL;
     l->cache_entries = NULL;
+    l->cache_map = NULL;
     l->msg_cnt = 0; l->msg_bytes = 0;
     l->max_msgs = 100000; l->max_bytes = 1LL << 30;
     l->copy_max = 65535;
@@ -348,6 +353,7 @@ static int lane_traverse(Lane *l, visitproc visit, void *arg) {
     Py_VISIT(l->wake);
     Py_VISIT(l->cache_topic);
     Py_VISIT(l->cache_entries);
+    Py_VISIT(l->cache_map);
     return 0;
 }
 
@@ -357,12 +363,14 @@ static int lane_clear(Lane *l) {
     Py_CLEAR(l->wake);
     Py_CLEAR(l->cache_topic);
     Py_CLEAR(l->cache_entries);
+    Py_CLEAR(l->cache_map);
     return 0;
 }
 
 static void lane_cache_invalidate(Lane *l) {
     Py_CLEAR(l->cache_topic);
     Py_CLEAR(l->cache_entries);
+    Py_CLEAR(l->cache_map);
 }
 
 // map_set(topic, partition, entry): install an (Arena, toppar) entry.
@@ -482,21 +490,32 @@ static PyObject *lane_lookup(Lane *l, PyObject *topic, int64_t part,
     PyObject *ent = PyDict_GetItemWithError(l->map, kt);
     Py_DECREF(kt);
     if (!ent) return NULL;
-    // populate the cache.  Same topic VALUE under a new pointer keeps
-    // the entry list (two interned copies must not thrash it); a
-    // different topic resets it.
+    // populate the cache: each topic keeps its own entries list in
+    // cache_map (str-keyed, hash cached in the str object), so a
+    // multi-topic round-robin switches lists instead of rebuilding
+    // them. The fast slot is repointed ONLY after every allocation
+    // succeeded — a poisoned slot would route records to the wrong
+    // topic's arena.
     if (l->cache_topic != topic) {
-        int same = l->cache_topic != NULL
-            && PyUnicode_Check(l->cache_topic)
-            && PyObject_RichCompareBool(l->cache_topic, topic, Py_EQ) == 1;
-        if (PyErr_Occurred()) PyErr_Clear();
+        if (!l->cache_map) {
+            l->cache_map = PyDict_New();
+            if (!l->cache_map) return NULL;
+        }
+        PyObject *lst = PyDict_GetItemWithError(l->cache_map, topic);
+        if (!lst) {
+            if (PyErr_Occurred()) return NULL;
+            lst = PyList_New(0);
+            if (!lst) return NULL;
+            if (PyDict_SetItem(l->cache_map, topic, lst) < 0) {
+                Py_DECREF(lst);
+                return NULL;
+            }
+            Py_DECREF(lst);          // the dict's reference keeps it
+        }
         Py_INCREF(topic);
         Py_XSETREF(l->cache_topic, topic);
-        if (!same) {
-            PyObject *nl = PyList_New(0);
-            if (!nl) return NULL;
-            Py_XSETREF(l->cache_entries, nl);
-        }
+        Py_INCREF(lst);
+        Py_XSETREF(l->cache_entries, lst);
     }
     while (PyList_GET_SIZE(l->cache_entries) <= part) {
         if (PyList_Append(l->cache_entries, Py_None) < 0) return NULL;
@@ -712,6 +731,64 @@ static PyObject *lane_produce_batch(Lane *l, PyObject *const *args,
     return Py_BuildValue("(LL)", (long long)i, (long long)appended);
 }
 
+// produce_raw(topic, partition, base_addr, klens_addr, vlens_addr,
+//             count) -> appended count | -1 (toppar not registered)
+// The C-ABI batch lane (capi tk_produce_batch): the caller hands the
+// ARENA-LAYOUT arrays (concatenated key||value bytes + int32 len
+// arrays, -1 = null) by address and the whole run appends in one
+// GIL-held native pass — the reference's rd_kafka_produce_batch with
+// the enqueue lane's memory layout. Stops early on queue-full.
+static PyObject *lane_produce_raw(Lane *l, PyObject *const *args,
+                                  Py_ssize_t nargs) {
+    if (nargs != 6) {
+        PyErr_SetString(PyExc_TypeError,
+                        "produce_raw(topic, partition, base_addr, "
+                        "klens_addr, vlens_addr, count)");
+        return NULL;
+    }
+    PyObject *topic = args[0];
+    int64_t part = PyLong_AsLongLong(args[1]);
+    const uint8_t *base = (const uint8_t *)PyLong_AsVoidPtr(args[2]);
+    const int32_t *klens = (const int32_t *)PyLong_AsVoidPtr(args[3]);
+    const int32_t *vlens = (const int32_t *)PyLong_AsVoidPtr(args[4]);
+    int64_t count = PyLong_AsLongLong(args[5]);
+    if (PyErr_Occurred()) return NULL;
+    if (!(l->enabled && !l->fatal && part >= 0 && PyUnicode_Check(topic)))
+        return PyLong_FromLong(-1);
+    PyObject *ent = lane_lookup(l, topic, part, NULL);
+    if (!ent) {
+        if (PyErr_Occurred()) return NULL;
+        return PyLong_FromLong(-1);
+    }
+    Arena *a = (Arena *)PyTuple_GET_ITEM(ent, 0);
+    int was_empty = (a->count == a->start);
+    const uint8_t *src = base;
+    int64_t i = 0;
+    for (; i < count; i++) {
+        int64_t kl = klens[i], vl = vlens[i];
+        int64_t sz = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
+        if (sz > l->copy_max) break;
+        if (l->msg_cnt >= l->max_msgs || l->msg_bytes + sz > l->max_bytes)
+            break;
+        const uint8_t *kp = kl > 0 ? src : NULL;
+        if (kl > 0) src += kl;
+        const uint8_t *vp = vl > 0 ? src : NULL;
+        if (vl > 0) src += vl;
+        if (arena_do_append(a, (const char *)kp, kl,
+                            (const char *)vp, vl) < 0)
+            return NULL;
+        l->msg_cnt += 1;
+        l->msg_bytes += sz;
+    }
+    if (i > 0 && was_empty && l->wake) {
+        PyObject *tp = PyTuple_GET_ITEM(ent, 1);
+        PyObject *r = PyObject_CallOneArg(l->wake, tp);
+        if (!r) return NULL;
+        Py_DECREF(r);
+    }
+    return PyLong_FromLongLong(i);
+}
+
 static PyMemberDef lane_members[] = {
     {"map", T_OBJECT_EX, offsetof(Lane, map), READONLY,
      "{(topic, partition) -> (Arena, toppar)}"},
@@ -748,6 +825,9 @@ static PyMethodDef lane_methods[] = {
     {"produce_batch", (PyCFunction)(void (*)(void))lane_produce_batch,
      METH_FASTCALL,
      "produce_batch(topic, msgs, start, default_part) -> (next, appended)"},
+    {"produce_raw", (PyCFunction)(void (*)(void))lane_produce_raw,
+     METH_FASTCALL,
+     "produce_raw(topic, part, base_addr, klens_addr, vlens_addr, n)"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject LaneType = {

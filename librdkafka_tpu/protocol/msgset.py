@@ -377,8 +377,8 @@ def _parse_records_v2_native(info: BatchInfo,
         fields.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     if got != n:
         raise CrcMismatch(f"malformed v2 records: parsed {got} of {n}")
-    tstype = (proto.TSTYPE_LOG_APPEND_TIME
-              if info.attrs & proto.ATTR_TIMESTAMP_TYPE
+    log_append = bool(info.attrs & proto.ATTR_TIMESTAMP_TYPE)
+    tstype = (proto.TSTYPE_LOG_APPEND_TIME if log_append
               else proto.TSTYPE_CREATE_TIME)
     base_ts = info.first_timestamp
     base_off = info.base_offset
@@ -390,7 +390,9 @@ def _parse_records_v2_native(info: BatchInfo,
         headers = _parse_headers(records_bytes, ho, nh) if nh else []
         out.append(Record(
             key=key, value=value, headers=headers,
-            timestamp=base_ts + ts_d, offset=base_off + off_d, msgver=2,
+            timestamp=(info.max_timestamp if log_append
+                       else base_ts + ts_d),
+            offset=base_off + off_d, msgver=2,
             is_control=info.is_control,
             is_transactional=info.is_transactional,
             producer_id=info.producer_id, timestamp_type=tstype))
@@ -444,10 +446,15 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
         fields.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     if got != n:
         raise CrcMismatch(f"malformed v2 records: parsed {got} of {n}")
-    tstype = (proto.TSTYPE_LOG_APPEND_TIME
-              if info.attrs & proto.ATTR_TIMESTAMP_TYPE
+    # LOG_APPEND_TIME: the broker stamps only MaxTimestamp; per-record
+    # deltas still carry producer create times and must be IGNORED —
+    # every record reports the batch append time (reference:
+    # rdkafka_msgset_reader.c:902-908)
+    log_append = bool(info.attrs & proto.ATTR_TIMESTAMP_TYPE)
+    tstype = (proto.TSTYPE_LOG_APPEND_TIME if log_append
               else proto.TSTYPE_CREATE_TIME)
     base_ts = info.first_timestamp
+    append_ts = info.max_timestamp
     base_off = info.base_offset
     not_persisted = MsgStatus.NOT_PERSISTED
     new = Message.__new__
@@ -465,7 +472,7 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
         m.value = records_bytes[vo:vo + vl] if vl >= 0 else None
         m.headers = _parse_headers(records_bytes, ho, nh) if nh else []
         m.offset = off
-        m.timestamp = base_ts + ts_d
+        m.timestamp = append_ts if log_append else base_ts + ts_d
         m.timestamp_type = tstype
         m.error = None
         m.opaque = None
@@ -503,8 +510,8 @@ def _read_headers(sl: "Slice", nh: int) -> list:
 def _parse_records_v2_py(info: BatchInfo,
                          records_bytes: bytes) -> list[Record]:
     sl = Slice(records_bytes)
-    tstype = (proto.TSTYPE_LOG_APPEND_TIME
-              if info.attrs & proto.ATTR_TIMESTAMP_TYPE
+    log_append = bool(info.attrs & proto.ATTR_TIMESTAMP_TYPE)
+    tstype = (proto.TSTYPE_LOG_APPEND_TIME if log_append
               else proto.TSTYPE_CREATE_TIME)
     out = []
     for _ in range(info.record_count):
@@ -521,7 +528,8 @@ def _parse_records_v2_py(info: BatchInfo,
         headers = _read_headers(rsl, nh) if nh else []
         out.append(Record(
             key=key, value=value, headers=headers,
-            timestamp=info.first_timestamp + ts_delta,
+            timestamp=(info.max_timestamp if log_append
+                       else info.first_timestamp + ts_delta),
             offset=info.base_offset + off_delta, msgver=2,
             is_control=info.is_control,
             is_transactional=info.is_transactional,

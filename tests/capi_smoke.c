@@ -1,11 +1,28 @@
 /* C smoke test for libtkafka.so (tests/test_0115_capi.py compiles and
- * runs this): produce 50 records through the embedded framework into
- * its in-process mock cluster, then consume them back — a full wire
- * round trip driven entirely from C, the role src-cpp/ plays for the
- * reference. */
+ * runs this): the full client lifecycle driven from C — the role
+ * src-cpp/ plays for the reference (surface: src/rdkafka.h).
+ *
+ *   1. admin: create a topic
+ *   2. produce with headers + timestamp + per-message opaque, DR
+ *      callback trampoline counting deliveries
+ *   3. arena-layout batch produce (rd_kafka_produce_batch analog)
+ *   4. consume: headers arrive; commit (sync)
+ *   5. reopen the group and RESUME from the committed offset
+ *   6. seek + committed introspection; admin: delete the topic
+ */
 #include <stdio.h>
 #include <string.h>
+#include <stdlib.h>
 #include "tkafka.h"
+
+static long long dr_ok = 0, dr_err = 0, dr_opaque_sum = 0;
+
+static void on_dr(long long opaque, int err, int32_t partition,
+                  int64_t offset) {
+    (void)partition; (void)offset;
+    if (err == 0) { dr_ok++; dr_opaque_sum += opaque; }
+    else dr_err++;
+}
 
 int main(void) {
     char errstr[512];
@@ -15,54 +32,139 @@ int main(void) {
         errstr, sizeof(errstr));
     if (!p) { fprintf(stderr, "producer_new: %s\n", errstr); return 1; }
 
-    char payload[64], key[16];
-    for (int i = 0; i < 50; i++) {
+    /* --- 1. admin: create the topic over the wire ------------------- */
+    if (tk_create_topic(p, "ctopic", 2, 10000) != 0) {
+        fprintf(stderr, "create_topic failed\n"); return 1;
+    }
+
+    /* --- 2. produce with headers/timestamp/opaque + DR callback ----- */
+    if (tk_set_dr_cb(p, on_dr) != 0) { fprintf(stderr, "set_dr_cb\n"); return 1; }
+    const char *hn[2] = {"source", "seq"};
+    char payload[64], key[16], seqv[16];
+    for (int i = 0; i < 25; i++) {
         snprintf(payload, sizeof(payload), "c-api-message-%03d", i);
         snprintf(key, sizeof(key), "k%d", i);
-        if (tk_produce(p, "ctopic", i % 2, key, strlen(key),
-                       payload, strlen(payload)) != 0) {
-            fprintf(stderr, "produce %d failed\n", i);
-            return 1;
+        snprintf(seqv, sizeof(seqv), "%d", i);
+        const char *hv[2] = {"capi-smoke", seqv};
+        size_t hl[2] = {strlen("capi-smoke"), strlen(seqv)};
+        if (tk_produce2(p, "ctopic", i % 2, key, strlen(key),
+                        payload, strlen(payload),
+                        0 /* timestamp: now */, hn, hv, hl, 2,
+                        (long long)i /* opaque */) != 0) {
+            fprintf(stderr, "produce2 %d failed\n", i); return 1;
         }
     }
+
+    /* --- 3. arena-layout batch produce ------------------------------ */
+    /* 25 records "batch-####" with null keys, partition 0 */
+    char base[25 * 16];
+    int32_t klens[25], vlens[25];
+    size_t off = 0;
+    for (int i = 0; i < 25; i++) {
+        int n = snprintf(base + off, 16, "batch-%04d", i);
+        klens[i] = -1;
+        vlens[i] = n;
+        off += (size_t)n;
+    }
+    long long nb = tk_produce_batch(p, "ctopic", 0, base, klens, vlens, 25);
+    if (nb != 25) { fprintf(stderr, "produce_batch %lld/25\n", nb); return 1; }
+
     if (tk_flush(p, 30000) != 0) { fprintf(stderr, "flush\n"); return 1; }
+    if (dr_ok != 25 || dr_err != 0 || dr_opaque_sum != 25 * 24 / 2) {
+        fprintf(stderr, "dr counts: ok=%lld err=%lld opq=%lld\n",
+                dr_ok, dr_err, dr_opaque_sum);
+        return 1;
+    }
+    if (tk_outq_len(p) != 0) { fprintf(stderr, "outq != 0\n"); return 1; }
 
     char bootstrap[256];
     if (tk_mock_bootstrap(p, bootstrap, sizeof(bootstrap)) <= 0) {
-        fprintf(stderr, "mock_bootstrap\n");
-        return 1;
+        fprintf(stderr, "mock_bootstrap\n"); return 1;
     }
 
+    /* --- 4. consume 30 of 50; verify headers; sync-commit ----------- */
     char conf[512];
     snprintf(conf, sizeof(conf),
              "{\"bootstrap.servers\": \"%s\", \"group.id\": \"gc\","
              " \"auto.offset.reset\": \"earliest\","
+             " \"enable.auto.commit\": false,"
              " \"check.crcs\": true}", bootstrap);
     tk_handle_t c = tk_consumer_new(conf, errstr, sizeof(errstr));
     if (!c) { fprintf(stderr, "consumer_new: %s\n", errstr); return 1; }
-    if (tk_subscribe(c, "ctopic") != 0) { return 1; }
+    if (tk_subscribe(c, "ctopic") != 0) return 1;
 
-    int got = 0, polls = 0;
-    long long key_sum = 0;
-    while (got < 50 && polls++ < 600) {
+    int got = 0, with_headers = 0, polls = 0;
+    while (got < 30 && polls++ < 600) {
         tk_msg_t m;
         int r = tk_consumer_poll(c, 100, &m);
         if (r < 0) { fprintf(stderr, "poll error\n"); return 1; }
         if (r == 1) {
             if (m.err == 0) {
-                if (strncmp(m.payload, "c-api-message-", 14) != 0) {
-                    fprintf(stderr, "bad payload\n");
-                    return 1;
-                }
-                key_sum += m.key_len;
                 got++;
+                if (m.headers && strstr(m.headers, "capi-smoke"))
+                    with_headers++;
             }
             tk_msg_free(&m);
         }
     }
+    if (got != 30) { fprintf(stderr, "phase4 got %d/30\n", got); return 1; }
+    if (with_headers == 0) { fprintf(stderr, "no headers seen\n"); return 1; }
+    if (tk_commit(c, 0) != 0) { fprintf(stderr, "commit\n"); return 1; }
+
+    long long c0 = tk_committed(c, "ctopic", 0, 5000);
+    long long c1 = tk_committed(c, "ctopic", 1, 5000);
+    /* negative = no committed offset for that partition */
+    long long csum = (c0 > 0 ? c0 : 0) + (c1 > 0 ? c1 : 0);
+    if (csum != 30) {
+        fprintf(stderr, "committed %lld+%lld != 30\n", c0, c1); return 1;
+    }
+    if (c0 < 0) c0 = 0;
     tk_destroy(c);
+
+    /* --- 5. reopen the same group: must RESUME at committed --------- */
+    tk_handle_t c2 = tk_consumer_new(conf, errstr, sizeof(errstr));
+    if (!c2) { fprintf(stderr, "consumer_new2: %s\n", errstr); return 1; }
+    if (tk_subscribe(c2, "ctopic") != 0) return 1;
+    int rest = 0; polls = 0;
+    long long min_off_p0 = 1 << 30;
+    while (rest < 20 && polls++ < 600) {
+        tk_msg_t m;
+        int r = tk_consumer_poll(c2, 100, &m);
+        if (r == 1) {
+            if (m.err == 0) {
+                rest++;
+                if (m.partition == 0 && m.offset < min_off_p0)
+                    min_off_p0 = m.offset;
+            }
+            tk_msg_free(&m);
+        }
+    }
+    if (rest != 20) { fprintf(stderr, "resume got %d/20\n", rest); return 1; }
+    if (min_off_p0 < c0) {
+        fprintf(stderr, "resumed below committed (%lld < %lld)\n",
+                min_off_p0, c0);
+        return 1;
+    }
+
+    /* --- 6. seek back and re-read one; then admin delete ------------ */
+    if (tk_seek(c2, "ctopic", 0, 0) != 0) { fprintf(stderr, "seek\n"); return 1; }
+    int reread = 0; polls = 0;
+    while (!reread && polls++ < 600) {
+        tk_msg_t m;
+        int r = tk_consumer_poll(c2, 100, &m);
+        if (r == 1) {
+            if (m.err == 0 && m.partition == 0 && m.offset == 0) reread = 1;
+            tk_msg_free(&m);
+        }
+    }
+    if (!reread) { fprintf(stderr, "seek re-read failed\n"); return 1; }
+    tk_destroy(c2);
+
+    if (tk_delete_topic(p, "ctopic", 10000) != 0) {
+        fprintf(stderr, "delete_topic failed\n"); return 1;
+    }
     tk_destroy(p);
-    if (got != 50) { fprintf(stderr, "got %d/50\n", got); return 1; }
-    printf("CAPI-OK %d messages, key bytes %lld\n", got, key_sum);
+    printf("CAPI-OK produce2+headers+dr=%lld batch=%lld consume+commit+"
+           "resume+seek+admin all pass\n", dr_ok, nb);
     return 0;
 }

@@ -132,3 +132,34 @@ def test_control_batch_flag():
     info, _, full = next(iter_batches(bytes(wire)))
     assert info.is_control
     assert verify_crc_v2(info, full)
+
+
+def test_log_append_time_uses_max_timestamp():
+    """LOG_APPEND_TIME batches: per-record deltas still carry producer
+    create times; every record must report the batch MaxTimestamp
+    (reference rdkafka_msgset_reader.c:902-908)."""
+    from librdkafka_tpu.protocol import proto
+    from librdkafka_tpu.protocol.msgset import (
+        MsgsetWriterV2, Record, iter_batches, parse_fetch_messages_v2,
+        parse_records_v2)
+
+    recs = [Record(key=None, value=b"v%d" % i, headers=[],
+                   timestamp=1_000_000 + i * 50) for i in range(5)]
+    w = MsgsetWriterV2(codec=None)
+    w.build(recs, now_ms=1_000_000)
+    w.assemble(None)
+    wire = bytearray(w.patch_crc(0))
+    # flip the timestamp-type attribute bit and stamp MaxTimestamp the
+    # way a broker does for log.message.timestamp.type=LogAppendTime
+    attrs_off = proto.V2_OF_Attributes
+    wire[attrs_off + 1] |= proto.ATTR_TIMESTAMP_TYPE & 0xFF
+    append_ms = 2_000_000
+    maxts_off = proto.V2_OF_MaxTimestamp
+    wire[maxts_off:maxts_off + 8] = append_ms.to_bytes(8, "big")
+    (info, payload, full), = iter_batches(bytes(wire))
+    assert info.attrs & proto.ATTR_TIMESTAMP_TYPE
+    for r in parse_records_v2(info, payload):
+        assert r.timestamp == append_ms
+        assert r.timestamp_type == proto.TSTYPE_LOG_APPEND_TIME
+    msgs, _ = parse_fetch_messages_v2(info, payload, "t", 0, 0)
+    assert all(m.timestamp == append_ms for m in msgs)

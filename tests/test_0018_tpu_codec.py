@@ -97,6 +97,32 @@ def test_crc32c_standard_vector():
     assert int(crc32c_jax.crc32c_many([b"123456789"])[0]) == 0xE3069283
 
 
+def test_crc32c_hw_sw_cross_check():
+    """The SSE4.2 3-stream path (tk_crc32c, runtime-detected) must be
+    bit-exact vs the software slice-by-8 fold (tk_crc32c_sw) across the
+    lane-split thresholds: the 3-lane split engages at n >= 192, lane
+    lengths are 8-byte aligned, and the tail folds into lane C — every
+    boundary gets randomized coverage, with nonzero initial registers
+    (the GF(2) zero-advance stitch must honor them)."""
+    import ctypes
+
+    L = cpu.lib()
+    L.tk_crc32c_sw.restype = ctypes.c_uint32
+    L.tk_crc32c_sw.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                               ctypes.c_uint32]
+    rng = np.random.default_rng(42)
+    sizes = (list(range(0, 32)) + [63, 64, 65, 127, 128, 129,
+             190, 191, 192, 193, 200, 255, 256, 257, 383, 384, 385,
+             575, 576, 577, 1000, 4095, 4096, 4097, 65535, 65536,
+             65537, 1_000_003])
+    for n in sizes:
+        buf = rng.integers(0, 256, max(n, 1), dtype=np.uint8).tobytes()[:n]
+        for init in (0, 1, 0xFFFFFFFF, int(rng.integers(0, 1 << 32))):
+            hw = L.tk_crc32c(buf, n, init)
+            sw = L.tk_crc32c_sw(buf, n, init)
+            assert hw == sw, (n, hex(init), hex(hw), hex(sw))
+
+
 def test_crc32c_mxu_bitexact():
     """The one-matmul MXU formulation (64KB blocks + host combine) must
     match the oracle on every size class: sub-block, exact block,

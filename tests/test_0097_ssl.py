@@ -191,3 +191,214 @@ def test_gssapi_rejected_at_creation():
         Producer({"bootstrap.servers": "127.0.0.1:1",
                   "security.protocol": "sasl_plaintext"})
     assert ei.value.error.code == Err._UNSUPPORTED_FEATURE
+
+
+# ---------------------------------------------------- r4: ssl.* breadth ----
+
+def test_mtls_with_in_memory_pems(certs):
+    """mTLS from in-memory PEM strings (ssl.certificate.pem /
+    ssl.key.pem / ssl_ca) — no file paths in the client conf at all
+    (reference rdkafka_cert.c in-memory certs via
+    rd_kafka_conf_set_ssl_cert)."""
+    cluster = MockCluster(num_brokers=1, topics={"mem": 1},
+                          tls={"certfile": certs["server_cert"],
+                               "keyfile": certs["server_key"],
+                               "cafile": certs["ca"],
+                               "require_client_cert": True})
+    try:
+        with open(certs["client_cert"]) as f:
+            cert_pem = f.read()
+        with open(certs["client_key"]) as f:
+            key_pem = f.read()
+        with open(certs["ca"], "rb") as f:
+            ca_pem = f.read()
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "security.protocol": "ssl",
+                      "ssl_ca": ca_pem,
+                      "ssl.certificate.pem": cert_pem,
+                      "ssl.key.pem": key_pem,
+                      "linger.ms": 5})
+        p.produce("mem", value=b"in-memory-mtls", partition=0)
+        assert p.flush(15.0) == 0
+        p.close()
+    finally:
+        cluster.stop()
+
+
+def test_ssl_key_bytes_variant(certs):
+    """ssl_certificate / ssl_key accept raw PEM bytes (the C
+    set_ssl_cert path hands buffers, not str)."""
+    cluster = MockCluster(num_brokers=1, topics={"memb": 1},
+                          tls={"certfile": certs["server_cert"],
+                               "keyfile": certs["server_key"],
+                               "cafile": certs["ca"],
+                               "require_client_cert": True})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "security.protocol": "ssl",
+                      "ssl_ca": open(certs["ca"], "rb").read(),
+                      "ssl_certificate": open(certs["client_cert"], "rb").read(),
+                      "ssl_key": open(certs["client_key"], "rb").read(),
+                      "linger.ms": 5})
+        p.produce("memb", value=b"bytes-mtls", partition=0)
+        assert p.flush(15.0) == 0
+        p.close()
+    finally:
+        cluster.stop()
+
+
+def test_certificate_verify_cb_rejects(tls_cluster, certs):
+    """ssl.certificate.verify_cb returning False must fail the
+    connection (reference rd_kafka_conf_set_ssl_cert_verify_cb)."""
+    calls = []
+
+    def reject(broker_name, broker_id, depth, der, ok):
+        calls.append((broker_name, bool(der), ok))
+        return False
+
+    drs = []
+    p = Producer(_ssl_conf(tls_cluster, certs, **{
+        "ssl.certificate.verify_cb": reject,
+        "socket.timeout.ms": 3000,
+        "message.timeout.ms": 2000,
+        "dr_msg_cb": lambda e, m: drs.append(e)}))
+    p.produce("sec", value=b"never", partition=0)
+    assert p.flush(8.0) == 0
+    # the message must have FAILED (timed out unreachable), not delivered
+    assert drs and drs[0] is not None and drs[0].code == Err._MSG_TIMED_OUT
+    assert calls and calls[0][1], "verify_cb saw no DER certificate"
+    p.close()
+
+
+def test_certificate_verify_cb_accepts(tls_cluster, certs):
+    seen = []
+
+    def accept(broker_name, broker_id, depth, der, ok):
+        seen.append(der)
+        return True
+
+    p = Producer(_ssl_conf(tls_cluster, certs, **{
+        "ssl.certificate.verify_cb": accept}))
+    p.produce("sec", value=b"allowed", partition=0)
+    assert p.flush(15.0) == 0
+    assert seen and seen[0]                 # got the DER bytes
+    p.close()
+
+
+def test_curves_and_sigalgs_lists(tls_cluster, certs):
+    """ssl.curves.list / ssl.sigalgs.list reach OpenSSL (a handshake
+    still succeeds with mainstream values; junk fails loudly at
+    client-create time, proving the knob is applied, not decorative)."""
+    p = Producer(_ssl_conf(tls_cluster, certs, **{
+        "ssl.curves.list": "X25519:P-256",
+        "ssl.sigalgs.list": "RSA-PSS+SHA256:rsa_pkcs1_sha256"}))
+    p.produce("sec", value=b"curves", partition=0)
+    assert p.flush(15.0) == 0
+    p.close()
+
+    with pytest.raises(KafkaException):
+        Producer(_ssl_conf(tls_cluster, certs,
+                           **{"ssl.curves.list": "NOT-A-CURVE"}))
+    with pytest.raises(KafkaException):
+        Producer(_ssl_conf(tls_cluster, certs,
+                           **{"ssl.sigalgs.list": "NOT-A-SIGALG"}))
+
+
+def test_crl_location_rejects_revoked(certs, tmp_path):
+    """ssl.crl.location: a CRL revoking the server cert must fail the
+    handshake; an empty CRL from the same CA lets it through."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from tlsutil import load_key_and_cert
+
+    ca_key, ca_cert, srv_cert = load_key_and_cert(certs)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def build_crl(revoke_serial=None):
+        b = (x509.CertificateRevocationListBuilder()
+             .issuer_name(ca_cert.subject)
+             .last_update(now)
+             .next_update(now + datetime.timedelta(days=1)))
+        if revoke_serial is not None:
+            b = b.add_revoked_certificate(
+                x509.RevokedCertificateBuilder()
+                .serial_number(revoke_serial)
+                .revocation_date(now).build())
+        return b.sign(ca_key, hashes.SHA256()).public_bytes(
+            serialization.Encoding.PEM)
+
+    crl_rev = tmp_path / "revoked.crl"
+    crl_rev.write_bytes(build_crl(srv_cert.serial_number))
+    crl_ok = tmp_path / "empty.crl"
+    crl_ok.write_bytes(build_crl(None))
+
+    cluster = MockCluster(num_brokers=1, topics={"crl": 1},
+                          tls={"certfile": certs["server_cert"],
+                               "keyfile": certs["server_key"]})
+    try:
+        # revoked: handshake must fail -> the message FAILS (timeout DR)
+        drs = []
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "security.protocol": "ssl",
+                      "ssl.ca.location": certs["ca"],
+                      "ssl.crl.location": str(crl_rev),
+                      "message.timeout.ms": 2500, "linger.ms": 5,
+                      "dr_msg_cb": lambda e, m: drs.append(e)})
+        p.produce("crl", value=b"no", partition=0)
+        assert p.flush(8.0) == 0
+        assert drs and drs[0] is not None \
+            and drs[0].code == Err._MSG_TIMED_OUT
+        p.close()
+        # empty CRL: fine
+        p2 = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                       "security.protocol": "ssl",
+                       "ssl.ca.location": certs["ca"],
+                       "ssl.crl.location": str(crl_ok),
+                       "linger.ms": 5})
+        p2.produce("crl", value=b"yes", partition=0)
+        assert p2.flush(15.0) == 0
+        p2.close()
+    finally:
+        cluster.stop()
+
+
+def test_open_and_closesocket_cbs(certs, tmp_path):
+    """open_cb feeds the file offset store's opens; closesocket_cb fires
+    on broker socket close (reference open_cb/closesocket_cb rows)."""
+    import os as _os
+
+    opened = []
+    closed = []
+
+    def open_cb(path, flags):
+        opened.append(path)
+        return _os.open(path, flags | _os.O_CREAT, 0o644)
+
+    cluster = MockCluster(num_brokers=1, topics={"oc": 1})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "closesocket_cb": lambda s: closed.append(True),
+                      "linger.ms": 5})
+        p.produce("oc", value=b"x", partition=0)
+        assert p.flush(10.0) == 0
+        p.close()
+        assert closed, "closesocket_cb never fired"
+
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "goc", "auto.offset.reset": "earliest",
+                      "open_cb": open_cb,
+                      "offset.store.method": "file",
+                      "offset.store.path": str(tmp_path) + _os.sep})
+        c.subscribe(["oc"])
+        deadline = time.monotonic() + 15
+        m = None
+        while m is None and time.monotonic() < deadline:
+            m = c.poll(0.2)
+        assert m is not None and m.error is None
+        c.commit(asynchronous=False)
+        c.close()
+        assert opened and opened[0].endswith("oc-0.offset")
+    finally:
+        cluster.stop()

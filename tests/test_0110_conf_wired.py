@@ -51,9 +51,24 @@ def test_every_property_is_read_outside_conf():
     for prop in PROPERTIES:
         if prop.alias or prop.name in ALLOWED_UNREAD:
             continue
+        if prop.deprecated:
+            # accepted no-ops, like the reference's _RK_DEPRECATED rows
+            # (e.g. reconnect.backoff.jitter.ms, rdkafka_conf.c:437) —
+            # marked so the doc generator labels them; anything unread
+            # and NOT marked deprecated is still a decorative row
+            continue
         if prop.name not in blob:
             dead.append(prop.name)
     assert not dead, f"decorative conf rows (declared, never read): {dead}"
+
+
+def test_deprecated_rows_are_accepted_noops():
+    from librdkafka_tpu.client.conf import Conf
+    dep = [p for p in PROPERTIES if p.deprecated]
+    assert any(p.name == "reconnect.backoff.jitter.ms" for p in dep)
+    c = Conf()
+    for p in dep:
+        c.set(p.name, p.default)      # must not raise
 
 
 def test_aliases_point_at_real_rows():

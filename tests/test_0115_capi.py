@@ -42,7 +42,7 @@ def test_c_program_round_trip(libtkafka):
     r = subprocess.run([exe], capture_output=True, text=True, timeout=120,
                        env=env)
     assert r.returncode == 0, (r.stdout, r.stderr)
-    assert "CAPI-OK 50 messages" in r.stdout
+    assert "CAPI-OK" in r.stdout and "all pass" in r.stdout
 
 
 def test_header_is_self_contained(libtkafka):

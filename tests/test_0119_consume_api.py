@@ -162,3 +162,41 @@ def test_consume_connection_close_recovers(cluster):
     c.close()
     assert sorted(set(got)) == list(range(40)), \
         f"lost offsets: {sorted(set(range(40)) - set(got))}"
+
+
+def test_consume_callback_mode(cluster):
+    """Callback-based consume (reference rd_kafka_consume_callback +
+    consume_cb / consume.callback.max.messages conf rows)."""
+    _produce(cluster, 30)
+    seen = []
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gccb", "auto.offset.reset": "earliest",
+                  "consume_cb": lambda m: seen.append(m.offset),
+                  "consume.callback.max.messages": 10})
+    c.subscribe(["ca"])
+    total = 0
+    deadline = time.monotonic() + 20
+    while total < 30 and time.monotonic() < deadline:
+        n = c.consume_callback(timeout=0.5)
+        assert n <= 10          # consume.callback.max.messages cap
+        total += n
+    assert total == 30
+    assert seen == list(range(30))
+    # explicit-arg override beats the conf cap
+    _produce(cluster, 5)
+    got2 = []
+    deadline = time.monotonic() + 20
+    while len(got2) < 5 and time.monotonic() < deadline:
+        c.consume_callback(timeout=0.5,
+                           consume_cb=lambda m: got2.append(m.offset),
+                           max_messages=2)
+    assert got2 == list(range(30, 35))
+    c.close()
+
+
+def test_consume_callback_requires_cb(cluster):
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gnone"})
+    with pytest.raises(Exception):
+        c.consume_callback(timeout=0.1)
+    c.close()

@@ -87,4 +87,14 @@ def make_certs(tmpdir: str) -> dict:
         "client_cert": paths["client.pem"],
         "client_key": paths["client.key"],
         "client_p12": paths["client.p12"],
+        # objects for tests that need to issue more material from the
+        # SAME CA (e.g. CRLs revoking the server cert's serial)
+        "_ca_key": ca_key,
+        "_ca_cert": ca_cert,
+        "_server_cert_obj": srv_cert,
     }
+
+
+def load_key_and_cert(certs: dict):
+    """(ca_key, ca_cert, server_cert) objects for CRL issuance."""
+    return certs["_ca_key"], certs["_ca_cert"], certs["_server_cert_obj"]
