@@ -236,7 +236,7 @@ def tk_produce_batch(h, topic, partition, base, klens, vlens, count):
                             dtype=_np.int32)
         total = int((_np.where(ka > 0, ka, 0)
                      + _np.where(va > 0, va, 0)).sum())
-        blob = bytes(ffi.buffer(base, total))
+        blob = None   # copied lazily: the raw() lane reads base in place
         off = 0
         while done < count:
             if raw is not None:
@@ -253,6 +253,8 @@ def tk_produce_batch(h, topic, partition, base, klens, vlens, count):
                     continue
             # first-sight (toppar not registered) or ineligible: route
             # ONE record through the Python path, then retry the lane
+            if blob is None:
+                blob = bytes(ffi.buffer(base, total))
             kl, vl = int(ka[done]), int(va[done])
             k = blob[off:off + kl] if kl >= 0 else None
             off += max(kl, 0)
@@ -535,6 +537,8 @@ def tk_mock_bootstrap(h, buf, size):
 @ffi.def_extern()
 def tk_destroy(h):
     obj = _handles.pop(h, None)
+    _dr_cbs.pop(h, None)   # handle ids are never reused: drop the DR
+                           # trampoline or registrations leak forever
     if obj is not None:
         try:
             obj.close()

@@ -76,9 +76,64 @@ class Request:
 IDEMP_MAX_INFLIGHT = 5
 
 
+class _FusedJob:
+    """Phase-2 marker replacing MsgsetWriterV2 for ArenaBatches the
+    fused native builder (tk_enqlane.build_batch) can finish in one
+    GIL-released call: frame + compress + v2 header + CRC, no
+    intermediate Python bytes.  Idempotence fields are captured at
+    batch-formation time exactly like _make_writer does."""
+
+    __slots__ = ("codec_id", "pid", "epoch", "base_seq", "now_ms")
+
+    def __init__(self, codec_id: int, pid: int, epoch: int,
+                 base_seq: int, now_ms: int):
+        self.codec_id = codec_id
+        self.pid = pid
+        self.epoch = epoch
+        self.base_seq = base_seq
+        self.now_ms = now_ms
+
+
+def _fused_builder():
+    from .arena import _mod
+    m = _mod()
+    return getattr(m, "build_batch", None) if m else None
+
+
 def _run_codec_phase(rk, ready: list, codec: str) -> list:
     """Compress + assemble + CRC a batch set. Pure compute — safe on the
-    codec worker thread. Returns [(tp, msgs, wire|None, exc|None)]."""
+    codec worker thread. Returns [(tp, msgs, wire|None, exc|None)] in
+    ``ready`` order (same-tp batches must stay FIFO).
+
+    ArenaBatches carrying a _FusedJob take the fused native path; the
+    rest (Message batches, non-native codecs, device-routed providers)
+    run the 3-phase writer pipeline below."""
+    build = _fused_builder()
+    by_idx: dict[int, tuple] = {}
+    writer_items: list[tuple[int, tuple]] = []
+    for i, item in enumerate(ready):
+        tp, msgs, w = item
+        if isinstance(w, _FusedJob):
+            try:
+                if build is None:       # extension vanished mid-flight
+                    raise RuntimeError("fused builder unavailable")
+                wire = build(msgs.base, msgs.klens, msgs.vlens,
+                             msgs.count, w.now_ms, w.pid, w.epoch,
+                             w.base_seq, w.codec_id)
+                by_idx[i] = (tp, msgs, wire, None)
+            except Exception as e:
+                by_idx[i] = (tp, msgs, None, e)
+        else:
+            writer_items.append((i, item))
+    if writer_items:
+        sub = _run_codec_phase_writers(rk, [t for _, t in writer_items],
+                                       codec)
+        for (i, _), r in zip(writer_items, sub):
+            by_idx[i] = r
+    return [by_idx[i] for i in range(len(ready))]
+
+
+def _run_codec_phase_writers(rk, ready: list, codec: str) -> list:
     provider = rk.codec_provider
     results = []
     try:
@@ -551,8 +606,12 @@ class Broker:
                 if ccb:
                     ccb(self.sock)
                 self.sock.close()
-            except OSError:
-                pass
+            except Exception as e:
+                # an app close-hook that raises must not abort teardown
+                # midway (socket leak + in-flight requests never failed)
+                if not isinstance(e, OSError):
+                    self.rk.log("ERROR",
+                                f"{self.name}: closesocket_cb raised: {e!r}")
             self.sock = None
         self._rbuf.clear()
         self._wbuf.clear()
@@ -1009,7 +1068,7 @@ class Broker:
         self.rk.dr_msgq(msgs, KafkaError(Err._FAIL,
                                          f"batch codec failed: {exc!r}"))
 
-    def _make_writer(self, tp, msgs, codec: str) -> MsgsetWriterV2:
+    def _make_writer(self, tp, msgs, codec: str):
         rk = self.rk
         pid, epoch = (-1, -1)
         base_seq = -1
@@ -1017,10 +1076,18 @@ class Broker:
             pid, epoch = rk.idemp.pid, rk.idemp.epoch
             base_seq = (batch_head_msgid(msgs) - 1
                         - tp.epoch_base_msgid) & 0x7FFFFFFF
+        now_ms = int(time.time() * 1000)
+        if isinstance(msgs, ArenaBatch):
+            # fused fast lane: defer frame+compress+CRC to ONE native
+            # call in the codec phase (no intermediate records_bytes)
+            # when the provider routes this codec to the CPU path
+            cid = getattr(rk.codec_provider, "fused_codec_id",
+                          lambda c: None)(codec)
+            if cid is not None and _fused_builder() is not None:
+                return _FusedJob(cid, pid, epoch, base_seq, now_ms)
         w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
                            base_sequence=base_seq,
                            codec=None if codec == "none" else codec)
-        now_ms = int(time.time() * 1000)
         if isinstance(msgs, ArenaBatch):
             # fast lane: ONE native call straight off the arena buffers
             w.build_arena(msgs, now_ms)

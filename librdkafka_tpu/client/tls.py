@@ -119,14 +119,23 @@ def _load_client_cert(ctx: ssl.SSLContext, conf) -> None:
     cert = conf.get("ssl.certificate.location")
     key = conf.get("ssl.key.location")
     pw = conf.get("ssl.key.password") or None
-    if cert:
+    cert_mem = conf.get("ssl.certificate.pem") or conf.get("ssl_certificate")
+    key_mem = conf.get("ssl.key.pem") or conf.get("ssl_key")
+    if cert and not key_mem:
         try:
             ctx.load_cert_chain(cert, keyfile=key or None, password=pw)
         except (ssl.SSLError, OSError) as e:
             raise KafkaException(Err._SSL, f"client certificate: {e}")
         return
-    cert_mem = conf.get("ssl.certificate.pem") or conf.get("ssl_certificate")
-    key_mem = conf.get("ssl.key.pem") or conf.get("ssl_key")
+    if cert and key_mem and not cert_mem:
+        # cert from file + key in memory (the reference allows any
+        # mix of rd_kafka_conf_set_ssl_cert and file rows): read the
+        # file so both halves go through the transient-PEM load below
+        try:
+            with open(cert, "rb") as f:
+                cert_mem = f.read()
+        except OSError as e:
+            raise KafkaException(Err._SSL, f"client certificate: {e}")
     if not cert_mem:
         if key_mem:
             # key without a certificate is as much a config error as the

@@ -448,6 +448,15 @@ class CpuCodecProvider:
     def crc32c_many(self, bufs: list[bytes]) -> list[int]:
         return [int(x) for x in crc32c_many(bufs)]
 
+    def fused_codec_id(self, codec: str) -> int | None:
+        """Wire attribute id when the fused native batch builder
+        (tk_enqlane.build_batch: frame+compress+CRC+header in one
+        GIL-released call) is equivalent to this provider's 3-phase
+        path for ``codec``; None keeps the 3-phase pipeline.  The
+        fused lz4/snappy encoders are the same native functions
+        compress_many dispatches to, so wire bytes are identical."""
+        return {"none": 0, "snappy": 2, "lz4": 3}.get(codec)
+
     def crc32_many(self, bufs: list[bytes]) -> list[int]:
         """Legacy MsgVer0/1 zlib-poly CRC (reference: src/rdcrc32.c)."""
         import zlib

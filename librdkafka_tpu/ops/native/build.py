@@ -20,13 +20,15 @@ ENQ_SO = os.path.join(_DIR, "tk_enqlane.so")
 _lock = threading.Lock()
 
 
-def _compile(src: str, so: str, extra: list[str]) -> str:
+def _compile(src, so: str, extra: list[str]) -> str:
+    srcs = [src] if isinstance(src, str) else list(src)
     if (os.path.exists(so)
-            and os.path.getmtime(so) >= os.path.getmtime(src)):
+            and all(os.path.getmtime(so) >= os.path.getmtime(s)
+                    for s in srcs)):
         return so
     tmp = so + ".tmp"
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           *extra, "-o", tmp, src]
+           *extra, "-o", tmp, *srcs]
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, so)
     return so
@@ -56,12 +58,14 @@ def build(force: bool = False) -> str:
 
 
 def build_enqlane(force: bool = False) -> str:
-    """Compile the tk_enqlane CPython extension if stale; returns path."""
+    """Compile the tk_enqlane CPython extension if stale; returns path.
+    codec.cpp is linked in too: the fused batch builder (build_batch)
+    calls its framing/codec/CRC functions directly."""
     with _lock:
         if force and os.path.exists(ENQ_SO):
             os.remove(ENQ_SO)
         inc = sysconfig.get_paths()["include"]
-        return _compile(ENQ_SRC, ENQ_SO, ["-I" + inc])
+        return _compile([ENQ_SRC, SRC], ENQ_SO, ["-I" + inc])
 
 
 def load_enqlane():

@@ -218,6 +218,17 @@ class TpuCodecProvider:
             return [int(x) for x in _crc32c_many_mxu(bufs)]
         return self._cpu.crc32c_many(bufs)
 
+    def fused_codec_id(self, codec: str) -> int | None:
+        """Fused native batch build is allowed only when this provider
+        would route BOTH the compress and the CRC to the CPU path
+        anyway (lz4 not forced onto the device, transport gate says
+        offload doesn't pay) — then it is exactly the CPU provider's
+        fused path.  When the device route is open the 3-phase
+        pipeline keeps the batched CRC on the MXU."""
+        if self.lz4_force or self._offload_pays():
+            return None
+        return self._cpu.fused_codec_id(codec)
+
     def crc32_many(self, bufs: list[bytes]) -> list[int]:
         """Legacy MsgVer0/1 zlib-poly CRC on the same MXU kernel (the
         GF(2) decomposition is polynomial-agnostic; reference hot loop:

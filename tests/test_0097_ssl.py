@@ -225,6 +225,31 @@ def test_mtls_with_in_memory_pems(certs):
         cluster.stop()
 
 
+def test_mtls_cert_file_key_in_memory(certs):
+    """Mixed material: ssl.certificate.location (file) +
+    ssl.key.pem (in-memory) — the reference allows any mix of
+    rd_kafka_conf_set_ssl_cert and file rows (rdkafka_cert.c)."""
+    cluster = MockCluster(num_brokers=1, topics={"mix": 1},
+                          tls={"certfile": certs["server_cert"],
+                               "keyfile": certs["server_key"],
+                               "cafile": certs["ca"],
+                               "require_client_cert": True})
+    try:
+        with open(certs["client_key"]) as f:
+            key_pem = f.read()
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "security.protocol": "ssl",
+                      "ssl.ca.location": certs["ca"],
+                      "ssl.certificate.location": certs["client_cert"],
+                      "ssl.key.pem": key_pem,
+                      "linger.ms": 5})
+        p.produce("mix", value=b"mixed-material-mtls", partition=0)
+        assert p.flush(15.0) == 0
+        p.close()
+    finally:
+        cluster.stop()
+
+
 def test_ssl_key_bytes_variant(certs):
     """ssl_certificate / ssl_key accept raw PEM bytes (the C
     set_ssl_cert path hands buffers, not str)."""

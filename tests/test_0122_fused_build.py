@@ -1,0 +1,159 @@
+"""Fused native batch builder: tk_enqlane.build_batch must be
+bit-identical to the 3-phase writer pipeline (frame -> compress_many ->
+assemble -> patch_crc) for every codec it claims, because the broker
+swaps one for the other purely as an optimization.
+
+Reference behavior being matched: rd_kafka_msgset_writer_finalize
+(rdkafka_msgset_writer.c:1230) — header + CRC written in place over the
+accumulated buffer.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from librdkafka_tpu.client.arena import ArenaBatch
+from librdkafka_tpu.ops.cpu import CpuCodecProvider
+from librdkafka_tpu.protocol.msgset import (MsgsetWriterV2,
+                                            read_batch_header,
+                                            parse_records_v2)
+from librdkafka_tpu.utils.buf import Slice
+
+
+def _builder():
+    from librdkafka_tpu.client.broker import _fused_builder
+    b = _fused_builder()
+    if b is None:
+        pytest.skip("tk_enqlane extension unavailable")
+    return b
+
+
+def _records(n, size, keyed=False):
+    vals = [(b'{"seq": %07d, "pad": "' % i) + b"ab" * (size // 2) + b'"}'
+            for i in range(n)]
+    keys = [b"k%04d" % i if keyed else None for i in range(n)]
+    base = b"".join(
+        (k if k else b"") + v for k, v in zip(keys, vals))
+    klens = np.array([len(k) if k else -1 for k in keys],
+                     np.int32).tobytes()
+    vlens = np.array([len(v) for v in vals], np.int32).tobytes()
+    return base, klens, vlens, n
+
+
+def _writer_path(base, klens, vlens, count, now_ms, pid, epoch, seq,
+                 codec):
+    batch = ArenaBatch(base, klens, vlens, count, len(base), 0, 0)
+    w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
+                       base_sequence=seq,
+                       codec=None if codec == "none" else codec)
+    w.build_arena(batch, now_ms)
+    prov = CpuCodecProvider()
+    blob = None
+    if codec != "none":
+        blob = prov.compress_many(codec, [w.records_bytes])[0]
+        if len(blob) >= len(w.records_bytes):
+            blob = None
+            w.codec = None
+    region = w.assemble(blob)
+    return w.patch_crc(int(prov.crc32c_many([region])[0]))
+
+
+@pytest.mark.parametrize("codec,cid", [("none", 0), ("lz4", 3),
+                                       ("snappy", 2)])
+@pytest.mark.parametrize("keyed", [False, True])
+def test_bit_identical(codec, cid, keyed):
+    build = _builder()
+    base, klens, vlens, n = _records(400, 512, keyed)
+    now_ms = int(time.time() * 1000)
+    ref = _writer_path(base, klens, vlens, n, now_ms, -1, -1, -1, codec)
+    got = build(base, klens, vlens, n, now_ms, -1, -1, -1, cid)
+    assert got == ref
+
+
+def test_idempotence_fields():
+    build = _builder()
+    base, klens, vlens, n = _records(64, 256)
+    now_ms = 1721000000123
+    got = build(base, klens, vlens, n, now_ms, 7777, 5, 1234, 3)
+    ref = _writer_path(base, klens, vlens, n, now_ms, 7777, 5, 1234,
+                       "lz4")
+    assert got == ref
+    info = read_batch_header(Slice(got))
+    assert (info.producer_id, info.producer_epoch) == (7777, 5)
+    assert info.base_sequence == 1234
+    assert info.record_count == n
+
+
+def test_incompressible_falls_back_plain():
+    build = _builder()
+    rng = np.random.default_rng(3)
+    vals = [rng.integers(0, 256, 300, dtype=np.uint8).tobytes()
+            for _ in range(20)]
+    base = b"".join(vals)
+    klens = np.full(20, -1, np.int32).tobytes()
+    vlens = np.array([len(v) for v in vals], np.int32).tobytes()
+    got = build(base, klens, vlens, 20, 1721000000000, -1, -1, -1, 3)
+    info = read_batch_header(Slice(got))
+    assert info.codec is None          # stored plain, attrs codec bits 0
+    ref = _writer_path(base, klens, vlens, 20, 1721000000000, -1, -1,
+                       -1, "lz4")
+    assert got == ref
+
+
+def test_round_trip_parse():
+    build = _builder()
+    base, klens, vlens, n = _records(200, 700, keyed=True)
+    got = build(base, klens, vlens, n, 1721000000456, -1, -1, -1, 3)
+    info = read_batch_header(Slice(got))
+    prov = CpuCodecProvider()
+    payload = bytes(got[61:])
+    records = prov.decompress_many("lz4", [payload])[0]
+    recs = parse_records_v2(info, records)
+    assert len(recs) == n
+    assert recs[0].key == b"k0000"
+    assert recs[n - 1].value.startswith(b'{"seq": %07d' % (n - 1))
+    # CRC over [Attributes..end] must verify
+    from librdkafka_tpu.utils.crc import crc32c
+    import struct
+    (crc,) = struct.unpack_from(">I", got, 17)
+    patched = bytearray(got)
+    struct.pack_into(">I", patched, 17, 0)
+    assert crc == crc32c(bytes(patched[21:]))
+
+
+def test_producer_uses_fused_path():
+    """End-to-end: fast-lane batches flow through _FusedJob and arrive
+    intact (consumer reads back exactly what was produced)."""
+    from librdkafka_tpu import Consumer, Producer
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    mc = MockCluster(num_brokers=1, topics={"t0122": 2})
+    try:
+        p = Producer({"bootstrap.servers": mc.bootstrap_servers(),
+                      "compression.codec": "lz4", "linger.ms": 5})
+        sent = {}
+        for i in range(500):
+            v = (b'{"i": %d, "pad": "' % i) + b"xy" * 200 + b'"}'
+            p.produce("t0122", value=v, partition=i % 2)
+            sent[i] = v
+        assert p.flush(30.0) == 0
+        # the fused path must actually have been taken (provider says
+        # lz4 is fused-eligible on the CPU backend)
+        assert p.rk.codec_provider.fused_codec_id("lz4") == 3
+        p.close()
+
+        c = Consumer({"bootstrap.servers": mc.bootstrap_servers(),
+                      "group.id": "g0122",
+                      "auto.offset.reset": "earliest",
+                      "check.crcs": True})
+        c.subscribe(["t0122"])
+        got = []
+        deadline = time.monotonic() + 30
+        while len(got) < 500 and time.monotonic() < deadline:
+            m = c.poll(0.5)
+            if m is not None and m.error is None:
+                got.append(m.value)
+        c.close()
+        assert sorted(got) == sorted(sent.values())
+    finally:
+        mc.stop()
