@@ -241,15 +241,14 @@ class Broker:
         self.retryq: list[tuple[float, Request]] = []
         self._corrid = 0
         self._rbuf = bytearray()
-        self._wbuf = bytearray()
-        self._wbuf_off = 0              # consumed prefix (offset send)
+        # segment-queue write buffer: request segments drain via
+        # sendmsg iovecs without being flattened (sockbuf.SegWriter)
+        self._wbuf = sockbuf.SegWriter()
         # built-but-untransmitted request accounting for
         # queue.buffering.backpressure.threshold (reference: rkb_outbufs
-        # count, rdkafka_broker.c:3262). Monotonic byte totals survive
-        # wbuf compaction; the deque holds each queued request's end
-        # position in queued-bytes space.
-        self._wbuf_queued_total = 0
-        self._wbuf_sent_total = 0
+        # count, rdkafka_broker.c:3262). The deque holds each queued
+        # request's end position in the writer's monotonic queued-bytes
+        # space.
         self._unsent_req_ends: deque[int] = deque()
         self._wakeup_r, self._wakeup_w = socket.socketpair()
         self._wakeup_r.setblocking(False)
@@ -615,9 +614,6 @@ class Broker:
             self.sock = None
         self._rbuf.clear()
         self._wbuf.clear()
-        self._wbuf_off = 0
-        self._wbuf_queued_total = 0
-        self._wbuf_sent_total = 0
         self._unsent_req_ends.clear()
         self.fetch_inflight_cnt = 0
         self._tls_handshaking = False
@@ -654,20 +650,20 @@ class Broker:
             our = APIS[req.api][0]
             ver = min(our, self.api_versions.get(int(req.api), our))
         req.version = ver          # response parses with the same schema
-        wire = apis.build_request(req.api, req.corrid,
-                                  self.rk.conf.get("client.id"), req.body,
-                                  version=ver)
-        self._wbuf += wire
-        self._wbuf_queued_total += len(wire)
-        self._unsent_req_ends.append(self._wbuf_queued_total)
+        wire = apis.build_request_buf(req.api, req.corrid,
+                                      self.rk.conf.get("client.id"),
+                                      req.body, version=ver)
+        wire_len = len(wire)
+        self._wbuf.append(wire.iovecs())
+        self._unsent_req_ends.append(self._wbuf.queued_total)
         self.c_tx += 1
-        self.c_tx_bytes += len(wire)
+        self.c_tx_bytes += wire_len
         req.ts_sent = time.monotonic()
         if req.ts_enq:
             self.outbuf_avg.add((req.ts_sent - req.ts_enq) * 1e6)
         if self.rk.interceptors:
             self.rk.interceptors.on_request_sent(
-                self.nodeid, int(req.api), req.corrid, len(wire))
+                self.nodeid, int(req.api), req.corrid, wire_len)
         if req.expect_response:
             self.waitresp[req.corrid] = req
             if not req.abs_timeout:
@@ -676,23 +672,19 @@ class Broker:
         self._flush_wbuf()
 
     def _flush_wbuf(self):
-        # offset-based consumption: `del wbuf[:n]` memmoves the whole
-        # remaining buffer per send() — with 1MB batches draining in
-        # ~64KB socket chunks that is ~16MB of GIL-held shifting per
-        # batch, felt by every other thread as produce latency
-        if not self.sock or self._wbuf_off >= len(self._wbuf):
+        # scatter-gather drain: request segments (incl. spliced
+        # RecordBatch bytes) go to sendmsg in place — no flat-buffer
+        # copy, no consumed-prefix memmove
+        if not self.sock or not self._wbuf.pending():
             return
-        off, _blocked, err = sockbuf.send_from(self.sock, self._wbuf,
-                                               self._wbuf_off)
+        _n, _blocked, err = self._wbuf.send(self.sock)
         if err is not None:
             self._disconnect(KafkaError(Err._TRANSPORT,
                                         f"send failed: {err}"))
             return
-        self._wbuf_sent_total += off - self._wbuf_off
         while (self._unsent_req_ends
-               and self._unsent_req_ends[0] <= self._wbuf_sent_total):
+               and self._unsent_req_ends[0] <= self._wbuf.sent_total):
             self._unsent_req_ends.popleft()
-        self._wbuf_off = sockbuf.compact_consumed(self._wbuf, off)
 
     def _io_serve(self, timeout: float = 0.005):
         """select() over socket + wakeup pipe
@@ -708,7 +700,7 @@ class Broker:
             if self.sock is None:    # _recv may have disconnected
                 return
             rlist.append(self.sock)
-            if len(self._wbuf) > self._wbuf_off:
+            if self._wbuf.pending():
                 wlist.append(self.sock)
         try:
             r, w, _ = select.select(rlist, wlist, [], timeout)

@@ -624,9 +624,13 @@ def schemas_for(api: ApiKey, version: int | None) -> tuple[int, Schema, Schema]:
     return ver, req_schema, resp_schema
 
 
-def build_request(api: ApiKey, corrid: int, client_id: str | None,
-                  body: dict, version: int | None = None) -> bytes:
-    """Frame a request: 4-byte size + header + body (rd_kafka_buf pattern)."""
+def build_request_buf(api: ApiKey, corrid: int, client_id: str | None,
+                      body: dict, version: int | None = None):
+    """Frame a request as a SegBuf: 4-byte size + header + body.  Large
+    Bytes fields (RecordBatch wire) ride as spliced read-only segments,
+    so the broker can hand the segments straight to sendmsg without
+    flattening (reference: requests are rd_buf segment chains sent via
+    iovec, rdkafka_buf.c + rdkafka_transport.c:109)."""
     from ..utils.buf import SegBuf
     ver, req_schema, _ = schemas_for(api, version)
     buf = SegBuf()
@@ -637,7 +641,14 @@ def build_request(api: ApiKey, corrid: int, client_id: str | None,
                                "client_id": client_id})
     req_schema.write(buf, body)
     buf.update_i32(szpos, len(buf) - 4)
-    return buf.as_bytes()
+    return buf
+
+
+def build_request(api: ApiKey, corrid: int, client_id: str | None,
+                  body: dict, version: int | None = None) -> bytes:
+    """Frame a request: 4-byte size + header + body (rd_kafka_buf pattern)."""
+    return build_request_buf(api, corrid, client_id, body,
+                             version=version).as_bytes()
 
 
 def build_response(api: ApiKey, corrid: int, body: dict,

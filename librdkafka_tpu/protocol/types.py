@@ -92,12 +92,21 @@ class _NullableString:
 class _Bytes:
     """Nullable bytes: int32 length (-1 = null) + bytes."""
 
+    #: payloads at or above this ride as spliced read-only segments
+    #: (no copy into the write buffer; they go to the socket via the
+    #: SegWriter iovec path) — RecordBatch bytes in Produce requests
+    #: and Fetch responses are the case that matters
+    SPLICE_MIN = 4096
+
     def write(self, buf, val: Optional[bytes]):
         if val is None:
             buf.write_i32(-1)
         else:
             buf.write_i32(len(val))
-            buf.write(val)
+            if len(val) >= self.SPLICE_MIN:
+                buf.push_ro(val)
+            else:
+                buf.write(val)
 
     def read(self, sl) -> Optional[bytes]:
         n = sl.read_i32()

@@ -44,11 +44,17 @@ class SegBuf:
             self._len += len(data)
         return pos
 
-    def push_ro(self, data: bytes) -> int:
-        """Splice a read-only segment (no copy). Reference: rd_buf_push."""
+    def push_ro(self, data) -> int:
+        """Splice a read-only segment (no copy) — bytes, bytearray or
+        memoryview are kept by reference. Reference: rd_buf_push
+        (rdbuf.c:563); this is how a finished RecordBatch rides inside
+        a ProduceRequest without being re-copied."""
         pos = self._len
-        if data:
-            self._segs.append(data if isinstance(data, bytes) else bytes(data))
+        if len(data):
+            # a caller-owned bytearray is wrapped in a memoryview so
+            # write() can never extend it in place
+            self._segs.append(memoryview(data)
+                              if isinstance(data, bytearray) else data)
             self._len += len(data)
         return pos
 
@@ -64,10 +70,10 @@ class SegBuf:
                 self._segs.pop()
             else:
                 keep = len(seg) - drop
-                if isinstance(seg, bytes):  # copy-on-truncate for ro segment
-                    self._segs[-1] = bytearray(seg[:keep])
-                else:
+                if isinstance(seg, bytearray):
                     del seg[keep:]
+                else:  # copy-on-truncate for ro (bytes/memoryview) segment
+                    self._segs[-1] = bytearray(seg[:keep])
                 drop = 0
         self._len = pos
 
@@ -89,7 +95,7 @@ class SegBuf:
                 s = max(pos, off) - off
                 e = min(end, seg_end) - off
                 n = e - s
-                if isinstance(seg, bytes):
+                if not isinstance(seg, bytearray):  # ro: copy-on-write
                     seg = bytearray(seg)
                     self._segs[i] = seg
                 seg[s:e] = data[di:di + n]
