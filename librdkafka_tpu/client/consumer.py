@@ -59,7 +59,8 @@ class Consumer:
         self._rk.cgrp = ConsumerGroup(self._rk, group_id) if group_id else None
         self._assignment: dict[tuple[str, int], Toppar] = {}
         # messages from a batched FETCH op awaiting delivery via poll()
-        self._pending: deque = deque()
+        self._pending: deque = deque()   # (tp, msgs, version) batches
+        self._cur = None                 # [tp, msgs, version, i] cursor
         self._auto_store = conf.get("enable.auto.offset.store")
         self._closed = False
 
@@ -202,16 +203,48 @@ class Consumer:
             start({})
 
     # --------------------------------------------------------------- poll --
+    def _next_pending(self) -> Optional[Message]:
+        """Next deliverable message from the fetched-batch queue.
+        Batches stay whole (one deque entry per partition response, the
+        op-per-batch axis); a cursor walks the current batch so the
+        per-message cost is one _deliver call — no per-message tuples.
+        Staleness (seek/revoke version barriers) stays per-message."""
+        cur = self._cur
+        pending = self._pending
+        deliver = self._deliver
+        while True:
+            if cur is None:
+                if not pending:
+                    return None
+                tp, msgs, ver = pending.popleft()
+                cur = [tp, msgs, ver, 0]
+            tp, msgs, ver, i = cur
+            n = len(msgs)
+            while i < n:
+                m = msgs[i]
+                i += 1
+                out = deliver(tp, m, ver)
+                if out is not None:
+                    if i < n:
+                        cur[3] = i
+                        self._cur = cur
+                    else:
+                        self._cur = None
+                    return out
+            cur = None
+            self._cur = None
+
     def poll(self, timeout: float = 1.0) -> Optional[Message]:
-        if self._rk.cgrp:
-            self._rk.cgrp.poll_tick()
+        cgrp = self._rk.cgrp
+        if cgrp is not None:
+            cgrp.poll_tick()
+        # fast path: drain already-fetched batches without touching the
+        # clock or the op queue (the per-message consume budget)
+        msg = self._next_pending()
+        if msg is not None:
+            return msg
         deadline = time.monotonic() + timeout
         while True:
-            while self._pending:
-                tp, m, ver = self._pending.popleft()
-                msg = self._deliver(tp, m, ver)
-                if msg is not None:
-                    return msg
             remain = deadline - time.monotonic()
             op = self.queue.pop(max(0.0, min(remain, 0.1)))
             if op is None:
@@ -219,6 +252,9 @@ class Consumer:
                     return None
                 continue
             msg = self._serve_op(op)
+            if msg is not None:
+                return msg
+            msg = self._next_pending()
             if msg is not None:
                 return msg
             if time.monotonic() >= deadline:
@@ -272,10 +308,9 @@ class Consumer:
         rk = self._rk
         if op.type == OpType.FETCH:
             tp, msgs, version = op.payload
-            first = self._deliver(tp, msgs[0], version)
-            for m in msgs[1:]:
-                self._pending.append((tp, m, version))
-            return first
+            if msgs:
+                self._pending.append((tp, msgs, version))
+            return None
         if op.type == OpType.CONSUMER_ERR:
             tp, msg, version = op.payload
             return msg if tp.version == version else None

@@ -960,11 +960,204 @@ done:
     return out;
 }
 
+// ============================================ fetch materialization =====
+//
+// materialize_v2: bulk-create delivery-ready client Message objects
+// straight off tk_parse_v2's field table.  The Python loop sets 18
+// slot attributes per record through bytecode (~1.5-2 us/record — the
+// consumer budget); here each Message is tp_alloc + direct slot-offset
+// stores.  Slot offsets come from the class's member descriptors, so
+// this tracks the Python class definition (a missing slot fails loudly
+// at first call, not per record).
+// (Reference analog: rd_kafka_msgset_reader_msg_parse builds rko_msg
+// structs inline, rdkafka_msgset_reader.c:902.)
+
+#include <descrobject.h>
+
+static const char *const MSG_SLOTS[] = {
+    "topic", "partition", "key", "value", "headers", "offset",
+    "timestamp", "timestamp_type", "error", "opaque", "msgid",
+    "retries", "status", "enq_time", "ts_backoff", "latency_us",
+    "on_delivery", "size", NULL};
+enum {
+    S_TOPIC, S_PARTITION, S_KEY, S_VALUE, S_HEADERS, S_OFFSET,
+    S_TIMESTAMP, S_TSTYPE, S_ERROR, S_OPAQUE, S_MSGID,
+    S_RETRIES, S_STATUS, S_ENQ, S_BACKOFF, S_LATENCY,
+    S_ONDEL, S_SIZE, S_NSLOTS};
+
+static PyTypeObject *msg_type_cached = NULL;
+static Py_ssize_t msg_slot_off[S_NSLOTS];
+
+static int resolve_msg_slots(PyTypeObject *type) {
+    for (int i = 0; MSG_SLOTS[i]; i++) {
+        PyObject *d = PyDict_GetItemString(type->tp_dict, MSG_SLOTS[i]);
+        if (!d || !PyObject_TypeCheck(d, &PyMemberDescr_Type)) {
+            PyErr_Format(PyExc_TypeError,
+                         "materialize_v2: %s.%s is not a slot member",
+                         type->tp_name, MSG_SLOTS[i]);
+            return -1;
+        }
+        msg_slot_off[i] = ((PyMemberDescrObject *)d)->d_member->offset;
+    }
+    msg_type_cached = type;
+    return 0;
+}
+
+static inline void slot_set(PyObject *m, int slot, PyObject *v) {
+    // tp_alloc zeroed the slot; store a NEW reference (caller increfs)
+    *(PyObject **)((char *)m + msg_slot_off[slot]) = v;
+}
+
+// materialize_v2(msg_type, records: bytes, fields_addr: int, n: int,
+//                topic: str, partition: int, base_off: int, fo: int,
+//                base_ts: int, append_ts: int, log_append: int,
+//                tstype: int, status: object)
+//   -> (list[Message], total_payload_bytes, header_fixups | None)
+// header_fixups: [(list_index, ho, nh), ...] for records with headers —
+// the (rare) header parse stays in Python.
+static PyObject *mod_materialize_v2(PyObject *Py_UNUSED(self),
+                                    PyObject *const *args,
+                                    Py_ssize_t nargs) {
+    if (nargs != 13) {
+        PyErr_SetString(PyExc_TypeError, "materialize_v2: 13 args");
+        return NULL;
+    }
+    PyTypeObject *type = (PyTypeObject *)args[0];
+    if (!PyType_Check(args[0])) {
+        PyErr_SetString(PyExc_TypeError, "arg 0 must be the Message type");
+        return NULL;
+    }
+    if (type != msg_type_cached && resolve_msg_slots(type) < 0)
+        return NULL;
+    Py_buffer rb;
+    if (PyObject_GetBuffer(args[1], &rb, PyBUF_SIMPLE) < 0) return NULL;
+    const int64_t *fields = (const int64_t *)PyLong_AsVoidPtr(args[2]);
+    int64_t n = PyLong_AsLongLong(args[3]);
+    PyObject *topic = args[4];
+    int64_t partition = PyLong_AsLongLong(args[5]);
+    int64_t base_off = PyLong_AsLongLong(args[6]);
+    int64_t fo = PyLong_AsLongLong(args[7]);
+    int64_t base_ts = PyLong_AsLongLong(args[8]);
+    PyObject *append_ts_obj = args[9];      // PyLong (shared when log_append)
+    int log_append = (int)PyLong_AsLong(args[10]);
+    PyObject *tstype = args[11];
+    PyObject *status = args[12];
+    if (PyErr_Occurred()) { PyBuffer_Release(&rb); return NULL; }
+    const char *rbase = (const char *)rb.buf;
+    int64_t rblen = rb.len;
+
+    PyObject *list = PyList_New(0);
+    PyObject *fixups = NULL;
+    PyObject *part_obj = PyLong_FromLongLong(partition);
+    PyObject *zero = PyLong_FromLong(0);
+    PyObject *fzero = PyFloat_FromDouble(0.0);
+    int64_t total = 0;
+    // one-entry timestamp memo: fast-lane batches carry one timestamp
+    int64_t ts_memo_v = INT64_MIN;
+    PyObject *ts_memo = NULL;
+    if (!list || !part_obj || !zero || !fzero) goto fail;
+    for (int64_t i = 0; i < n; i++) {
+        const int64_t *f = fields + i * 8;
+        int64_t off = base_off + f[1];
+        if (off < fo) continue;
+        int64_t ko = f[2], kl = f[3], vo = f[4], vl = f[5];
+        if (kl > 0 && (ko < 0 || ko + kl > rblen)) goto bounds;
+        if (vl > 0 && (vo < 0 || vo + vl > rblen)) goto bounds;
+        {
+            PyObject *m = type->tp_alloc(type, 0);
+            if (!m) goto fail;
+            PyObject *key, *value, *headers, *off_o, *ts_o, *size_o;
+            if (kl >= 0) key = PyBytes_FromStringAndSize(rbase + ko, kl);
+            else { key = Py_None; Py_INCREF(key); }
+            if (vl >= 0) value = PyBytes_FromStringAndSize(rbase + vo, vl);
+            else { value = Py_None; Py_INCREF(value); }
+            headers = PyList_New(0);
+            off_o = PyLong_FromLongLong(off);
+            if (log_append) {
+                ts_o = append_ts_obj; Py_INCREF(ts_o);
+            } else {
+                int64_t tsv = base_ts + f[0];
+                if (tsv != ts_memo_v || !ts_memo) {
+                    Py_XDECREF(ts_memo);
+                    ts_memo = PyLong_FromLongLong(tsv);
+                    ts_memo_v = tsv;
+                }
+                ts_o = ts_memo; Py_XINCREF(ts_o);
+            }
+            int64_t sz = (vl > 0 ? vl : 0) + (kl > 0 ? kl : 0);
+            size_o = PyLong_FromLongLong(sz);
+            if (!key || !value || !headers || !off_o || !ts_o || !size_o) {
+                Py_XDECREF(key); Py_XDECREF(value); Py_XDECREF(headers);
+                Py_XDECREF(off_o); Py_XDECREF(ts_o); Py_XDECREF(size_o);
+                Py_DECREF(m);
+                goto fail;
+            }
+            Py_INCREF(topic);  slot_set(m, S_TOPIC, topic);
+            Py_INCREF(part_obj); slot_set(m, S_PARTITION, part_obj);
+            slot_set(m, S_KEY, key);
+            slot_set(m, S_VALUE, value);
+            slot_set(m, S_HEADERS, headers);
+            slot_set(m, S_OFFSET, off_o);
+            slot_set(m, S_TIMESTAMP, ts_o);
+            Py_INCREF(tstype); slot_set(m, S_TSTYPE, tstype);
+            Py_INCREF(Py_None); slot_set(m, S_ERROR, Py_None);
+            Py_INCREF(Py_None); slot_set(m, S_OPAQUE, Py_None);
+            Py_INCREF(zero); slot_set(m, S_MSGID, zero);
+            Py_INCREF(zero); slot_set(m, S_RETRIES, zero);
+            Py_INCREF(status); slot_set(m, S_STATUS, status);
+            Py_INCREF(fzero); slot_set(m, S_ENQ, fzero);
+            Py_INCREF(fzero); slot_set(m, S_BACKOFF, fzero);
+            Py_INCREF(zero); slot_set(m, S_LATENCY, zero);
+            Py_INCREF(Py_None); slot_set(m, S_ONDEL, Py_None);
+            slot_set(m, S_SIZE, size_o);
+            total += sz;
+            if (PyList_Append(list, m) < 0) { Py_DECREF(m); goto fail; }
+            Py_DECREF(m);
+            if (f[7] > 0) {            // record carries headers: fix up
+                if (!fixups) {
+                    fixups = PyList_New(0);
+                    if (!fixups) goto fail;
+                }
+                PyObject *t = Py_BuildValue(
+                    "(nLL)", PyList_GET_SIZE(list) - 1,
+                    (long long)f[6], (long long)f[7]);
+                if (!t || PyList_Append(fixups, t) < 0) {
+                    Py_XDECREF(t); goto fail;
+                }
+                Py_DECREF(t);
+            }
+        }
+    }
+    {
+        PyObject *r = Py_BuildValue("(OLO)", list, (long long)total,
+                                    fixups ? fixups : Py_None);
+        Py_DECREF(list);
+        Py_XDECREF(fixups);
+        Py_XDECREF(ts_memo);
+        Py_DECREF(part_obj); Py_DECREF(zero); Py_DECREF(fzero);
+        PyBuffer_Release(&rb);
+        return r;
+    }
+bounds:
+    PyErr_SetString(PyExc_ValueError,
+                    "materialize_v2: record field out of bounds");
+fail:
+    Py_XDECREF(list);
+    Py_XDECREF(fixups);
+    Py_XDECREF(ts_memo);
+    Py_XDECREF(part_obj); Py_XDECREF(zero); Py_XDECREF(fzero);
+    PyBuffer_Release(&rb);
+    return NULL;
+}
+
 static PyMethodDef module_methods[] = {
     {"build_batch", (PyCFunction)(void (*)(void))mod_build_batch,
      METH_FASTCALL,
      "build_batch(base, klens, vlens, count, now_ms, pid, epoch, "
      "base_seq, codec_id) -> wire RecordBatch bytes"},
+    {"materialize_v2", (PyCFunction)(void (*)(void))mod_materialize_v2,
+     METH_FASTCALL,
+     "materialize_v2(...) -> (messages, total_bytes, header_fixups)"},
     {NULL, NULL, 0, NULL}};
 
 static PyMemberDef lane_members[] = {

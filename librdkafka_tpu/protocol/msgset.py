@@ -457,6 +457,18 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
     append_ts = info.max_timestamp
     base_off = info.base_offset
     not_persisted = MsgStatus.NOT_PERSISTED
+    mat = _materializer()
+    if mat is not None:
+        # bulk native materialization: tp_alloc + direct slot stores per
+        # record instead of 18 bytecode attribute sets (enqlane.cpp)
+        out, total, fixups = mat(
+            Message, records_bytes, fields.ctypes.data, n, topic,
+            partition, base_off, fo, base_ts, append_ts,
+            1 if log_append else 0, tstype, not_persisted)
+        if fixups is not None:
+            for idx, ho, nh in fixups:
+                out[idx].headers = _parse_headers(records_bytes, ho, nh)
+        return out, total
     new = Message.__new__
     out = []
     append = out.append
@@ -488,6 +500,26 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
         total += sz
         append(m)
     return out, total
+
+
+_MAT = None
+_MAT_ERR = False
+
+
+def _materializer():
+    """tk_enqlane.materialize_v2, or None when the extension is
+    unavailable (pure-Python fallback below stays authoritative)."""
+    global _MAT, _MAT_ERR
+    if _MAT is None and not _MAT_ERR:
+        try:
+            from ..client.arena import _mod
+            m = _mod()
+            _MAT = getattr(m, "materialize_v2", None) if m else None
+            if _MAT is None:
+                _MAT_ERR = True
+        except Exception:
+            _MAT_ERR = True
+    return _MAT
 
 
 def _parse_headers(buf: bytes, off: int, nh: int) -> list:
