@@ -36,15 +36,41 @@ def produce_mode(args):
         else:
             errors[0] += 1
 
-    conf["dr_msg_cb"] = on_dr
+    # -l: per-message produce->delivery latency, the
+    # rdkafka_performance latency mode (reference
+    # examples/rdkafka_performance.c:70,112-118 — msg opaque carries the
+    # send timestamp, the DR handler feeds the histogram). Tracking a
+    # timestamp per message forgoes the zero-alloc fast lane, exactly
+    # like the reference's -l forgoes its zero-copy path.
+    lat = None
+    if args.latency:
+        from librdkafka_tpu.utils.hdrhistogram import HdrHistogram
+        lat = HdrHistogram(1, 60_000_000, 3)   # 1us .. 60s
+
+        def on_dr_lat(err, msg):
+            if err is None:
+                delivered[0] += 1
+                lat.record(max(1, int((time.monotonic() - msg.opaque)
+                                      * 1e6)))
+            else:
+                errors[0] += 1
+
+        conf["dr_msg_cb"] = on_dr_lat
+    else:
+        conf["dr_msg_cb"] = on_dr
     p = Producer(conf)
     payload = bytes(bytearray(i & 0xFF for i in range(args.size)))
     t0 = time.monotonic()
     for i in range(args.count):
         while True:
             try:
-                p.produce(args.topic, value=payload,
-                          partition=i % args.partitions)
+                if lat is not None:
+                    p.produce(args.topic, value=payload,
+                              partition=i % args.partitions,
+                              opaque=time.monotonic())
+                else:
+                    p.produce(args.topic, value=payload,
+                              partition=i % args.partitions)
                 break
             except BufferError:
                 p.poll(0.01)
@@ -57,6 +83,11 @@ def produce_mode(args):
     mb = delivered[0] * args.size / dt / 1e6
     print(f"% {delivered[0]} msgs delivered ({errors[0]} failed, "
           f"{rem} stuck) in {dt:.3f}s: {rate:,.0f} msgs/s, {mb:.2f} MB/s")
+    if lat is not None and delivered[0]:
+        p = lat.value_at_percentile
+        print(f"% latency (us): min={lat.min_v} avg={lat.mean():.0f} "
+              f"p50={p(50)} p95={p(95)} p99={p(99)} p99.99={p(99.99)} "
+              f"max={lat.max_v}")
     if stats:
         il = stats[-1]["int_latency"]
         print(f"% int_latency p50={il['p50']}us p99={il['p99']}us")
@@ -74,6 +105,10 @@ def consume_mode(args):
     nbytes = 0
     t0 = None
     idle_deadline = time.monotonic() + 30
+    lat = None
+    if args.latency:
+        from librdkafka_tpu.utils.hdrhistogram import HdrHistogram
+        lat = HdrHistogram(1, 60_000_000, 3)
     while time.monotonic() < idle_deadline:
         m = c.poll(0.5)
         if m is None or m.error is not None:
@@ -82,6 +117,11 @@ def consume_mode(args):
             t0 = time.monotonic()
         n += 1
         nbytes += len(m.value or b"")
+        if lat is not None and m.timestamp:
+            # end-to-end latency vs the producer CreateTime stamp
+            # (reference -l consume path prints the same delta)
+            lat.record(max(1, int(time.time() * 1000 - m.timestamp)
+                           * 1000))
         idle_deadline = time.monotonic() + 3
         if args.count and n >= args.count:
             break
@@ -89,6 +129,10 @@ def consume_mode(args):
     c.close()
     print(f"% consumed {n} msgs in {dt:.3f}s: {n / dt:,.0f} msgs/s, "
           f"{nbytes / dt / 1e6:.2f} MB/s")
+    if lat is not None and n:
+        p = lat.value_at_percentile
+        print(f"% e2e latency (us): min={lat.min_v} avg={lat.mean():.0f} "
+              f"p50={p(50)} p99={p(99)} max={lat.max_v}")
 
 
 def main():
@@ -100,6 +144,9 @@ def main():
     ap.add_argument("-g", dest="group", default="perf-group")
     ap.add_argument("-s", dest="size", type=int, default=1024)
     ap.add_argument("-c", dest="count", type=int, default=100000)
+    ap.add_argument("-l", dest="latency", action="store_true",
+                    help="per-message latency mode (produce: "
+                         "produce->DR; consume: CreateTime->poll)")
     ap.add_argument("-z", dest="codec", default="none",
                     choices=["none", "gzip", "snappy", "lz4", "zstd"])
     ap.add_argument("--backend", default="cpu", choices=["cpu", "tpu"])
