@@ -408,6 +408,26 @@ CODECS = {
 }
 
 
+_EXT = None
+_EXT_ERR = False
+
+
+def _ext():
+    """The tk_enqlane extension's batched codec entry points (no-join
+    crc32c_many / in-place decompress_many), or None."""
+    global _EXT, _EXT_ERR
+    if _EXT is None and not _EXT_ERR:
+        try:
+            from .native.build import load_enqlane
+            m = load_enqlane()
+            _EXT = m if hasattr(m, "crc32c_many") else None
+            if _EXT is None:
+                _EXT_ERR = True
+        except Exception:
+            _EXT_ERR = True
+    return _EXT
+
+
 class CpuCodecProvider:
     """The msgset codec provider interface (SURVEY.md §7 stage 5).
 
@@ -436,6 +456,23 @@ class CpuCodecProvider:
                         size_hints: list[int] | None = None) -> list[bytes]:
         if not bufs:
             return []
+        if codec in ("lz4", "snappy"):
+            ext = _ext()
+            if (ext is not None and codec == "snappy" and any(
+                    bytes(b).startswith(SNAPPY_JAVA_MAGIC)
+                    for b in bufs)):
+                ext = None           # java framing: python reader below
+            if ext is not None:
+                out = ext.decompress_many(3 if codec == "lz4" else 2,
+                                          bufs, size_hints)
+                if None not in out:
+                    return out
+                # isolate failures through the grow-and-retry path
+                return [o if o is not None else
+                        self.decompress_one(codec, b, h)
+                        for o, b, h in zip(
+                            out, bufs,
+                            size_hints or [0] * len(bufs))]
         if codec == "lz4":
             return lz4f_decompress_many(bufs, size_hints)
         if codec == "snappy" and not any(
@@ -445,7 +482,14 @@ class CpuCodecProvider:
         hints = size_hints or [0] * len(bufs)
         return [dec(b, h) for b, h in zip(bufs, hints)]
 
+    def decompress_one(self, codec: str, buf: bytes, hint: int = 0):
+        return CODECS[codec][1](buf, hint)
+
     def crc32c_many(self, bufs: list[bytes]) -> list[int]:
+        ext = _ext()
+        if ext is not None:
+            # per-buffer hardware CRC with no join copy (enqlane.cpp)
+            return ext.crc32c_many(bufs)
         return [int(x) for x in crc32c_many(bufs)]
 
     def fused_codec_id(self, codec: str) -> int | None:

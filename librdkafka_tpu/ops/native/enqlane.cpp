@@ -814,9 +814,14 @@ int64_t tk_frame_v2(const uint8_t *base, const int32_t *klens,
 int64_t tk_lz4f_bound(int64_t n);
 int64_t tk_lz4f_compress_fast(const uint8_t *src, int64_t n,
                               uint8_t *dst, int64_t cap);
+int64_t tk_lz4f_decompress(const uint8_t *src, int64_t n,
+                           uint8_t *dst, int64_t cap);
 int64_t tk_snappy_bound(int64_t n);
 int64_t tk_snappy_compress(const uint8_t *src, int64_t n,
                            uint8_t *dst, int64_t cap);
+int64_t tk_snappy_uncompressed_length(const uint8_t *src, int64_t n);
+int64_t tk_snappy_decompress(const uint8_t *src, int64_t n,
+                             uint8_t *dst, int64_t cap);
 uint32_t tk_crc32c(const uint8_t *p, int64_t n, uint32_t crc);
 }
 
@@ -1150,6 +1155,125 @@ fail:
     return NULL;
 }
 
+// crc32c_many(buffers) -> list[int]
+// Per-buffer CRC32C with no join copy: the ctypes provider path
+// concatenated every region into one contiguous base first (a ~2 GB/s
+// memcpy in front of a ~15 GB/s hardware CRC).
+static PyObject *mod_crc32c_many(PyObject *Py_UNUSED(self),
+                                 PyObject *const *args,
+                                 Py_ssize_t nargs) {
+    if (nargs != 1) {
+        PyErr_SetString(PyExc_TypeError, "crc32c_many(buffers)");
+        return NULL;
+    }
+    PyObject *seq = PySequence_Fast(args[0], "crc32c_many: not a sequence");
+    if (!seq) return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (!out) { Py_DECREF(seq); return NULL; }
+    std::vector<Py_buffer> bufs((size_t)n);
+    Py_ssize_t got = 0;
+    for (; got < n; got++) {
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(seq, got),
+                               &bufs[got], PyBUF_SIMPLE) < 0)
+            break;
+    }
+    if (got == n) {
+        std::vector<uint32_t> crcs((size_t)n);
+        Py_BEGIN_ALLOW_THREADS
+        for (Py_ssize_t i = 0; i < n; i++)
+            crcs[i] = tk_crc32c((const uint8_t *)bufs[i].buf,
+                                bufs[i].len, 0);
+        Py_END_ALLOW_THREADS
+        for (Py_ssize_t i = 0; i < n; i++) {
+            PyObject *v = PyLong_FromUnsignedLong(crcs[i]);
+            if (!v) { Py_CLEAR(out); break; }
+            PyList_SET_ITEM(out, i, v);
+        }
+    } else {
+        Py_CLEAR(out);
+    }
+    for (Py_ssize_t i = 0; i < got; i++) PyBuffer_Release(&bufs[i]);
+    Py_DECREF(seq);
+    return out;
+}
+
+// decompress_many(codec_id, buffers, hints|None) -> list[bytes|None]
+// codec_id: 3 lz4-frame, 2 raw snappy.  Output bytes are written in
+// place (alloc, decompress with the GIL released, shrink) — no join of
+// the inputs, no string_at copy of the outputs.  A buffer that fails
+// comes back None (caller falls back / errors the batch).
+static PyObject *mod_decompress_many(PyObject *Py_UNUSED(self),
+                                     PyObject *const *args,
+                                     Py_ssize_t nargs) {
+    if (nargs != 3) {
+        PyErr_SetString(PyExc_TypeError,
+                        "decompress_many(codec_id, buffers, hints)");
+        return NULL;
+    }
+    int64_t codec = PyLong_AsLongLong(args[0]);
+    if (PyErr_Occurred()) return NULL;
+    if (codec != 2 && codec != 3) {
+        PyErr_SetString(PyExc_ValueError, "codec_id must be 2 or 3");
+        return NULL;
+    }
+    PyObject *seq = PySequence_Fast(args[1],
+                                    "decompress_many: not a sequence");
+    if (!seq) return NULL;
+    PyObject *hints = args[2] == Py_None ? NULL : args[2];
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject *out = PyList_New(n);
+    if (!out) { Py_DECREF(seq); return NULL; }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        Py_buffer src;
+        if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(seq, i), &src,
+                               PyBUF_SIMPLE) < 0) {
+            Py_DECREF(seq); Py_DECREF(out);
+            return NULL;
+        }
+        int64_t cap = 0;
+        if (hints) {
+            PyObject *h = PySequence_GetItem(hints, i);
+            if (h) { cap = PyLong_AsLongLong(h); Py_DECREF(h); }
+            if (PyErr_Occurred()) PyErr_Clear();
+        }
+        if (codec == 2) {
+            int64_t ul = tk_snappy_uncompressed_length(
+                (const uint8_t *)src.buf, src.len);
+            if (ul >= 0 && ul > cap) cap = ul;
+        }
+        if (cap <= 0) cap = 4 * src.len + (64 << 10);
+        PyObject *b = NULL;
+        int64_t r = -4;
+        for (int attempt = 0; attempt < 8; attempt++) {
+            b = PyBytes_FromStringAndSize(NULL, cap);
+            if (!b) break;
+            uint8_t *dst = (uint8_t *)PyBytes_AS_STRING(b);
+            Py_BEGIN_ALLOW_THREADS
+            r = codec == 3
+                    ? tk_lz4f_decompress((const uint8_t *)src.buf,
+                                         src.len, dst, cap)
+                    : tk_snappy_decompress((const uint8_t *)src.buf,
+                                           src.len, dst, cap);
+            Py_END_ALLOW_THREADS
+            if (r != -4) break;          // -4 = capacity shortfall
+            Py_DECREF(b); b = NULL;
+            cap *= 4;
+        }
+        PyBuffer_Release(&src);
+        if (b && r >= 0 && _PyBytes_Resize(&b, r) == 0) {
+            PyList_SET_ITEM(out, i, b);
+        } else {
+            Py_XDECREF(b);
+            if (PyErr_Occurred()) PyErr_Clear();
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(out, i, Py_None);
+        }
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
 static PyMethodDef module_methods[] = {
     {"build_batch", (PyCFunction)(void (*)(void))mod_build_batch,
      METH_FASTCALL,
@@ -1158,6 +1282,11 @@ static PyMethodDef module_methods[] = {
     {"materialize_v2", (PyCFunction)(void (*)(void))mod_materialize_v2,
      METH_FASTCALL,
      "materialize_v2(...) -> (messages, total_bytes, header_fixups)"},
+    {"crc32c_many", (PyCFunction)(void (*)(void))mod_crc32c_many,
+     METH_FASTCALL, "crc32c_many(buffers) -> list[int] (no join copy)"},
+    {"decompress_many", (PyCFunction)(void (*)(void))mod_decompress_many,
+     METH_FASTCALL,
+     "decompress_many(codec_id, buffers, hints) -> list[bytes|None]"},
     {NULL, NULL, 0, NULL}};
 
 static PyMemberDef lane_members[] = {
