@@ -26,7 +26,7 @@ Also reported (extras in the same JSON line):
                           (gather-bound; see PERF.md for why wire-exact
                           LZ4 cannot win on TPU vector hardware)
   transport_mb_s        - measured host->device bandwidth
-Env knobs: BENCH_MSGS (150000), BENCH_MSG_SIZE (1024), BENCH_TOPPARS (16).
+Env knobs: BENCH_MSGS (500000), BENCH_MSG_SIZE (1024), BENCH_TOPPARS (16).
 """
 import json
 import os
@@ -321,10 +321,11 @@ def codec_offload():
 
 
 def main():
-    # 150k messages ≈ 1s steady-state per trial: short runs understate
-    # the rate by folding the constant linger+flush tail into it
-    # (measured 119k @40k msgs vs 171k @240k, same config)
-    n_msgs = int(os.environ.get("BENCH_MSGS", 150000))
+    # ~1s of steady state per trial: short runs understate the rate by
+    # folding the constant linger+flush tail into it (measured 119k
+    # @40k msgs vs 171k @240k, same config). The round-4 pipeline runs
+    # ~500k msgs/s, so the default trial is 500k messages now.
+    n_msgs = int(os.environ.get("BENCH_MSGS", 500000))
     size = int(os.environ.get("BENCH_MSG_SIZE", 1024))
     toppars = int(os.environ.get("BENCH_TOPPARS", 16))
     # median of 3 per backend, INTERLEAVED cpu/tpu pairs: the shared
