@@ -80,6 +80,28 @@ extern int  tk_delete_topic(tk_handle_t h, const char *topic,
 extern void tk_msg_free(tk_msg_t *m);
 extern int  tk_mock_bootstrap(tk_handle_t h, char *buf, int size);
 extern void tk_destroy(tk_handle_t h);
+
+/* --- introspection & offset queries (reference rdkafka.h:
+ *     rd_kafka_version_str, rd_kafka_err2str,
+ *     rd_kafka_query_watermark_offsets, rd_kafka_offsets_for_times,
+ *     rd_kafka_position, rd_kafka_pause/resume_partitions,
+ *     rd_kafka_purge, rd_kafka_metadata, rd_kafka_conf_dump) --- */
+extern int  tk_version(char *buf, int size);
+extern int  tk_err2str(int err, char *buf, int size);
+extern int  tk_query_watermark_offsets(tk_handle_t h, const char *topic,
+                                       int32_t partition, int64_t *lo,
+                                       int64_t *hi, int timeout_ms);
+extern long long tk_offsets_for_times(tk_handle_t h, const char *topic,
+                                      int32_t partition, int64_t ts_ms,
+                                      int timeout_ms);
+extern long long tk_position(tk_handle_t h, const char *topic,
+                             int32_t partition);
+extern int  tk_pause(tk_handle_t h, const char *topic, int32_t partition);
+extern int  tk_resume(tk_handle_t h, const char *topic, int32_t partition);
+extern int  tk_purge(tk_handle_t h, int in_queue, int in_flight);
+extern int  tk_metadata_json(tk_handle_t h, char *buf, int size,
+                             int timeout_ms);
+extern int  tk_conf_dump_json(tk_handle_t h, char *buf, int size);
 """
 
 CDEF = TYPES + FUNCS
@@ -544,6 +566,172 @@ def tk_destroy(h):
             obj.close()
         except Exception:
             pass
+
+
+def _write_cstr(buf, size, s):
+    b = s.encode() if isinstance(s, str) else bytes(s)
+    if buf == ffi.NULL or size <= 0 or len(b) + 1 > size:
+        return -1
+    out = ffi.buffer(buf, size)
+    out[: len(b)] = b
+    out[len(b)] = b"\0"
+    return len(b)
+
+
+@ffi.def_extern()
+def tk_version(buf, size):
+    # reference: rd_kafka_version_str()
+    import librdkafka_tpu
+    return _write_cstr(buf, size, librdkafka_tpu.__version__)
+
+
+@ffi.def_extern()
+def tk_err2str(err, buf, size):
+    # reference: rd_kafka_err2str / rd_kafka_err2name
+    from librdkafka_tpu.client.errors import Err
+    try:
+        name = Err(err).name
+    except ValueError:
+        name = f"UNKNOWN_ERR_{err}"
+    return _write_cstr(buf, size, name)
+
+
+@ffi.def_extern()
+def tk_query_watermark_offsets(h, topic, partition, lo, hi, timeout_ms):
+    # reference: rd_kafka_query_watermark_offsets (consumer handles)
+    from librdkafka_tpu.client.consumer import TopicPartition
+    c = _handles.get(h)
+    if not isinstance(c, Consumer):
+        return -1
+    try:
+        low, high = c.get_watermark_offsets(
+            TopicPartition(ffi.string(topic).decode(), partition),
+            timeout=timeout_ms / 1000.0)
+        lo[0] = int(low)
+        hi[0] = int(high)
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_offsets_for_times(h, topic, partition, ts_ms, timeout_ms):
+    # reference: rd_kafka_offsets_for_times; returns the offset, -1 =
+    # timestamp past log end (reference semantics), -2 = error
+    from librdkafka_tpu.client.consumer import TopicPartition
+    c = _handles.get(h)
+    if not isinstance(c, Consumer):
+        return -2
+    try:
+        out = c.offsets_for_times(
+            [TopicPartition(ffi.string(topic).decode(), partition,
+                            ts_ms)],
+            timeout=timeout_ms / 1000.0)
+        return int(out[0].offset)
+    except Exception:
+        return -2
+
+
+@ffi.def_extern()
+def tk_position(h, topic, partition):
+    # reference: rd_kafka_position; next offset to consume, -1001 when
+    # the partition is not assigned/positioned
+    from librdkafka_tpu.client.consumer import TopicPartition
+    c = _handles.get(h)
+    if not isinstance(c, Consumer):
+        return -1001
+    try:
+        out = c.position(
+            [TopicPartition(ffi.string(topic).decode(), partition)])
+        return int(out[0].offset)
+    except Exception:
+        return -1001
+
+
+@ffi.def_extern()
+def tk_pause(h, topic, partition):
+    from librdkafka_tpu.client.consumer import TopicPartition
+    c = _handles.get(h)
+    if not isinstance(c, Consumer):
+        return -1
+    try:
+        c.pause([TopicPartition(ffi.string(topic).decode(), partition)])
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_resume(h, topic, partition):
+    from librdkafka_tpu.client.consumer import TopicPartition
+    c = _handles.get(h)
+    if not isinstance(c, Consumer):
+        return -1
+    try:
+        c.resume([TopicPartition(ffi.string(topic).decode(), partition)])
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_purge(h, in_queue, in_flight):
+    # reference: rd_kafka_purge (producer handles)
+    p = _handles.get(h)
+    if not isinstance(p, Producer):
+        return -1
+    try:
+        p.purge(in_queue=bool(in_queue), in_flight=bool(in_flight))
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_metadata_json(h, buf, size, timeout_ms):
+    # reference: rd_kafka_metadata, flattened to JSON for C callers:
+    # {"brokers": {id: "host:port"}, "controller_id": n,
+    #  "topics": {name: {partition: leader}}}
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    rk = obj._rk
+    try:
+        rk.metadata_refresh("tk_metadata")
+        # producer/consumer handles refresh SPARSELY (their known
+        # topics), so waiting for a FULL enumeration would never
+        # resolve — a warm cache (>=1 broker) is the reference's
+        # rd_kafka_metadata(all_topics=0) behavior
+        if not rk.metadata_wait(lambda: rk.metadata["brokers"],
+                                timeout_ms / 1000.0):
+            return -1
+        with rk._metadata_lock:
+            md = rk.metadata
+            snap = {"brokers": {str(i): f"{b[0]}:{b[1]}"
+                                if isinstance(b, (tuple, list)) else str(b)
+                                for i, b in md["brokers"].items()},
+                    "controller_id": md.get("controller_id", -1),
+                    "topics": {t: {str(p): ldr for p, ldr in ps.items()}
+                               for t, ps in md["topics"].items()}}
+        return _write_cstr(buf, size, json.dumps(snap))
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_conf_dump_json(h, buf, size):
+    # reference: rd_kafka_conf_dump — the handle's effective conf
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    try:
+        d = obj._rk.conf.dump()
+        safe = {k: (v if isinstance(v, (str, int, float, bool,
+                                        type(None))) else repr(v))
+                for k, v in d.items()}
+        return _write_cstr(buf, size, json.dumps(safe))
+    except Exception:
+        return -1
 """
 
 HEADER_TEXT = (

@@ -158,6 +158,49 @@ int main(void) {
         }
     }
     if (!reread) { fprintf(stderr, "seek re-read failed\n"); return 1; }
+
+    /* --- 7. introspection & offset queries --------------------------- */
+    char vbuf[64], ebuf[64];
+    if (tk_version(vbuf, sizeof vbuf) <= 0) {
+        fprintf(stderr, "tk_version\n"); return 1;
+    }
+    if (tk_err2str(0, ebuf, sizeof ebuf) <= 0) {
+        fprintf(stderr, "tk_err2str\n"); return 1;
+    }
+    int64_t lo = -1, hi = -1;
+    if (tk_query_watermark_offsets(c2, "ctopic", 0, &lo, &hi, 10000) != 0) {
+        fprintf(stderr, "watermarks failed\n"); return 1;
+    }
+    if (lo != 0 || hi <= 0) {
+        fprintf(stderr, "watermarks lo=%lld hi=%lld\n",
+                (long long)lo, (long long)hi);
+        return 1;
+    }
+    long long earliest = tk_offsets_for_times(c2, "ctopic", 0, 0, 10000);
+    if (earliest != 0) {
+        fprintf(stderr, "offsets_for_times(ts=0) = %lld\n", earliest);
+        return 1;
+    }
+    long long pos = tk_position(c2, "ctopic", 0);
+    if (pos < 1) {   /* consumed offset 0 again after the seek */
+        fprintf(stderr, "position = %lld\n", pos); return 1;
+    }
+    if (tk_pause(c2, "ctopic", 0) != 0 || tk_resume(c2, "ctopic", 0) != 0) {
+        fprintf(stderr, "pause/resume failed\n"); return 1;
+    }
+    char mbuf[8192];
+    if (tk_metadata_json(c2, mbuf, sizeof mbuf, 10000) <= 0
+        || !strstr(mbuf, "ctopic")) {
+        fprintf(stderr, "metadata_json: %s\n", mbuf); return 1;
+    }
+    char cbuf[16384];
+    if (tk_conf_dump_json(c2, cbuf, sizeof cbuf) <= 0
+        || !strstr(cbuf, "group.id")) {
+        fprintf(stderr, "conf_dump_json failed\n"); return 1;
+    }
+    if (tk_purge(p, 1, 0) != 0) {
+        fprintf(stderr, "purge failed\n"); return 1;
+    }
     tk_destroy(c2);
 
     if (tk_delete_topic(p, "ctopic", 10000) != 0) {
@@ -165,6 +208,7 @@ int main(void) {
     }
     tk_destroy(p);
     printf("CAPI-OK produce2+headers+dr=%lld batch=%lld consume+commit+"
-           "resume+seek+admin all pass\n", dr_ok, nb);
+           "resume+seek+admin+watermarks+times+position+pause+metadata+"
+           "confdump+purge v=%s all pass\n", dr_ok, nb, vbuf);
     return 0;
 }
