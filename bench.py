@@ -335,6 +335,19 @@ def main():
     # backend=tpu must be >= cpu e2e: lz4 routes to the native CPU path
     # (tpu.lz4.force off) and the adaptive transport gate keeps CRC on
     # CPU when host<->device bandwidth can't pay for the launch.
+    # consumer FIRST: it runs before anything imports jax, so the
+    # recorded number isn't taxed by the jax/axon runtime's background
+    # threads on this 1-core host (measured 167k in-process-with-jax vs
+    # ~250k without; the producer cpu-vs-tpu comparison below stays
+    # interleaved so that tax hits both sides of ITS comparison)
+    consumer_rate = None
+    try:
+        rates = [consumer_pipeline(n_msgs, size, toppars)
+                 for _ in range(3)]
+        consumer_rate = sorted(rates)[1]
+    except Exception as e:
+        # null in the JSON must be diagnosable, never silent
+        print(f"consumer_pipeline failed: {e!r}", file=sys.stderr)
     cpu_rates, tpu_rates = [], []
     try:
         for _ in range(3):
@@ -347,14 +360,6 @@ def main():
         raise
     host_rate = sorted(cpu_rates)[1]
     tpu_backend_rate = sorted(tpu_rates)[1]
-    consumer_rate = None
-    try:
-        rates = [consumer_pipeline(n_msgs, size, toppars)
-                 for _ in range(3)]
-        consumer_rate = sorted(rates)[1]
-    except Exception as e:
-        # null in the JSON must be diagnosable, never silent
-        print(f"consumer_pipeline failed: {e!r}", file=sys.stderr)
     # BASELINE config 5: 64-toppar idempotent producer (fresh mock with
     # 64 partitions; PID FSM + per-batch sequence numbering in play)
     idem_rate = None

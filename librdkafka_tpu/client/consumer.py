@@ -292,9 +292,23 @@ class Consumer:
 
     def consume(self, num_messages: int = 1, timeout: float = 1.0
                 ) -> list[Message]:
+        """Batch consume (reference: rd_kafka_consume_batch_queue).
+        Drains already-fetched batches without per-message clock reads
+        or op-queue round trips; blocks via poll() only while short."""
+        cgrp = self._rk.cgrp
+        if cgrp is not None:
+            cgrp.poll_tick()
         out = []
-        deadline = time.monotonic() + timeout
+        nxt = self._next_pending
         while len(out) < num_messages:
+            m = nxt()
+            if m is None:
+                break
+            out.append(m)
+        deadline = None
+        while len(out) < num_messages:
+            if deadline is None:
+                deadline = time.monotonic() + timeout
             remain = deadline - time.monotonic()
             if remain <= 0:
                 break
@@ -302,6 +316,11 @@ class Consumer:
             if m is None:
                 break
             out.append(m)
+            while len(out) < num_messages:
+                m = nxt()
+                if m is None:
+                    break
+                out.append(m)
         return out
 
     def _serve_op(self, op: Op) -> Optional[Message]:

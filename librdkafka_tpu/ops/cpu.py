@@ -177,6 +177,8 @@ def snappy_java_decompress(data: bytes) -> bytes:
     Old Java producers emit this framing inside MessageSets; the reference
     detects and unframes it in rdkafka_msgset_reader.c (~:300).
     """
+    if not isinstance(data, bytes):
+        data = bytes(data)             # memoryview from the fetch path
     if not data.startswith(SNAPPY_JAVA_MAGIC):
         return snappy_decompress(data)
     out = io.BytesIO()

@@ -341,6 +341,11 @@ def parse_records_v2(info: BatchInfo, records_bytes: bytes) -> list[Record]:
     Python slices the key/value bytes and decodes headers only for the
     rare records that have them. Falls back to the pure-Python walk if
     the native library is unavailable."""
+    if not isinstance(records_bytes, bytes):
+        # Record.key/value must be owned bytes (this is the
+        # inspection/test path; the consume hot path materializes
+        # Messages straight off views via parse_fetch_messages_v2)
+        records_bytes = bytes(records_bytes)
     try:
         return _parse_records_v2_native(info, records_bytes)
     except _NativeUnavailable:
@@ -441,8 +446,12 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
         raise CrcMismatch(
             f"record_count {n} impossible for {len(records_bytes)} bytes")
     fields = np.empty((n, 8), dtype=np.int64)
+    # records_bytes may be a memoryview into the response frame (the
+    # zero-copy fetch path): hand the walk its address via numpy, which
+    # wraps read-only buffers without copying
+    src = np.frombuffer(records_bytes, dtype=np.uint8)
     got = L.tk_parse_v2(
-        records_bytes, len(records_bytes), n,
+        src.ctypes.data_as(ctypes.c_char_p), len(records_bytes), n,
         fields.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
     if got != n:
         raise CrcMismatch(f"malformed v2 records: parsed {got} of {n}")
@@ -473,6 +482,8 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
     out = []
     append = out.append
     total = 0
+    if not isinstance(records_bytes, bytes):
+        records_bytes = bytes(records_bytes)   # keys/values sliced below
     for ts_d, off_d, ko, kl, vo, vl, ho, nh in fields.tolist():
         off = base_off + off_d
         if off < fo:
@@ -569,12 +580,18 @@ def _parse_records_v2_py(info: BatchInfo,
     return out
 
 
-def iter_batches(data: bytes):
-    """Yield (BatchInfo, records_payload, full_batch_bytes) for each complete
+def iter_batches(data):
+    """Yield (BatchInfo, records_payload, full_batch) for each complete
     batch in a Fetch-response records blob. Brokers may return a partial
-    batch at the tail — it is skipped (reference reader behavior)."""
-    data = bytes(data)
-    sl = Slice(data)
+    batch at the tail — it is skipped (reference reader behavior).
+
+    payload/full come back as memoryviews into ``data`` (no per-batch
+    copy); every downstream consumer — the batched CRC verify, the
+    native decompress, the record walk/materializer — reads them via
+    the buffer protocol.  Callers that need owned bytes wrap with
+    ``bytes(...)``."""
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    sl = Slice(mv)
     while sl.remains() >= proto.V2_HEADER_SIZE:
         start = sl.offset
         try:
@@ -585,8 +602,8 @@ def iter_batches(data: bytes):
         payload_len = batch_total - proto.V2_HEADER_SIZE
         if payload_len < 0 or sl.remains() < payload_len:
             return  # partial batch at tail
-        payload = sl.read(payload_len)
-        yield info, payload, data[start:start + batch_total]
+        payload = sl.view(payload_len)
+        yield info, payload, mv[start:start + batch_total]
 
 
 def verify_crc_v2(info: BatchInfo, full_batch: bytes) -> bool:
@@ -658,7 +675,6 @@ def split_msgset_segments(data) -> list[tuple[str, bytes]]:
     Both formats share the [i64 offset][i32 size] frame prefix with the
     magic byte at offset 16, so one uniform walk discriminates.
     A partial trailing frame is dropped (broker may truncate)."""
-    data = bytes(data)
     segs: list[tuple[str, bytes]] = []
     off, n = 0, len(data)
     start = 0
@@ -671,11 +687,17 @@ def split_msgset_segments(data) -> list[tuple[str, bytes]]:
         if cur is None:
             cur = kind
         elif kind != cur:
-            segs.append((cur, data[start:off]))
+            segs.append((cur, bytes(data[start:off])))
             start, cur = off, kind
         off += 12 + size
     if cur is not None and off > start:
-        segs.append((cur, data[start:off]))
+        if start == 0 and off == n:
+            # single same-format run covering the whole blob (the
+            # common case): hand back the caller's object uncopied —
+            # it may be a memoryview into the response frame
+            segs.append((cur, data))
+        else:
+            segs.append((cur, bytes(data[start:off])))
     return segs
 
 

@@ -110,7 +110,14 @@ class _Bytes:
 
     def read(self, sl) -> Optional[bytes]:
         n = sl.read_i32()
-        return None if n < 0 else sl.read(n)
+        if n < 0:
+            return None
+        if n >= self.SPLICE_MIN:
+            # large payloads (RecordBatch blobs) come out as views into
+            # the response frame — the codec/parse layers consume them
+            # through the buffer protocol without a flat copy
+            return sl.view(n)
+        return sl.read(n)
 
 
 Int8, Int16, Int32, Int64 = _Int8(), _Int16(), _Int32(), _Int64()
