@@ -211,7 +211,8 @@ class Consumer:
         Staleness (seek/revoke version barriers) stays per-message."""
         cur = self._cur
         pending = self._pending
-        deliver = self._deliver
+        assignment = self._assignment
+        auto_store = self._auto_store
         while True:
             if cur is None:
                 if not pending:
@@ -220,17 +221,30 @@ class Consumer:
                 cur = [tp, msgs, ver, 0]
             tp, msgs, ver, i = cur
             n = len(msgs)
+            # _deliver's bookkeeping inlined (it is the per-message
+            # consume budget); semantics identical — staleness
+            # (tp.version, revocation) is re-checked per message
+            # because seek()/unassign() can land mid-batch
+            key = (tp.topic, tp.partition)
             while i < n:
                 m = msgs[i]
                 i += 1
-                out = deliver(tp, m, ver)
-                if out is not None:
-                    if i < n:
-                        cur[3] = i
-                        self._cur = cur
-                    else:
-                        self._cur = None
-                    return out
+                fc = tp.fetchq_cnt - 1
+                tp.fetchq_cnt = fc if fc > 0 else 0
+                fb = tp.fetchq_bytes - m.size
+                tp.fetchq_bytes = fb if fb > 0 else 0
+                if tp.version != ver or key not in assignment:
+                    continue            # stale: accounting released
+                off1 = m.offset + 1
+                tp.app_offset = off1
+                if auto_store:
+                    tp.stored_offset = off1
+                if i < n:
+                    cur[3] = i
+                    self._cur = cur
+                else:
+                    self._cur = None
+                return m
             cur = None
             self._cur = None
 
