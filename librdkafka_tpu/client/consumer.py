@@ -206,9 +206,16 @@ class Consumer:
     def _next_pending(self) -> Optional[Message]:
         """Next deliverable message from the fetched-batch queue.
         Batches stay whole (one deque entry per partition response, the
-        op-per-batch axis); a cursor walks the current batch so the
-        per-message cost is one _deliver call — no per-message tuples.
-        Staleness (seek/revoke version barriers) stays per-message."""
+        op-per-batch axis); a cursor walks the current batch with the
+        per-message delivery bookkeeping inlined below — fetchq
+        accounting, the staleness barrier, offset advance. A message is
+        stale — dropped with its accounting released — when the
+        partition was seeked/paused since the fetch (version barrier)
+        OR revoked from the current assignment; the revocation check
+        applies to group and simple consumers alike, assign()/
+        unassign() maintain _assignment in both modes (reference:
+        rd_kafka_op_version_outdated plus the fetchq disconnect on
+        rd_kafka_toppar_fetch_stop)."""
         cur = self._cur
         pending = self._pending
         assignment = self._assignment
@@ -359,27 +366,6 @@ class Consumer:
         # same handlers rd_kafka_poll would use
         rk._serve_rep_op(op)
         return None
-
-    def _deliver(self, tp: Toppar, msg: Message,
-                 version: int) -> Optional[Message]:
-        """Per-message delivery bookkeeping; None when the message is
-        stale (partition seeked/revoked since the fetch)."""
-        rk = self._rk
-        tp.fetchq_cnt = max(0, tp.fetchq_cnt - 1)
-        tp.fetchq_bytes = max(0, tp.fetchq_bytes - msg.size)
-        # Stale when the partition was seeked/paused since the fetch
-        # (version barrier) OR when it has been revoked from the current
-        # assignment.  The revocation check applies to group and simple
-        # consumers alike — assign()/unassign() maintain _assignment in
-        # both modes (reference: rd_kafka_op_version_outdated plus the
-        # fetchq disconnect on rd_kafka_toppar_fetch_stop).
-        if (tp.version != version
-                or (tp.topic, tp.partition) not in self._assignment):
-            return None     # stale: accounting released above
-        tp.app_offset = msg.offset + 1
-        if self._auto_store:
-            tp.stored_offset = msg.offset + 1
-        return msg
 
     # ------------------------------------------------------------ offsets --
     def stored_offsets(self) -> dict[tuple[str, int], int]:

@@ -5,10 +5,12 @@ The reference's ``rd_kafka_flush`` waits on ``rd_kafka_outq_len``
 just unacked messages.  flush() returning before the DR callback fires
 loses the report to a post-flush close; these tests pin the contract.
 
-``Consumer._deliver`` must drop a message when the partition was
-seeked/paused since the fetch (version barrier) OR revoked from the
-assignment — on group AND simple consumers alike (reference:
-rd_kafka_op_version_outdated + fetchq disconnect on fetch_stop).
+Delivery (``Consumer._next_pending``) must drop a message when the
+partition was seeked/paused since the fetch (version barrier) OR
+revoked from the assignment — on group AND simple consumers alike
+(reference: rd_kafka_op_version_outdated + fetchq disconnect on
+fetch_stop).  The tests seed the fetched-batch queue directly and pull
+through the delivery cursor — the same path poll()/consume() take.
 """
 import time
 
@@ -53,10 +55,12 @@ def test_deliver_version_stale_simple_consumer():
         tp = c._assignment[("st", 0)]
         fresh = Message("st", value=b"v", partition=0)
         fresh.offset = 7
-        assert c._deliver(tp, fresh, tp.version) is fresh
+        c._pending.append((tp, [fresh], tp.version))
+        assert c._next_pending() is fresh
         stale = Message("st", value=b"v", partition=0)
         stale.offset = 8
-        assert c._deliver(tp, stale, tp.version - 1) is None
+        c._pending.append((tp, [stale], tp.version - 1))
+        assert c._next_pending() is None
         # the stale drop must not advance the app offset
         assert tp.app_offset == 8
     finally:
@@ -78,11 +82,13 @@ def test_deliver_revoked_partition_dropped():
             ver = tp.version
             m = Message("rv", value=b"v", partition=0)
             m.offset = 0
-            assert c._deliver(tp, m, ver) is m
+            c._pending.append((tp, [m], ver))
+            assert c._next_pending() is m
             c.unassign()
             late = Message("rv", value=b"v", partition=0)
             late.offset = 1
-            assert c._deliver(tp, late, ver) is None
+            c._pending.append((tp, [late], ver))
+            assert c._next_pending() is None
         finally:
             c.close()
     cluster.stop()
