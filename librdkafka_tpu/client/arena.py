@@ -9,12 +9,15 @@ take()s contiguous runs that the native framer consumes directly —
 ``ArenaBatch`` is that run flowing through the same produce pipeline as
 a ``list[Message]`` batch (codec phase → send → response → retry/DR).
 
-Eligibility (checked in Kafka.produce): no DR consumers (no dr
-callbacks, no "dr" events, no background thread), no interceptors,
-explicit partition, bytes/None key+value, no headers/on_delivery/
-opaque/timestamp.  Anything else falls back to the Message path; a
-toppar that sees a fallback message is permanently demoted (arena
-drained into Messages first — FIFO order is preserved exactly).
+Eligibility (checked in Kafka.produce): no interceptors (on_send must
+fire per message at produce() time), explicit partition, bytes/None
+key+value, no headers/on_delivery/opaque/timestamp.  DR consumers
+(dr_msg_cb/dr_cb/"dr" events/background) do NOT demote: delivery
+reports materialize Message objects from the arena run at DR time
+(dr_msgq → to_messages → materialize_arena), off the produce() path.
+Anything else falls back to the Message path; a toppar that sees a
+fallback message is permanently demoted (arena drained into Messages
+first — FIFO order is preserved exactly).
 """
 from __future__ import annotations
 
@@ -124,12 +127,27 @@ class ArenaBatch:
     def __len__(self) -> int:
         return self.count
 
-    def to_messages(self, topic: str = "") -> list:
-        """Materialize per-record Message objects (rare paths only:
-        legacy MsgVer0/1 brokers)."""
-        import numpy as np
+    def to_messages(self, topic: str = "", partition: int = -1,
+                    base_offset: int = -1, status=None, error=None) -> list:
+        """Materialize per-record Message objects (legacy MsgVer0/1
+        brokers, delivery reports).  Bulk native creation when the
+        extension is loaded (materialize_arena: tp_alloc + direct slot
+        stores — the DR path for fast-lane batches); ``status``/
+        ``error``/``base_offset`` stamp every record."""
+        from .msg import Message, MsgStatus
 
-        from .msg import Message
+        m_ = _mod()
+        mat = getattr(m_, "materialize_arena", None) if m_ else None
+        if mat is not None:
+            out = mat(Message, self.base, self.klens, self.vlens,
+                      self.count, topic, partition, base_offset,
+                      self.msgid_base, self.enq_first, self.retries,
+                      status if status is not None
+                      else MsgStatus.NOT_PERSISTED,
+                      error)
+            if out is not None:
+                return out
+        import numpy as np
 
         kl = np.frombuffer(self.klens, np.int32)
         vl = np.frombuffer(self.vlens, np.int32)
@@ -143,10 +161,16 @@ class ArenaBatch:
             if vl[i] >= 0:
                 v = self.base[off:off + vl[i]]
                 off += int(vl[i])
-            m = Message(topic, value=v, key=k)
+            m = Message(topic, value=v, key=k, partition=partition)
             m.msgid = self.msgid_base + i
             m.enq_time = self.enq_first
             m.retries = self.retries
+            if base_offset >= 0:
+                m.offset = base_offset + i
+            if status is not None:
+                m.status = status
+            if error is not None:
+                m.error = error
             out.append(m)
         return out
 

@@ -1043,7 +1043,7 @@ class Broker:
                 tp.release_inflight(msgs)
                 rk.dr_msgq(msgs, KafkaError(Err._PURGE_INFLIGHT,
                                             "purged in flight",
-                                            retriable=False))
+                                            retriable=False), tp=tp)
             elif exc is not None:
                 self._release_unsent(tp, msgs, exc)
             elif self.state != BrokerState.UP or self.terminate:
@@ -1058,7 +1058,8 @@ class Broker:
         tp.release_inflight(msgs)
         self.rk.log("ERROR", f"{self.name}: batch codec failed: {exc!r}")
         self.rk.dr_msgq(msgs, KafkaError(Err._FAIL,
-                                         f"batch codec failed: {exc!r}"))
+                                         f"batch codec failed: {exc!r}"),
+                        tp=tp)
 
     def _make_writer(self, tp, msgs, codec: str):
         rk = self.rk
@@ -1148,7 +1149,7 @@ class Broker:
             if not isinstance(msgs, ArenaBatch):
                 for m in msgs:
                     m.offset = -1
-            rk.dr_msgq(msgs, None)
+            rk.dr_msgq(msgs, None, tp=tp)
 
     def _handle_produce(self, tp, msgs: list[Message], err, resp):
         """Produce response → DR / retry / idempotence reconciliation
@@ -1193,7 +1194,7 @@ class Broker:
                     for i, m in enumerate(msgs):
                         m.offset = base + i if base >= 0 else -1
                         m.status = MsgStatus.PERSISTED
-                rk.dr_msgq(msgs, None)
+                rk.dr_msgq(msgs, None, tp=tp, base_offset=base)
                 return
             kerr = KafkaError(ec)
         else:
@@ -1205,7 +1206,7 @@ class Broker:
             if not fast:
                 for m in msgs:
                     m.status = MsgStatus.PERSISTED
-            rk.dr_msgq(msgs, None)
+            rk.dr_msgq(msgs, None, tp=tp)
             return
         if rk.idemp and kerr.code == Err.OUT_OF_ORDER_SEQUENCE_NUMBER:
             # If an EARLIER batch of this partition failed retriably, the
@@ -1234,7 +1235,7 @@ class Broker:
                 f"rejected with OUT_OF_ORDER_SEQUENCE_NUMBER "
                 f"(possibly persisted; resend would bypass broker dedup)")
             rk.set_fatal_error(fatal)
-            rk.dr_msgq(msgs, fatal)
+            rk.dr_msgq(msgs, fatal, tp=tp)
             return
         retriable = kerr.retriable
         max_retries = rk.conf.get("message.send.max.retries")
@@ -1260,7 +1261,8 @@ class Broker:
                     tp.retry_backoff_until = time.monotonic() + \
                         rk.conf.get("retry.backoff.ms") / 1000.0
                 else:
-                    rk.dr_msgq(msgs, self._gapless_fatal(tp, kerr) or kerr)
+                    rk.dr_msgq(msgs, self._gapless_fatal(tp, kerr) or kerr,
+                               tp=tp)
                 return
             retry = [m for m in msgs if m.retries < max_retries]
             fail = [m for m in msgs if m.retries >= max_retries]
@@ -1272,9 +1274,10 @@ class Broker:
                 tp.retry_backoff_until = time.monotonic() + \
                     rk.conf.get("retry.backoff.ms") / 1000.0
             if fail:
-                rk.dr_msgq(fail, self._gapless_fatal(tp, kerr) or kerr)
+                rk.dr_msgq(fail, self._gapless_fatal(tp, kerr) or kerr,
+                           tp=tp)
         else:
-            rk.dr_msgq(msgs, self._gapless_fatal(tp, kerr) or kerr)
+            rk.dr_msgq(msgs, self._gapless_fatal(tp, kerr) or kerr, tp=tp)
 
     # =================================================== CONSUMER SERVE ===
     def _consumer_serve(self, now: float):

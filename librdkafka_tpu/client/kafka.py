@@ -633,6 +633,7 @@ class Kafka:
             self._lane.map_del(tp.topic, tp.partition)
             failed: list[Message] = []
             fast_cnt = fast_bytes = 0
+            dr_wanted = self._dr_out_wanted()
             with tp.lock:
                 failed.extend(tp.msgq)
                 tp.msgq.clear()
@@ -640,16 +641,23 @@ class Kafka:
                 failed.extend(tp.xmit_msgq)
                 tp.xmit_msgq.clear()
                 for b in tp.retry_batches:
-                    if isinstance(b, ArenaBatch):
+                    if not isinstance(b, ArenaBatch):
+                        failed.extend(b)
+                    elif dr_wanted:   # dr_msgq accounts materialized msgs
+                        failed.extend(b.to_messages(tp.topic, tp.partition))
+                    else:
                         fast_cnt += b.count
                         fast_bytes += b.nbytes
-                    else:
-                        failed.extend(b)
                 tp.retry_batches.clear()
                 if tp.arena is not None:
-                    c, nb = tp.arena.clear()
-                    fast_cnt += c
-                    fast_bytes += nb
+                    if dr_wanted:
+                        for k, v in tp.arena.drain_records():
+                            failed.append(Message(tp.topic, value=v, key=k,
+                                                  partition=tp.partition))
+                    else:
+                        c, nb = tp.arena.clear()
+                        fast_cnt += c
+                        fast_bytes += nb
             if fast_cnt:
                 self._lane.acct(-fast_cnt, -fast_bytes)
             if failed:
@@ -785,12 +793,14 @@ class Kafka:
 
     def _recompute_fast_lane(self) -> None:
         conf = self.conf
-        self._fast_lane = (
-            self.is_producer
-            and not self.interceptors
-            and not conf.get("dr_msg_cb") and not conf.get("dr_cb")
-            and "dr" not in conf.get("enabled_events")
-            and conf.get("background_event_cb") is None)
+        # DR consumers (dr_msg_cb / dr_cb / "dr" events / background)
+        # no longer disable the lane: delivery reports materialize
+        # Message objects from the arena run at DR time (dr_msgq), so
+        # produce() stays on the zero-alloc path — the reference's
+        # headline throughput runs WITH dr_msg_cb set. Interceptors
+        # still force the Message path: on_send must fire per message
+        # at produce() time.
+        self._fast_lane = (self.is_producer and not self.interceptors)
         self._fast_lane_ver = getattr(conf, "version", 0)
         # the C entry consults this flag before touching an arena; a
         # conf.set that adds a DR consumer flips it via the listener
@@ -878,17 +888,40 @@ class Kafka:
             b.ops.push(Op(OpType.BROKER_WAKEUP))
 
     # ------------------------------------------------------------ DR path --
-    def dr_msgq(self, msgs, err: Optional[KafkaError]):
+    def _dr_out_wanted(self) -> bool:
+        """Is anyone consuming delivery reports? (dr callback, "dr"
+        events, or the background event thread)"""
+        conf = self.conf
+        return bool(conf.get("dr_msg_cb") or conf.get("dr_cb")
+                    or "dr" in conf.get("enabled_events")
+                    or self.background is not None)
+
+    def dr_msgq(self, msgs, err: Optional[KafkaError],
+                tp=None, base_offset: int = -1):
         """Queue delivery reports (reference: rd_kafka_dr_msgq,
         rdkafka_broker.c:2432).  Accepts list[Message] or a fast-lane
-        ArenaBatch — the lane is only engaged when there are no DR
-        consumers, so an ArenaBatch resolves to pure queue accounting."""
+        ArenaBatch.  With no DR consumer an ArenaBatch resolves to pure
+        queue accounting; with one, its records materialize into
+        Message objects HERE — at delivery-report time, off the
+        produce() path — carrying ``tp``'s topic/partition and offsets
+        from ``base_offset`` (successful batches)."""
         if isinstance(msgs, ArenaBatch):
-            with self._msg_cnt_lock:
-                self._lane.acct(-msgs.count, -msgs.nbytes)
-                if self.flushing:
-                    self._outq_cond.notify_all()
-            return
+            if self._dr_out_wanted():
+                st = (MsgStatus.PERSISTED if err is None
+                      else MsgStatus.POSSIBLY_PERSISTED
+                      if msgs.possibly_persisted
+                      else MsgStatus.NOT_PERSISTED)
+                msgs = msgs.to_messages(   # falls through to list path
+                    tp.topic if tp is not None else "",
+                    tp.partition if tp is not None else -1,
+                    base_offset=base_offset if err is None else -1,
+                    status=st)
+            else:
+                with self._msg_cnt_lock:
+                    self._lane.acct(-msgs.count, -msgs.nbytes)
+                    if self.flushing:
+                        self._outq_cond.notify_all()
+                return
         if err is not None:
             for m in msgs:
                 m.error = err
@@ -1041,6 +1074,7 @@ class Kafka:
         late broker response is dropped by the corrid filter)."""
         purged = []
         fast_cnt = fast_bytes = 0
+        dr_wanted = self._dr_out_wanted()
         with self._toppars_lock:
             tps = list(self._toppars.values())
         for tp in tps:
@@ -1052,16 +1086,25 @@ class Kafka:
                     purged.extend(tp.xmit_msgq)
                     tp.xmit_msgq.clear()
                     for batch in tp.retry_batches:
-                        if isinstance(batch, ArenaBatch):
+                        if not isinstance(batch, ArenaBatch):
+                            purged.extend(batch)
+                        elif dr_wanted:  # dr_msgq accounts these
+                            purged.extend(
+                                batch.to_messages(tp.topic, tp.partition))
+                        else:
                             fast_cnt += batch.count
                             fast_bytes += batch.nbytes
-                        else:
-                            purged.extend(batch)
                     tp.retry_batches.clear()
                     if tp.arena is not None:
-                        c, nb = tp.arena.clear()
-                        fast_cnt += c
-                        fast_bytes += nb
+                        if dr_wanted:
+                            for k, v in tp.arena.drain_records():
+                                purged.append(
+                                    Message(tp.topic, value=v, key=k,
+                                            partition=tp.partition))
+                        else:
+                            c, nb = tp.arena.clear()
+                            fast_cnt += c
+                            fast_bytes += nb
         with self._topics_lock:
             for t in self.topics.values():
                 with t.lock:
@@ -1122,12 +1165,21 @@ class Kafka:
             expired = []
             fast_cnt = fast_bytes = 0
             fast_pp = False
+            dr_wanted = self._dr_out_wanted()
             with tp.lock:
                 if tp.arena is not None and len(tp.arena):
                     # fast-lane records carry a native monotonic µs stamp
-                    c, nb = tp.arena.expire(int((now - tmo) * 1e6))
-                    fast_cnt += c
-                    fast_bytes += nb
+                    cutoff = int((now - tmo) * 1e6)
+                    if dr_wanted:
+                        # materialize for error DRs (dr_msgq accounts)
+                        for k, v in tp.arena.expire_records(cutoff):
+                            expired.append(
+                                Message(tp.topic, value=v, key=k,
+                                        partition=tp.partition))
+                    else:
+                        c, nb = tp.arena.expire(cutoff)
+                        fast_cnt += c
+                        fast_bytes += nb
                 for q in (tp.msgq, tp.xmit_msgq):
                     while q and now - q[0].enq_time > tmo:
                         expired.append(q.popleft())
@@ -1141,12 +1193,18 @@ class Kafka:
                     if now - head_enq <= tmo:
                         break
                     tp.retry_batches.popleft()
-                    if isinstance(b, ArenaBatch):
+                    if not isinstance(b, ArenaBatch):
+                        expired.extend(b)
+                    elif dr_wanted:
+                        lst = b.to_messages(tp.topic, tp.partition)
+                        if b.possibly_persisted:
+                            for m in lst:
+                                m.status = MsgStatus.POSSIBLY_PERSISTED
+                        expired.extend(lst)
+                    else:
                         fast_cnt += b.count
                         fast_bytes += b.nbytes
                         fast_pp = fast_pp or b.possibly_persisted
-                    else:
-                        expired.extend(b)
             if fast_cnt:
                 any_expired = True
                 any_possibly_persisted = any_possibly_persisted or fast_pp

@@ -218,6 +218,45 @@ static PyObject *arena_expire(Arena *a, PyObject *arg) {
     return Py_BuildValue("(iL)", (int)n, (long long)nb);
 }
 
+// expire_records(cutoff_us) -> [(key|None, value|None), ...]: drop the
+// prefix enqueued at or before cutoff_us, MATERIALIZED — the
+// message.timeout.ms scan uses this instead of expire() when a
+// delivery-report consumer needs the records for error DRs
+static PyObject *arena_expire_records(Arena *a, PyObject *arg) {
+    int64_t cutoff = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return NULL;
+    int32_t n = 0;
+    while (a->start + n < a->count && a->enq[a->start + n] <= cutoff)
+        n++;
+    PyObject *list = PyList_New(n);
+    if (!list) return NULL;
+    for (int32_t i = 0; i < n; i++) {
+        int32_t r = a->start + i;
+        int64_t off = a->boff[r];
+        int32_t kl = a->klens[r], vl = a->vlens[r];
+        PyObject *k, *v;
+        if (kl < 0) { k = Py_None; Py_INCREF(k); }
+        else {
+            k = PyBytes_FromStringAndSize((const char *)(a->buf + off), kl);
+            off += kl;
+        }
+        if (vl < 0) { v = Py_None; Py_INCREF(v); }
+        else
+            v = PyBytes_FromStringAndSize((const char *)(a->buf + off), vl);
+        if (!k || !v) {
+            Py_XDECREF(k); Py_XDECREF(v); Py_DECREF(list);
+            return NULL;
+        }
+        PyObject *t = PyTuple_Pack(2, k, v);
+        Py_DECREF(k); Py_DECREF(v);
+        if (!t) { Py_DECREF(list); return NULL; }
+        PyList_SET_ITEM(list, i, t);
+    }
+    a->start += n;
+    if (a->start == a->count) arena_reset(a);
+    return list;
+}
+
 // clear() -> (count, nbytes): drop everything (purge)
 static PyObject *arena_clear(Arena *a, PyObject *Py_UNUSED(ignored)) {
     int32_t n = a->count - a->start;
@@ -1274,11 +1313,140 @@ static PyObject *mod_decompress_many(PyObject *Py_UNUSED(self),
     return out;
 }
 
+// materialize_arena(msg_type, base, klens, vlens, count, topic,
+//                   partition, base_offset, msgid_base, enq_time,
+//                   retries, status, error) -> list[Message]
+// Bulk Message creation from the ARENA layout (concatenated key||value
+// + int32 len arrays) — the delivery-report path's ArenaBatch
+// materialization (kafka.dr_msgq), same slot-store scheme as
+// materialize_v2.  base_offset < 0 stores offset -1 per message.
+static PyObject *mod_materialize_arena(PyObject *Py_UNUSED(self),
+                                       PyObject *const *args,
+                                       Py_ssize_t nargs) {
+    if (nargs != 13) {
+        PyErr_SetString(PyExc_TypeError, "materialize_arena: 13 args");
+        return NULL;
+    }
+    PyTypeObject *type = (PyTypeObject *)args[0];
+    if (!PyType_Check(args[0])) {
+        PyErr_SetString(PyExc_TypeError, "arg 0 must be the Message type");
+        return NULL;
+    }
+    if (type != msg_type_cached && resolve_msg_slots(type) < 0)
+        return NULL;
+    Py_buffer base, kb, vb;
+    if (PyObject_GetBuffer(args[1], &base, PyBUF_SIMPLE) < 0) return NULL;
+    if (PyObject_GetBuffer(args[2], &kb, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&base); return NULL;
+    }
+    if (PyObject_GetBuffer(args[3], &vb, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&base); PyBuffer_Release(&kb); return NULL;
+    }
+    int64_t count = PyLong_AsLongLong(args[4]);
+    PyObject *topic = args[5];
+    int64_t partition = PyLong_AsLongLong(args[6]);
+    int64_t base_off = PyLong_AsLongLong(args[7]);
+    int64_t msgid_base = PyLong_AsLongLong(args[8]);
+    PyObject *enq_time = args[9];       // float (shared)
+    PyObject *retries = args[10];       // int (shared)
+    PyObject *status = args[11];
+    PyObject *error = args[12];         // KafkaError | None (shared)
+    PyObject *list = NULL, *part_obj = NULL, *ts_obj = NULL;
+    PyObject *fzero = NULL, *zero = NULL;
+    const int32_t *kl = (const int32_t *)kb.buf;
+    const int32_t *vl = (const int32_t *)vb.buf;
+    const char *src = (const char *)base.buf;
+    int64_t remain = base.len;
+    if (PyErr_Occurred()) goto done;
+    if (count < 0 || (int64_t)kb.len < count * 4
+        || (int64_t)vb.len < count * 4) {
+        PyErr_SetString(PyExc_ValueError, "materialize_arena: bad args");
+        goto done;
+    }
+    list = PyList_New(0);
+    part_obj = PyLong_FromLongLong(partition);
+    {
+        // fast-lane records carry no per-record wall clock; DR messages
+        // report the materialization time (Message.__init__ behavior)
+        struct timespec ts;
+        clock_gettime(CLOCK_REALTIME, &ts);
+        ts_obj = PyLong_FromLongLong((int64_t)ts.tv_sec * 1000
+                                     + ts.tv_nsec / 1000000);
+    }
+    fzero = PyFloat_FromDouble(0.0);
+    zero = PyLong_FromLong(0);
+    if (!list || !part_obj || !ts_obj || !fzero || !zero) goto fail;
+    for (int64_t i = 0; i < count; i++) {
+        int64_t k_len = kl[i], v_len = vl[i];
+        int64_t need = (k_len > 0 ? k_len : 0) + (v_len > 0 ? v_len : 0);
+        if (need > remain) {
+            PyErr_SetString(PyExc_ValueError,
+                            "materialize_arena: short base buffer");
+            goto fail;
+        }
+        PyObject *m = type->tp_alloc(type, 0);
+        if (!m) goto fail;
+        PyObject *key, *value, *headers, *off_o, *msgid_o, *size_o;
+        if (k_len >= 0) {
+            key = PyBytes_FromStringAndSize(src, k_len);
+            src += k_len; remain -= k_len;
+        } else { key = Py_None; Py_INCREF(key); }
+        if (v_len >= 0) {
+            value = PyBytes_FromStringAndSize(src, v_len);
+            src += v_len; remain -= v_len;
+        } else { value = Py_None; Py_INCREF(value); }
+        headers = PyList_New(0);
+        off_o = PyLong_FromLongLong(base_off >= 0 ? base_off + i : -1);
+        msgid_o = PyLong_FromLongLong(msgid_base + i);
+        size_o = PyLong_FromLongLong((k_len > 0 ? k_len : 0)
+                                     + (v_len > 0 ? v_len : 0));
+        if (!key || !value || !headers || !off_o || !msgid_o || !size_o) {
+            Py_XDECREF(key); Py_XDECREF(value); Py_XDECREF(headers);
+            Py_XDECREF(off_o); Py_XDECREF(msgid_o); Py_XDECREF(size_o);
+            Py_DECREF(m);
+            goto fail;
+        }
+        Py_INCREF(topic);  slot_set(m, S_TOPIC, topic);
+        Py_INCREF(part_obj); slot_set(m, S_PARTITION, part_obj);
+        slot_set(m, S_KEY, key);
+        slot_set(m, S_VALUE, value);
+        slot_set(m, S_HEADERS, headers);
+        slot_set(m, S_OFFSET, off_o);
+        Py_INCREF(ts_obj); slot_set(m, S_TIMESTAMP, ts_obj);
+        Py_INCREF(zero); slot_set(m, S_TSTYPE, zero);
+        Py_INCREF(error); slot_set(m, S_ERROR, error);
+        Py_INCREF(Py_None); slot_set(m, S_OPAQUE, Py_None);
+        slot_set(m, S_MSGID, msgid_o);
+        Py_INCREF(retries); slot_set(m, S_RETRIES, retries);
+        Py_INCREF(status); slot_set(m, S_STATUS, status);
+        Py_INCREF(enq_time); slot_set(m, S_ENQ, enq_time);
+        Py_INCREF(fzero); slot_set(m, S_BACKOFF, fzero);
+        Py_INCREF(zero); slot_set(m, S_LATENCY, zero);
+        Py_INCREF(Py_None); slot_set(m, S_ONDEL, Py_None);
+        slot_set(m, S_SIZE, size_o);
+        if (PyList_Append(list, m) < 0) { Py_DECREF(m); goto fail; }
+        Py_DECREF(m);
+    }
+    goto done;
+fail:
+    Py_CLEAR(list);
+done:
+    Py_XDECREF(part_obj); Py_XDECREF(ts_obj);
+    Py_XDECREF(fzero); Py_XDECREF(zero);
+    PyBuffer_Release(&base);
+    PyBuffer_Release(&kb);
+    PyBuffer_Release(&vb);
+    return list;
+}
+
 static PyMethodDef module_methods[] = {
     {"build_batch", (PyCFunction)(void (*)(void))mod_build_batch,
      METH_FASTCALL,
      "build_batch(base, klens, vlens, count, now_ms, pid, epoch, "
      "base_seq, codec_id) -> wire RecordBatch bytes"},
+    {"materialize_arena",
+     (PyCFunction)(void (*)(void))mod_materialize_arena, METH_FASTCALL,
+     "materialize_arena(...) -> list[Message] (arena layout)"},
     {"materialize_v2", (PyCFunction)(void (*)(void))mod_materialize_v2,
      METH_FASTCALL,
      "materialize_v2(...) -> (messages, total_bytes, header_fixups)"},
@@ -1343,6 +1511,8 @@ static PyMethodDef arena_methods[] = {
      "take(max_count, max_bytes) -> run tuple or None"},
     {"expire", (PyCFunction)arena_expire, METH_O,
      "expire(cutoff_us) -> (count, nbytes) dropped"},
+    {"expire_records", (PyCFunction)arena_expire_records, METH_O,
+     "expire_records(cutoff_us) -> [(key, value), ...] dropped"},
     {"clear", (PyCFunction)arena_clear, METH_NOARGS,
      "clear() -> (count, nbytes) dropped"},
     {"drain_records", (PyCFunction)arena_drain_records, METH_NOARGS,
