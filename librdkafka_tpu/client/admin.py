@@ -182,14 +182,20 @@ class _AdminWorker:
             if cid < 0:
                 return None
             b = self.rk.brokers.get(cid)
-            return b if b is not None and b.is_up() else None
-        if self.target == "coordinator":
+        elif self.target == "coordinator":
             b = self._coord_broker
-            return b if b is not None and b.is_up() else None
-        if self.target.startswith("broker:"):
+        elif self.target.startswith("broker:"):
             b = self.rk.brokers.get(int(self.target[7:]))
-            return b if b is not None and b.is_up() else None
-        return None
+        else:
+            return None
+        if b is None:
+            return None
+        if not b.is_up():
+            # sparse connections: this broker may be idle-unconnected;
+            # demand a connection and keep polling
+            b.schedule_connect()
+            return None
+        return b
 
     _coord_broker = None
 

@@ -100,7 +100,7 @@ def _fused_builder():
     return getattr(m, "build_batch", None) if m else None
 
 
-def _run_codec_phase(rk, ready: list, codec: str) -> list:
+def _run_codec_phase(rk, ready: list) -> list:
     """Compress + assemble + CRC a batch set. Pure compute — safe on the
     codec worker thread. Returns [(tp, msgs, wire|None, exc|None)] in
     ``ready`` order (same-tp batches must stay FIFO).
@@ -126,32 +126,32 @@ def _run_codec_phase(rk, ready: list, codec: str) -> list:
         else:
             writer_items.append((i, item))
     if writer_items:
-        sub = _run_codec_phase_writers(rk, [t for _, t in writer_items],
-                                       codec)
+        sub = _run_codec_phase_writers(rk, [t for _, t in writer_items])
         for (i, _), r in zip(writer_items, sub):
             by_idx[i] = r
     return [by_idx[i] for i in range(len(ready))]
 
 
-def _run_codec_phase_writers(rk, ready: list, codec: str) -> list:
+def _run_codec_phase_writers(rk, ready: list) -> list:
     provider = rk.codec_provider
     results = []
     try:
-        if codec != "none" and ready:
-            # compression.level is topic-scoped: group the fan-in by
-            # level so one serve pass honors every topic's setting
-            blobs = [None] * len(ready)
-            by_level: dict = {}
-            for i, (tp, _msgs, w) in enumerate(ready):
-                lvl = rk.topic_conf_for(tp.topic).get("compression.level")
-                by_level.setdefault(lvl, []).append(i)
-            for lvl, idxs in by_level.items():
-                out = provider.compress_many(
-                    codec, [ready[i][2].records_bytes for i in idxs], lvl)
-                for i, blob in zip(idxs, out):
-                    blobs[i] = blob
-        else:
-            blobs = [None] * len(ready)
+        blobs = [None] * len(ready)
+        # compression.codec and compression.level are topic-scoped:
+        # group the fan-in by (codec, level) so one serve pass honors
+        # every topic's settings (each writer carries its own codec,
+        # resolved at batch formation via Broker._codec_for)
+        by_key: dict = {}
+        for i, (tp, _msgs, w) in enumerate(ready):
+            if w.codec is None:
+                continue
+            lvl = rk.topic_conf_for(tp.topic).get("compression.level")
+            by_key.setdefault((w.codec, lvl), []).append(i)
+        for (cdc, lvl), idxs in by_key.items():
+            out = provider.compress_many(
+                cdc, [ready[i][2].records_bytes for i in idxs], lvl)
+            for i, blob in zip(idxs, out):
+                blobs[i] = blob
     except Exception as e:
         return [(tp, msgs, None, e) for tp, msgs, _w in ready]
 
@@ -192,9 +192,9 @@ class CodecWorker(threading.Thread):
         self.jobs = _q.Queue()
         self.start()
 
-    def submit(self, broker: "Broker", ready: list, codec: str,
+    def submit(self, broker: "Broker", ready: list,
                ts_codec: float, purge_epoch: int) -> None:
-        self.jobs.put((broker, ready, codec, ts_codec, purge_epoch))
+        self.jobs.put((broker, ready, ts_codec, purge_epoch))
 
     def stop(self) -> None:
         self.jobs.put(None)
@@ -213,9 +213,9 @@ class CodecWorker(threading.Thread):
             job = self.jobs.get()
             if job is None:
                 return
-            broker, ready, codec, ts_codec, pepoch = job
+            broker, ready, ts_codec, pepoch = job
             try:
-                results = _run_codec_phase(self.rk, ready, codec)
+                results = _run_codec_phase(self.rk, ready)
             except Exception as e:      # belt & braces: fail every batch
                 results = [(tp, msgs, None, e) for tp, msgs, _w in ready]
             broker.ops.push(Op(OpType.BROKER_WAKEUP,
@@ -266,6 +266,7 @@ class Broker:
         self._fallback_until = 0.0        # api.version.fallback.ms window
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
         self._next_connect = 0.0
+        self._connect_wanted = False    # sparse-connections override
         self.terminate = False
         self.fetch_inflight_cnt = 0     # outstanding FetchRequests
         self._tls_handshaking = False
@@ -321,6 +322,23 @@ class Broker:
     def is_up(self) -> bool:
         return self.state == BrokerState.UP
 
+    def _has_work(self) -> bool:
+        """Anything that needs a live connection (sparse-connections
+        gate): led/fetched toppars, queued or in-flight requests, or an
+        explicit connection request from a component that needs this
+        specific broker up (admin controller/coordinator targeting)."""
+        return bool(self.toppars or self.outq or self.waitresp
+                    or self.retryq or self._connect_wanted)
+
+    def schedule_connect(self) -> None:
+        """On-demand connection under sparse connections (reference:
+        rd_kafka_broker_schedule_connection, rdkafka_broker.c:880):
+        called by waiters that need THIS broker UP before they can
+        enqueue a request (admin worker, cgrp coordinator)."""
+        if not self._connect_wanted:
+            self._connect_wanted = True
+            self._wakeup()
+
     # --------------------------------------------------------- the thread --
     def _thread_main(self):
         if self.rk.interceptors:
@@ -339,6 +357,16 @@ class Broker:
     def _serve(self):
         now = time.monotonic()
         if self.state in (BrokerState.INIT, BrokerState.DOWN):
+            # sparse connections (reference enable.sparse.connections,
+            # hidden, default true; rdkafka_broker.c:880): a metadata-
+            # discovered broker with nothing to do stays unconnected.
+            # Bootstrap brokers (nodeid < 0) always connect — they are
+            # the metadata path.
+            if (self.nodeid >= 0 and not self._has_work()
+                    and self.rk.conf.get("enable.sparse.connections")):
+                self._serve_ops(0.05)
+                if not self._has_work():
+                    return
             if now >= self._next_connect:
                 self._try_connect()
             else:
@@ -406,6 +434,9 @@ class Broker:
 
     # ------------------------------------------------------ connect logic --
     def _try_connect(self):
+        # one-shot demand satisfied by this attempt; a still-waiting
+        # component re-schedules on its next resolve pass
+        self._connect_wanted = False
         self._set_state(BrokerState.TRY_CONNECT)
         self.c_connects += 1
         try:
@@ -892,7 +923,7 @@ class Broker:
                         tp.inflight += 1
                     ready.append((tp, msgs,
                                   None if legacy else
-                                  self._make_writer(tp, msgs, codec)))
+                                  self._make_writer(tp, msgs, self._codec_for(tp, codec))))
             if tp.retry_batches or tp.inflight >= max_inflight:
                 continue
             # ---- native enqueue fast lane: form an ArenaBatch ----------
@@ -932,7 +963,7 @@ class Broker:
                         tp.inflight += 1
                     ready.append((tp, b,
                                   None if legacy else
-                                  self._make_writer(tp, b, codec)))
+                                  self._make_writer(tp, b, self._codec_for(tp, codec))))
                     continue
             if not tp.xmit_msgq or now < tp.retry_backoff_until:
                 continue
@@ -971,7 +1002,7 @@ class Broker:
                 continue
             ready.append((tp, msgs,
                           None if legacy else
-                          self._make_writer(tp, msgs, codec)))
+                          self._make_writer(tp, msgs, self._codec_for(tp, codec))))
 
         if not ready:
             return
@@ -1017,10 +1048,9 @@ class Broker:
         worker = rk.codec_worker
         if worker is not None:
             self._codec_outstanding += 1
-            worker.submit(self, ready, codec, ts_codec,
-                          rk._purge_epoch)
+            worker.submit(self, ready, ts_codec, rk._purge_epoch)
             return
-        self._codec_results(_run_codec_phase(rk, ready, codec), ts_codec,
+        self._codec_results(_run_codec_phase(rk, ready), ts_codec,
                             rk._purge_epoch)
 
     def _codec_results(self, results: list, ts_codec: float,
@@ -1060,6 +1090,16 @@ class Broker:
         self.rk.dr_msgq(msgs, KafkaError(Err._FAIL,
                                          f"batch codec failed: {exc!r}"),
                         tp=tp)
+
+    def _codec_for(self, tp, global_codec: str) -> str:
+        """Topic-scope compression.codec override; 'inherit' falls
+        through to the global row (reference rdkafka_conf.c:1360)."""
+        t = self.rk.topics.get(tp.topic)
+        if t is not None:
+            tc = t.conf.get("compression.codec")
+            if tc != "inherit":
+                return tc
+        return global_codec
 
     def _make_writer(self, tp, msgs, codec: str):
         rk = self.rk
@@ -1107,7 +1147,8 @@ class Broker:
                 msgs = msgs.to_messages(tp.topic)
             try:
                 compress_fn = None
-                use_codec = None if codec == "none" else codec
+                codec_tp = self._codec_for(tp, codec)
+                use_codec = None if codec_tp == "none" else codec_tp
                 if use_codec:
                     lvl = rk.topic_conf_for(tp.topic).get("compression.level")
                     compress_fn = (lambda raw, c=use_codec, l=lvl:
@@ -1181,6 +1222,13 @@ class Broker:
 
     def _handle_produce0(self, tp, msgs: list[Message], err, resp):
         rk = self.rk
+        ut = rk.conf.get("ut_handle_ProduceResponse")
+        if ut is not None:
+            # hidden unit-test hook (reference ut_handle_ProduceResponse,
+            # rdkafka_conf.c:849): may override the response outcome
+            override = ut(self.nodeid, batch_head_msgid(msgs), err)
+            if override is not None:
+                err = override
         fast = isinstance(msgs, ArenaBatch)
         if err is None:
             pres = resp["topics"][0]["partitions"][0]

@@ -204,6 +204,9 @@ class ConsumerGroup:
         with self.rk._brokers_lock:
             b = self.rk.brokers.get(self.coord_id)
         if b is None or not b.is_up():
+            if b is not None:
+                # sparse connections: demand the coordinator connect
+                b.schedule_connect()
             self.state = "init"
             return None
         return b
@@ -425,6 +428,16 @@ class ConsumerGroup:
         # file-backed keys after the first attempt so they are not
         # re-committed per retry.
         if store is not None:
+            # offset.store.method=none: offsets for these topics are not
+            # stored anywhere (reference RD_KAFKA_OFFSET_METHOD_NONE)
+            none_keys = [k for k in offsets if store.method(k[0]) == "none"]
+            if none_keys:
+                offsets = {k: v for k, v in offsets.items()
+                           if k not in none_keys}
+                if not offsets:
+                    if cb:
+                        cb(None, {"topics": []})
+                    return True
             file_items = {k: v for k, v in offsets.items()
                           if store.uses_file(k[0])}
             if file_items:

@@ -40,6 +40,11 @@ class Prop:
     validator: Optional[Callable[[Any], bool]] = None
     deprecated: bool = False             # accepted no-op (reference
                                          # _RK_DEPRECATED rows)
+    hidden: bool = False                 # excluded from generated docs
+                                         # (reference _RK_HIDDEN rows)
+    fallthrough: bool = False            # global row that writes the
+                                         # same-name topic-scope knob
+                                         # via the default topic conf
 
 
 def _p(*args, **kw) -> Prop:
@@ -356,16 +361,99 @@ PROPERTIES: list[Prop] = [
        "Offset reset policy when no committed offset.", app=C,
        enum=("smallest", "earliest", "beginning", "largest", "latest", "end", "error")),
     _p("offset.store.method", TOPIC, "enum", "broker",
-       "Offset commit store method.", app=C, enum=("file", "broker")),
+       "Offset commit store method; none = offsets are not stored.",
+       app=C, enum=("none", "file", "broker")),
     _p("offset.store.path", TOPIC, "str", ".",
        "Path to local offset file store (legacy).", app=C),
     _p("offset.store.sync.interval.ms", TOPIC, "int", -1,
        "fsync interval for file store.", app=C, vmin=-1, vmax=86400000),
+
+    # ---- reference-parity tail (rdkafka_conf.c rows absent until r5) ----
+    # Deprecated no-ops the reference still accepts (_RK_DEPRECATED):
+    _p("socket.blocking.max.ms", GLOBAL, "int", 1000,
+       "No longer used.", vmin=1, vmax=60000, deprecated=True),
+    _p("topic.metadata.refresh.fast.cnt", GLOBAL, "int", 10,
+       "No longer used.", vmin=0, vmax=1000, deprecated=True),
+    _p("offset.store.method", GLOBAL, "enum", "broker",
+       "Offset commit store method (deprecated at global scope; routes "
+       "to the topic property).", app=C, enum=("none", "file", "broker"),
+       deprecated=True, fallthrough=True),
+    _p("produce.offset.report", TOPIC, "bool", False,
+       "No longer used.", app=P, deprecated=True),
+    _p("queuing.strategy", TOPIC, "enum", "fifo",
+       "Producer queuing strategy (EXPERIMENTAL, deprecated in the "
+       "reference; only FIFO preserves produce ordering).", app=P,
+       enum=("fifo", "lifo"), deprecated=True),
+    _p("msg_order_cmp", TOPIC, "ptr", None,
+       "Message queue ordering comparator (deprecated, see "
+       "queuing.strategy).", app=P, deprecated=True),
+    _p("auto.commit.enable", TOPIC, "bool", True,
+       "Legacy simple-consumer topic-scope auto commit (deprecated; use "
+       "the global enable.auto.commit).", app=C, deprecated=True),
+    _p("enable.auto.commit", TOPIC, "bool", True, "Alias.", app=C,
+       alias="auto.commit.enable", deprecated=True),
+    _p("auto.commit.interval.ms", TOPIC, "int", 60000,
+       "Legacy simple-consumer topic-scope commit interval (deprecated).",
+       app=C, vmin=10, vmax=86400000, deprecated=True),
+    # Java-client guidance rows (_RK_C_INVALID): setting them fails with
+    # a pointer at the right property (rdkafka_conf.c:715-729)
+    _p("ssl.truststore.location", GLOBAL, "invalid", None,
+       "Java TrustStores are not supported, use `ssl.ca.location` and a "
+       "certificate file instead."),
+    _p("sasl.jaas.config", GLOBAL, "invalid", None,
+       "Java JAAS configuration is not supported, see sasl.mechanisms / "
+       "sasl.username / sasl.password and the sasl.* properties instead."),
+    # Hidden rows (_RK_HIDDEN: functional, excluded from generated docs)
+    _p("enable.sparse.connections", GLOBAL, "bool", True,
+       "Only connect to brokers the client needs to talk to (bootstrap "
+       "brokers and brokers with led partitions or queued requests); "
+       "when disabled, connect to every discovered broker.", hidden=True),
+    _p("ut_handle_ProduceResponse", GLOBAL, "ptr", None,
+       "Unit-test interceptor for ProduceResponse handling: "
+       "fn(broker_id, base_msgid, err) -> err-or-None override.",
+       hidden=True),
+    # Per-topic codec override (reference topic-scope compression.codec,
+    # rdkafka_conf.c:1360: 'inherit' falls through to the global row)
+    _p("compression.codec", TOPIC, "enum", "inherit",
+       "Compression codec for this topic; inherit = use the global "
+       "compression.codec.", app=P,
+       enum=("none", "gzip", "snappy", "lz4", "zstd", "inherit")),
+    _p("compression.type", TOPIC, "enum", "inherit", "Alias.", app=P,
+       enum=("none", "gzip", "snappy", "lz4", "zstd", "inherit"),
+       alias="compression.codec"),
+    _p("opaque", TOPIC, "ptr", None,
+       "Per-topic application opaque (rd_kafka_topic_conf_set_opaque)."),
+    _p("consume.callback.max.messages", TOPIC, "int", 0,
+       "Maximum number of messages dispatched per consume_callback call "
+       "(0 = unlimited; topic-scope row mirrors the reference, the global "
+       "row is this tree's addition).", vmin=0, vmax=1000000, app=C),
 ]
 
-_BY_NAME: dict[str, Prop] = {}
+#: Rows this tree adds over the reference's 154-row table
+#: (rdkafka_conf.c:224). Everything in the reference table exists here
+#: too (test_0110 asserts the union both ways against the reference
+#: source); these are the intentional extras — the TPU codec-sidecar
+#: knobs plus three client conveniences.
+TPU_ADDITIONS = frozenset({
+    (GLOBAL, "compression.backend"),
+    (GLOBAL, "tpu.launch.min.batches"),
+    (GLOBAL, "tpu.lz4.force"),
+    (GLOBAL, "tpu.mesh.devices"),
+    (GLOBAL, "tpu.transport.min.mb.s"),
+    (GLOBAL, "codec.pipeline.depth"),
+    (GLOBAL, "allow.auto.create.topics"),       # KIP-361 (post-1.3.0)
+    (GLOBAL, "consume.callback.max.messages"),  # global mirror of the
+                                                # reference's topic row
+    (GLOBAL, "fetch.num.inflight"),             # fetch pipelining depth
+    (GLOBAL, "test.mock.default.partitions"),   # mock-cluster knob
+})
+
+# Scope-keyed lookup: the reference's table has rows of the same name in
+# both scopes (compression.codec, opaque, offset.store.method, ...)
+_BY_NAME: dict[tuple, Prop] = {}
 for prop in PROPERTIES:
-    _BY_NAME[prop.name] = prop
+    assert (prop.scope, prop.name) not in _BY_NAME, prop.name
+    _BY_NAME[(prop.scope, prop.name)] = prop
 
 _TRUE = {"true", "t", "1", "yes", "on"}
 _FALSE = {"false", "f", "0", "no", "off"}
@@ -385,10 +473,14 @@ class _ConfBase:
 
     # -- core API (reference: rd_kafka_conf_set, rdkafka_conf.c) --
     def set(self, name: str, value: Any) -> None:
-        prop = _BY_NAME.get(name)
-        if prop is None or prop.scope != self._scope:
+        prop = _BY_NAME.get((self._scope, name))
+        if prop is None:
             raise KafkaException(Err._INVALID_ARG,
                                  f"No such {self._scope} configuration property: {name!r}")
+        if prop.ptype == "invalid":
+            # reference _RK_C_INVALID rows: fail with guidance
+            raise KafkaException(Err._INVALID_ARG,
+                                 f"{name!r}: {prop.doc}")
         if prop.alias:
             return self.set(prop.alias, value)
         self._values[prop.name] = self._coerce(prop, value)
@@ -408,8 +500,8 @@ class _ConfBase:
         self._listeners.append(cb)
 
     def get(self, name: str) -> Any:
-        prop = _BY_NAME.get(name)
-        if prop is None or prop.scope != self._scope:
+        prop = _BY_NAME.get((self._scope, name))
+        if prop is None:
             raise KafkaException(Err._INVALID_ARG,
                                  f"No such {self._scope} configuration property: {name!r}")
         if prop.alias:
@@ -417,7 +509,7 @@ class _ConfBase:
         return self._values.get(prop.name, prop.default)
 
     def is_set(self, name: str) -> bool:
-        prop = _BY_NAME.get(name)
+        prop = _BY_NAME.get((self._scope, name))
         if prop and prop.alias:
             name = prop.alias
         return name in self._explicit
@@ -430,7 +522,8 @@ class _ConfBase:
         """All effective values (reference: rd_kafka_conf_dump)."""
         out = {}
         for prop in PROPERTIES:
-            if prop.scope == self._scope and not prop.alias and prop.ptype != "ptr":
+            if (prop.scope == self._scope and not prop.alias
+                    and prop.ptype not in ("ptr", "invalid")):
                 out[prop.name] = self.get(prop.name)
         return out
 
@@ -500,8 +593,14 @@ class Conf(_ConfBase):
     _scope = GLOBAL
 
     def set(self, name: str, value: Any) -> None:
-        prop = _BY_NAME.get(name)
-        if prop is not None and prop.scope == TOPIC:
+        # fallthrough: names that only exist topic-scope route to the
+        # default topic conf, as do explicit fallthrough rows (global
+        # offset.store.method); names in BOTH scopes otherwise
+        # (compression.codec, opaque, ...) take the global row, as the
+        # reference does
+        gprop = _BY_NAME.get((GLOBAL, name))
+        if ((gprop is None or gprop.fallthrough)
+                and (TOPIC, name) in _BY_NAME):
             tc = super().get("default_topic_conf")
             if tc is None:
                 tc = TopicConf()
@@ -509,6 +608,18 @@ class Conf(_ConfBase):
             tc.set(name, value)
             return
         super().set(name, value)
+
+    def get(self, name: str) -> Any:
+        # fallthrough rows read back from where set() wrote (the
+        # default topic conf), so set→get round-trips
+        gprop = _BY_NAME.get((GLOBAL, name))
+        if (gprop is not None and gprop.fallthrough
+                and (TOPIC, name) in _BY_NAME):
+            tc = super().get("default_topic_conf")
+            if tc is not None:
+                return tc.get(name)
+            return _BY_NAME[(TOPIC, name)].default
+        return super().get(name)
 
     def topic_conf(self) -> "TopicConf":
         tc = self.get("default_topic_conf")
@@ -529,7 +640,7 @@ def generate_configuration_md() -> str:
                 "Property | C/P | Range | Default | Description",
                 "---------|-----|-------|---------|------------"]
         for prop in PROPERTIES:
-            if prop.scope != scope:
+            if prop.scope != scope or prop.hidden:
                 continue
             rng = ""
             if prop.vmin is not None:
@@ -541,6 +652,21 @@ def generate_configuration_md() -> str:
                 doc = f"**DEPRECATED** {doc}"
             out.append(f"{prop.name} | {prop.app} | {rng} | {prop.default} | {doc}")
         out.append("")
+    out += [
+        "## Appendix: delta vs the reference table", "",
+        "Every property in librdkafka 1.3.0's declarative table "
+        "(src/rdkafka_conf.c:224, 154 rows incl. both scopes) exists in "
+        "this table with the same name, scope and semantics — including "
+        "the deprecated no-op rows, the hidden rows "
+        "(enable.sparse.connections, ut_handle_ProduceResponse) and the "
+        "Java-guidance error rows (ssl.truststore.location, "
+        "sasl.jaas.config). Windows-only behavior (SSPI) is out of "
+        "scope but its conf rows are accepted.", "",
+        "Rows this tree ADDS over the reference:", ""]
+    for scope, name in sorted(TPU_ADDITIONS):
+        prop = _BY_NAME[(scope, name)]
+        out.append(f"- `{name}` ({scope}): {prop.doc}")
+    out.append("")
     return "\n".join(out)
 
 

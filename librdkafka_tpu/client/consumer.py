@@ -296,8 +296,19 @@ class Consumer:
                 Err._INVALID_ARG,
                 "consume_callback requires a consume_cb (argument or "
                 "conf property)")
-        cap = max_messages if max_messages is not None else \
-            self._rk.conf.get("consume.callback.max.messages")
+        cap = max_messages
+        if cap is None:
+            cap = self._rk.conf.get("consume.callback.max.messages")
+            # topic-scope row (the reference's per-topic cap,
+            # rdkafka_conf.c:1365 — its consume_callback is a per-topic
+            # call): an explicitly-set subscribed topic's cap bounds
+            # this instance-level call conservatively
+            for t in (self._rk.cgrp.subscription if self._rk.cgrp else ()):
+                tc = self._rk.topic_conf_for(t)
+                if tc.is_set("consume.callback.max.messages"):
+                    tcap = tc.get("consume.callback.max.messages")
+                    if tcap and (not cap or tcap < cap):
+                        cap = tcap
         if not cap:
             cap = float("inf")
         n = 0

@@ -695,6 +695,15 @@ class Kafka:
             t = self.topics.get(name)
         return t.conf if t else self.conf.topic_conf()
 
+    def set_topic_conf(self, name: str, conf: dict) -> None:
+        """Per-topic configuration (the rd_kafka_topic_new(rk, name,
+        topic_conf) analog, reference rdkafka_topic.c): applies on top
+        of the default topic conf for this topic only."""
+        t = self.get_topic(name)
+        t.conf.update(conf)
+        if "partitioner" in conf:
+            t.partitioner = partitioner_fn(t.conf.get("partitioner"))
+
     def get_toppar(self, topic: str, partition: int,
                    create: bool = True) -> Optional[Toppar]:
         key = (topic, partition)

@@ -115,9 +115,13 @@ class FileOffsetStore:
                 self._files[key] = f
             return f
 
+    def method(self, topic: str) -> str:
+        """Effective offset.store.method for this topic
+        (none | file | broker)."""
+        return self.rk.topic_conf_for(topic).get("offset.store.method")
+
     def uses_file(self, topic: str) -> bool:
-        return (self.rk.topic_conf_for(topic).get("offset.store.method")
-                == "file")
+        return self.method(topic) == "file"
 
     def read(self, topic: str, partition: int) -> Optional[int]:
         try:

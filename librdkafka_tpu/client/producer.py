@@ -29,6 +29,11 @@ class Producer:
         # path (str encoding + on_delivery handled there)
         self.produce = self._rk.produce
 
+    def set_topic_conf(self, topic: str, conf: dict) -> None:
+        """Per-topic configuration override (rd_kafka_topic_new analog):
+        e.g. {'compression.codec': 'snappy'} for one topic."""
+        self._rk.set_topic_conf(topic, conf)
+
     def produce_batch(self, topic: str, msgs: list[dict],
                       partition: int = PARTITION_UA) -> int:
         """Batch produce (reference: rd_kafka_produce_batch,
