@@ -26,6 +26,47 @@ from .partition import FetchState, Toppar
 from .queue import Op, OpQueue, OpType, SyncReply
 
 
+class _PyCursor:
+    """Pure-Python delivery cursor: the fallback for
+    tk_enqlane.cursor_new (identical contract, see _next_pending)."""
+    __slots__ = ("tp", "msgs", "ver", "key", "i", "n")
+
+    def __init__(self, tp, msgs, ver, key):
+        self.tp = tp
+        self.msgs = msgs
+        self.ver = ver
+        self.key = key
+        self.i = 0
+        self.n = len(msgs)
+
+    def next(self, assignment, auto_store):
+        tp = self.tp
+        while self.i < self.n:
+            m = self.msgs[self.i]
+            self.i += 1
+            if tp.version != self.ver or self.key not in assignment:
+                continue            # stale/revoked: drop
+            off1 = m.offset + 1
+            tp.app_offset = off1
+            if auto_store:
+                tp.stored_offset = off1
+            return m
+        return None
+
+
+def _cursor_factory():
+    try:
+        from .arena import _mod
+        m = _mod()
+        f = getattr(m, "cursor_new", None) if m else None
+        return f if f is not None else _PyCursor
+    except Exception:
+        return _PyCursor
+
+
+_new_cursor = _cursor_factory()
+
+
 @dataclass
 class TopicPartition:
     """Public topic+partition+offset tuple (rd_kafka_topic_partition_t)."""
@@ -62,6 +103,7 @@ class Consumer:
         self._pending: deque = deque()   # (tp, msgs, version) batches
         self._cur = None                 # [tp, msgs, version, i] cursor
         self._auto_store = conf.get("enable.auto.offset.store")
+        self._next_tick = 0.0            # cgrp tick time-gate (poll)
         self._closed = False
 
     # ---------------------------------------------------------- subscribe --
@@ -206,64 +248,55 @@ class Consumer:
     def _next_pending(self) -> Optional[Message]:
         """Next deliverable message from the fetched-batch queue.
         Batches stay whole (one deque entry per partition response, the
-        op-per-batch axis); a cursor walks the current batch with the
-        per-message delivery bookkeeping inlined below — fetchq
-        accounting, the staleness barrier, offset advance. A message is
-        stale — dropped with its accounting released — when the
-        partition was seeked/paused since the fetch (version barrier)
-        OR revoked from the current assignment; the revocation check
-        applies to group and simple consumers alike, assign()/
-        unassign() maintain _assignment in both modes (reference:
+        op-per-batch axis); a delivery cursor (native tk_enqlane.Cursor
+        when available) walks the current batch — the staleness barrier,
+        the revocation check and the offset advance run per message in
+        ONE C call. A message is stale — dropped — when the partition
+        was seeked/paused since the fetch (version barrier) OR revoked
+        from the current assignment; assign()/unassign() maintain
+        _assignment in group and simple modes alike (reference:
         rd_kafka_op_version_outdated plus the fetchq disconnect on
-        rd_kafka_toppar_fetch_stop)."""
+        rd_kafka_toppar_fetch_stop). Fetchq accounting is released per
+        BATCH when its delivery begins (it feeds the queued.min.messages
+        fetch gate, where batch granularity is equivalent)."""
         cur = self._cur
         pending = self._pending
-        assignment = self._assignment
-        auto_store = self._auto_store
         while True:
             if cur is None:
                 if not pending:
                     return None
-                tp, msgs, ver = pending.popleft()
-                cur = [tp, msgs, ver, 0]
-            tp, msgs, ver, i = cur
-            n = len(msgs)
-            # _deliver's bookkeeping inlined (it is the per-message
-            # consume budget); semantics identical — staleness
-            # (tp.version, revocation) is re-checked per message
-            # because seek()/unassign() can land mid-batch
-            key = (tp.topic, tp.partition)
-            while i < n:
-                m = msgs[i]
-                i += 1
-                fc = tp.fetchq_cnt - 1
+                tp, msgs, ver, mbytes = pending.popleft()
+                fc = tp.fetchq_cnt - len(msgs)
                 tp.fetchq_cnt = fc if fc > 0 else 0
-                fb = tp.fetchq_bytes - m.size
+                fb = tp.fetchq_bytes - mbytes
                 tp.fetchq_bytes = fb if fb > 0 else 0
-                if tp.version != ver or key not in assignment:
-                    continue            # stale: accounting released
-                off1 = m.offset + 1
-                tp.app_offset = off1
-                if auto_store:
-                    tp.stored_offset = off1
-                if i < n:
-                    cur[3] = i
-                    self._cur = cur
-                else:
-                    self._cur = None
+                cur = _new_cursor(tp, msgs, ver, (tp.topic, tp.partition))
+                self._cur = cur
+            m = cur.next(self._assignment, self._auto_store)
+            if m is not None:
                 return m
             cur = None
             self._cur = None
 
     def poll(self, timeout: float = 1.0) -> Optional[Message]:
+        # fast path: drain already-fetched batches without touching the
+        # op queue (the per-message consume budget); the cgrp tick
+        # (max.poll bookkeeping, rebalance callbacks) is TIME-gated to
+        # ~4/s — a count gate would let a slow-consuming app's
+        # last-poll timestamp go stale past max.poll.interval.ms even
+        # though it polls continuously. The slow path always ticks.
+        msg = self._next_pending()
+        if msg is not None:
+            now = time.monotonic()
+            if now >= self._next_tick:
+                self._next_tick = now + 0.25
+                cgrp = self._rk.cgrp
+                if cgrp is not None:
+                    cgrp.poll_tick()
+            return msg
         cgrp = self._rk.cgrp
         if cgrp is not None:
             cgrp.poll_tick()
-        # fast path: drain already-fetched batches without touching the
-        # clock or the op queue (the per-message consume budget)
-        msg = self._next_pending()
-        if msg is not None:
-            return msg
         deadline = time.monotonic() + timeout
         while True:
             remain = deadline - time.monotonic()
@@ -358,9 +391,9 @@ class Consumer:
     def _serve_op(self, op: Op) -> Optional[Message]:
         rk = self._rk
         if op.type == OpType.FETCH:
-            tp, msgs, version = op.payload
+            tp, msgs, version, mbytes = op.payload
             if msgs:
-                self._pending.append((tp, msgs, version))
+                self._pending.append((tp, msgs, version, mbytes))
             return None
         if op.type == OpType.CONSUMER_ERR:
             tp, msg, version = op.payload

@@ -73,6 +73,77 @@ class Message:
                 f"{' err=' + self.error.code.name if self.error else ''})")
 
 
+class FetchMessage:
+    """Consumer-side message with LAZY key/value/headers: the native
+    bulk materializer stores the shared records buffer plus packed
+    (offset << 32 | length) ints per record; the bytes objects are
+    created only when the app reads ``.value``/``.key`` and are cached
+    on first access. Offset-commit-only consumers and key filters
+    never pay the per-record payload copy (the reference's rko_msg
+    points into the fetch buffer the same way,
+    rdkafka_msgset_reader.c:715).
+
+    Producer-only fields (msgid, retries, status, ...) are class-level
+    constants — consumer apps can read them, nothing ever sets them on
+    fetched messages."""
+
+    __slots__ = ("topic", "partition", "offset", "timestamp",
+                 "timestamp_type", "error", "_buf", "_v", "_k", "_h")
+
+    msgid = 0
+    retries = 0
+    opaque = None
+    on_delivery = None
+    enq_time = 0.0
+    ts_backoff = 0.0
+    latency_us = 0
+    status = MsgStatus.NOT_PERSISTED
+
+    @property
+    def value(self) -> Optional[bytes]:
+        v = self._v
+        if type(v) is int:
+            o = v >> 32
+            v = self._buf[o:o + (v & 0xFFFFFFFF)]
+            if type(v) is not bytes:
+                v = bytes(v)          # memoryview slice (zero-copy path)
+            self._v = v               # cache: second read is free
+        return v
+
+    @property
+    def key(self) -> Optional[bytes]:
+        k = self._k
+        if type(k) is int:
+            o = k >> 32
+            k = self._buf[o:o + (k & 0xFFFFFFFF)]
+            if type(k) is not bytes:
+                k = bytes(k)
+            self._k = k
+        return k
+
+    @property
+    def headers(self) -> list:
+        h = self._h
+        return h if h is not None else []
+
+    @property
+    def size(self) -> int:
+        v, k = self._v, self._k
+        n = (v & 0xFFFFFFFF) if type(v) is int else (len(v) if v else 0)
+        n += (k & 0xFFFFFFFF) if type(k) is int else (len(k) if k else 0)
+        return n
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __bool__(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"Message({self.topic}[{self.partition}]@{self.offset}"
+                f"{' err=' + self.error.code.name if self.error else ''})")
+
+
 def partition_random(key, cnt, rnd=random.random):
     return int(rnd() * cnt) % cnt
 

@@ -32,6 +32,23 @@ class FetchState(enum.Enum):
 
 
 class Toppar:
+    # slotted: the native delivery cursor (enqlane.cpp cursor_next)
+    # reads/writes version/app_offset/stored_offset by member offset,
+    # and the per-toppar footprint matters at 64+ partitions
+    __slots__ = (
+        "topic", "partition", "lock",
+        # producer
+        "msgq", "xmit_msgq", "msgq_bytes", "arena", "arena_ok",
+        "next_msgid", "epoch_base_msgid", "inflight", "inflight_msgids",
+        "retry_batches", "retry_backoff_until", "leader_id",
+        "ts_last_xmit",
+        # consumer
+        "fetch_state", "fetchq", "fetch_offset", "app_offset",
+        "stored_offset", "committed_offset", "hi_offset", "ls_offset",
+        "paused", "fetch_backoff_until", "fetch_in_flight",
+        "fetch_broker_id", "fetchq_cnt", "fetchq_bytes",
+        "eof_reported_at", "aborted_txns", "version")
+
     def __init__(self, topic: str, partition: int):
         self.topic = topic
         self.partition = partition

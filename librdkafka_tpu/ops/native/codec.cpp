@@ -428,14 +428,32 @@ static int64_t lz4_block_decompress_hist(const uint8_t *src, int64_t n,
         }
         if (o + mlen > cap) return -4;
         const uint8_t *m = dst + o - off;
-        if (off >= 8) {
+        if (off >= 16 && o + mlen + 16 <= cap) {
+            // wild copy: 16-byte chunks may overshoot mlen by up to 15
+            // bytes — safe inside cap, and the tail is overwritten by
+            // the next sequence's literals (liblz4's own fast path)
+            for (int64_t k = 0; k < mlen; k += 16)
+                memcpy(dst + o + k, m + k, 16);
+        } else if (off >= 8) {
             // non-overlapping at word granularity: 8-byte strided copy
             // (the byte loop measured ~0.6 GB/s on the fetch path)
             int64_t k = 0;
             for (; k + 8 <= mlen; k += 8) memcpy(dst + o + k, m + k, 8);
             for (; k < mlen; k++) dst[o + k] = m[k];
+        } else if (mlen <= off * 2) {
+            for (int64_t k = 0; k < mlen; k++) dst[o + k] = m[k];
         } else {
-            for (int64_t k = 0; k < mlen; k++) dst[o + k] = m[k];  // overlap
+            // small-offset overlap (RLE-ish data): pattern doubling —
+            // seed one period, then double the written segment with
+            // non-overlapping memcpys (log2 copies instead of a byte
+            // loop; this path measured 340 MB/s byte-at-a-time)
+            for (int64_t k = 0; k < off; k++) dst[o + k] = m[k];
+            int64_t seg = off;
+            while (seg < mlen) {
+                int64_t c = seg <= mlen - seg ? seg : mlen - seg;
+                memcpy(dst + o + seg, dst + o, c);
+                seg += c;
+            }
         }
         o += mlen;
     }
@@ -813,6 +831,87 @@ EXPORT void tk_snappy_compress_many(const uint8_t *base, const int64_t *offs,
     std::vector<std::thread> ts;
     for (int t = 0; t < nt; t++) ts.emplace_back(work);
     for (auto &t : ts) t.join();
+}
+
+// Exact decompressed size by a write-free sequence walk (the lz4 frame
+// format carries no content size with our FLG; a wrong capacity guess
+// costs full re-decodes — the snappy preamble-length pattern, but
+// computed). ~#sequences work, not #bytes.
+static int64_t lz4_block_decompressed_size(const uint8_t *src, int64_t n) {
+    int64_t i = 0, o = 0;
+    while (i < n) {
+        uint8_t tok = src[i++];
+        int64_t lit = tok >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do { if (i >= n) return -1; b = src[i++]; lit += b; } while (b == 255);
+        }
+        if (i + lit > n) return -1;
+        i += lit; o += lit;
+        if (i == n) break;
+        if (i + 2 > n) return -1;
+        i += 2;
+        int64_t mlen = (tok & 0x0F) + 4;
+        if ((tok & 0x0F) == 15) {
+            uint8_t b;
+            do { if (i >= n) return -1; b = src[i++]; mlen += b; } while (b == 255);
+        }
+        o += mlen;
+    }
+    return o;
+}
+
+EXPORT int64_t tk_lz4f_decompressed_size(const uint8_t *src, int64_t n) {
+    int64_t i = 0, o = 0;
+    // the result sizes an allocation BEFORE any decode validates the
+    // data, and the input is untrusted network bytes — clamp to the
+    // lz4 format's own max expansion (~255:1 via run-length extension
+    // bytes) so a corrupt frame cannot request terabytes
+    const int64_t max_out = n * 256 + (64 << 10);
+    if (n < 7) return -1;
+    if (rd32le(src) != LZ4F_MAGIC) return -2;
+    i = 4;
+    uint8_t flg = src[i];
+    if ((flg >> 6) != 1) return -3;
+    bool has_csize = flg & 0x08, has_dict = flg & 0x01;
+    bool has_bchk = flg & 0x10;
+    i += 2;
+    if (has_csize) {
+        // content size present: trust the header field within bounds
+        if (i + 8 > n) return -1;
+        int64_t cs;
+        memcpy(&cs, src + i, 8);
+        if (cs < 0 || cs > max_out) return -6;
+        return cs;
+    }
+    if (has_dict) i += 4;
+    i += 1;
+    while (true) {
+        if (i + 4 > n) return -1;
+        uint32_t hdr = rd32le(src + i); i += 4;
+        if (hdr == 0) break;
+        bool raw = hdr & 0x80000000u;
+        int64_t bsz = hdr & 0x7FFFFFFF;
+        if (i + bsz > n) return -1;
+        if (raw) o += bsz;
+        else {
+            int64_t d = lz4_block_decompressed_size(src + i, bsz);
+            if (d < 0) return -5;
+            o += d;
+        }
+        if (o > max_out) return -6;
+        i += bsz;
+        if (has_bchk) i += 4;
+    }
+    return o;
+}
+
+EXPORT void tk_lz4f_decompressed_size_many(const uint8_t *base,
+                                           const int64_t *offs,
+                                           const int64_t *lens, int n,
+                                           int64_t *out_sizes) {
+    for (int i = 0; i < n; i++)
+        out_sizes[i] = tk_lz4f_decompressed_size(base + offs[i], lens[i]);
 }
 
 EXPORT void tk_lz4f_decompress_many(const uint8_t *base, const int64_t *offs,

@@ -146,30 +146,70 @@ class TpuCodecProvider:
         if t is not None:
             t.join(timeout)
 
+    #: the probe body, run OUT OF PROCESS (see _probe_transport): a full
+    #: round trip (device_put + host readback) is the only sync that is
+    #: reliable on every platform (a tunneled device can return from
+    #: block_until_ready before bytes land), so the rate counts bytes
+    #: moved in BOTH directions
+    _PROBE_SRC = (
+        "import time\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "h = np.zeros((4, 65536), np.uint8)\n"
+        "np.asarray(jax.device_put(h))\n"
+        "t0 = time.perf_counter()\n"
+        "np.asarray(jax.device_put(h))\n"
+        "dt = max(time.perf_counter() - t0, 1e-9)\n"
+        "print((2 * h.nbytes / (1 << 20)) / dt)\n")
+
+    _PROBE_CACHE_TTL = 900.0     # transport is stable within a session
+
     def _probe_transport(self) -> float:
-        """Measure host<->device bandwidth once (warm path, 256KB).
-
-        The probe is a full round trip (device_put + host readback) —
-        the only sync that is reliable on every platform (a tunneled
-        device can return from block_until_ready before bytes land) —
-        so the rate counts the bytes moved in BOTH directions.  A probe
-        failure is cached as 0.0: a broken device must not re-raise
-        inside the broker serve loop on every batch."""
-        if self.transport_mb_s is None:
+        """Measure host<->device bandwidth once — in a SUBPROCESS, with
+        a disk cache.  When the gate routes to CPU (slow tunnel), the
+        client process must never initialize the jax runtime: its
+        background threads tax every broker/codec thread on small hosts
+        (measured ~90k msgs/s off the producer pipeline on a 1-core
+        host, VERDICT r4 #3).  A probe failure is cached in-memory as
+        0.0: a broken device must not re-raise inside the broker serve
+        loop, and must not receive traffic."""
+        if self.transport_mb_s is not None:
+            return self.transport_mb_s
+        import json
+        import os
+        import subprocess
+        import sys
+        import tempfile
+        import time
+        key = os.environ.get("JAX_PLATFORMS", "default") or "default"
+        cache = os.path.join(
+            tempfile.gettempdir(),
+            f"tk_transport_{os.getuid()}_{key.replace(',', '-')}.json")
+        try:
+            if time.time() - os.stat(cache).st_mtime < self._PROBE_CACHE_TTL:
+                with open(cache) as f:
+                    self.transport_mb_s = float(json.load(f)["mb_s"])
+                return self.transport_mb_s
+        except Exception:
+            pass
+        v = 0.0
+        try:
+            out = subprocess.run([sys.executable, "-c", self._PROBE_SRC],
+                                 capture_output=True, timeout=300)
+            if out.returncode == 0:
+                v = float(out.stdout.split()[-1])
+        except Exception:
+            v = 0.0
+        self.transport_mb_s = v
+        if v > 0:
             try:
-                import time
-
-                import jax
-
-                h = np.zeros((4, LZ4F_BLOCKSIZE), np.uint8)
-                np.asarray(jax.device_put(h))         # warm the path
-                t0 = time.perf_counter()
-                np.asarray(jax.device_put(h))
-                dt = max(time.perf_counter() - t0, 1e-9)
-                self.transport_mb_s = (2 * h.nbytes / (1 << 20)) / dt
+                tmp = cache + f".{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"mb_s": v}, f)
+                os.replace(tmp, cache)
             except Exception:
-                self.transport_mb_s = 0.0
-        return self.transport_mb_s
+                pass
+        return v
 
     def _offload_pays(self) -> bool:
         """True when the measured transport clears the gate (or the gate

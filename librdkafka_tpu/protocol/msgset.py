@@ -466,6 +466,20 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
     append_ts = info.max_timestamp
     base_off = info.base_offset
     not_persisted = MsgStatus.NOT_PERSISTED
+    lazy = _materializer_lazy()
+    if lazy is not None:
+        # r5 hot path: FetchMessage with LAZY key/value (packed
+        # buffer offsets; bytes created on first .value access) —
+        # offset-commit-only consumers never pay the payload copy
+        from ..client.msg import FetchMessage
+        out, total, fixups = lazy(
+            FetchMessage, records_bytes, fields.ctypes.data, n, topic,
+            partition, base_off, fo, base_ts, append_ts,
+            1 if log_append else 0, tstype)
+        if fixups is not None:
+            for idx, ho, nh in fixups:
+                out[idx]._h = _parse_headers(records_bytes, ho, nh)
+        return out, total
     mat = _materializer()
     if mat is not None:
         # bulk native materialization: tp_alloc + direct slot stores per
@@ -515,6 +529,23 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
 
 _MAT = None
 _MAT_ERR = False
+_LAZY = None
+_LAZY_ERR = False
+
+
+def _materializer_lazy():
+    """tk_enqlane.materialize_v2_lazy, or None when unavailable."""
+    global _LAZY, _LAZY_ERR
+    if _LAZY is None and not _LAZY_ERR:
+        try:
+            from ..client.arena import _mod
+            m = _mod()
+            _LAZY = getattr(m, "materialize_v2_lazy", None) if m else None
+            if _LAZY is None:
+                _LAZY_ERR = True
+        except Exception:
+            _LAZY_ERR = True
+    return _LAZY
 
 
 def _materializer():

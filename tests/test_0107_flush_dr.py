@@ -55,11 +55,11 @@ def test_deliver_version_stale_simple_consumer():
         tp = c._assignment[("st", 0)]
         fresh = Message("st", value=b"v", partition=0)
         fresh.offset = 7
-        c._pending.append((tp, [fresh], tp.version))
+        c._pending.append((tp, [fresh], tp.version, fresh.size))
         assert c._next_pending() is fresh
         stale = Message("st", value=b"v", partition=0)
         stale.offset = 8
-        c._pending.append((tp, [stale], tp.version - 1))
+        c._pending.append((tp, [stale], tp.version - 1, stale.size))
         assert c._next_pending() is None
         # the stale drop must not advance the app offset
         assert tp.app_offset == 8
@@ -82,12 +82,12 @@ def test_deliver_revoked_partition_dropped():
             ver = tp.version
             m = Message("rv", value=b"v", partition=0)
             m.offset = 0
-            c._pending.append((tp, [m], ver))
+            c._pending.append((tp, [m], ver, m.size))
             assert c._next_pending() is m
             c.unassign()
             late = Message("rv", value=b"v", partition=0)
             late.offset = 1
-            c._pending.append((tp, [late], ver))
+            c._pending.append((tp, [late], ver, late.size))
             assert c._next_pending() is None
         finally:
             c.close()
