@@ -363,6 +363,25 @@ def main():
         raise
     host_rate = sorted(cpu_rates)[1]
     tpu_backend_rate = sorted(tpu_rates)[1]
+    # delivery-report modes (the reference's headline runs WITH DRs):
+    # per-message dr_msg_cb and the batched dr_batch_cb (one call per
+    # delivered batch, the rd_kafka_event_DR message-array idea)
+    dr_rate = dr_batch_rate = None
+    try:
+        _cnt = [0]
+
+        def _dr_msg(err, m):
+            _cnt[0] += 1
+
+        def _dr_batch(msgs):
+            _cnt[0] += len(msgs)
+
+        dr_rate = host_pipeline(n_msgs, size, toppars,
+                                extra_conf={"dr_msg_cb": _dr_msg})
+        dr_batch_rate = host_pipeline(
+            n_msgs, size, toppars, extra_conf={"dr_batch_cb": _dr_batch})
+    except Exception as e:
+        print(f"dr pipeline failed: {e!r}", file=sys.stderr)
     # BASELINE config 5: 64-toppar idempotent producer (fresh mock with
     # 64 partitions; PID FSM + per-batch sequence numbering in play)
     idem_rate = None
@@ -392,6 +411,10 @@ def main():
             round(consumer_rate, 1) if consumer_rate is not None else None,
         "idempotent_64tp_msgs_s":
             round(idem_rate, 1) if idem_rate is not None else None,
+        "producer_dr_msgs_s":
+            round(dr_rate, 1) if dr_rate is not None else None,
+        "producer_dr_batch_msgs_s":
+            round(dr_batch_rate, 1) if dr_batch_rate is not None else None,
         "detail": off,
     }))
 

@@ -21,6 +21,7 @@ first — FIFO order is preserved exactly).
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 _enqlane = None
@@ -126,6 +127,27 @@ class ArenaBatch:
 
     def __len__(self) -> int:
         return self.count
+
+    def to_messages_lazy(self, topic: str, partition: int,
+                         base_offset: int, status, error) -> list:
+        """DR-path materialization: FetchMessage objects holding the
+        arena base buffer + packed offsets — key/value bytes exist only
+        if the DR callback reads them (most read error/offset/topic).
+        Falls back to the eager path when the extension is absent."""
+        from ..protocol import proto
+        from .msg import FetchMessage
+
+        m_ = _mod()
+        mat = getattr(m_, "materialize_arena_lazy", None) if m_ else None
+        if mat is not None:
+            out = mat(FetchMessage, self.base, self.klens, self.vlens,
+                      self.count, topic, partition, base_offset,
+                      int(time.time() * 1000), proto.TSTYPE_CREATE_TIME,
+                      status, error)
+            if out is not None:
+                return out
+        return self.to_messages(topic, partition, base_offset,
+                                status=status, error=error)
 
     def to_messages(self, topic: str = "", partition: int = -1,
                     base_offset: int = -1, status=None, error=None) -> list:

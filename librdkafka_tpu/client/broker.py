@@ -1460,9 +1460,12 @@ class Broker:
 
     def _handle_fetch(self, err, resp, versions, parts):
         self.fetch_inflight_cnt = max(0, self.fetch_inflight_cnt - 1)
+        # clear the in-flight claims FIRST (a parse error below must
+        # not strand partitions unfetchable); deferred entries re-claim
+        # theirs before parking
+        for tp in parts:
+            tp.fetch_in_flight = False
         if err is not None:
-            for tp in parts:
-                tp.fetch_in_flight = False
             # a failed fetch to a FOLLOWER falls back to the leader
             # (reference reverts the preferred replica on errors) —
             # WITH backoff, or transport errors would ping-pong the
@@ -1555,10 +1558,6 @@ class Broker:
                         rk.revoke_fetch_delegation(tp, ec.name)
                     tp.fetch_backoff_until = time.monotonic() + \
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
-        okset = {id(e[0]) for e in ok}
-        for tp in parts:
-            if id(tp) not in okset:
-                tp.fetch_in_flight = False
         if not ok:
             return
         # phases B-D run PER PARTITION with decompressed-ahead flow
@@ -1576,6 +1575,10 @@ class Broker:
         # fetchq bound, applied at the decompress stage). Within a
         # partition, CRC and decompress still run as BATCHED provider
         # calls over its ~10 batches — the offload seam's launch axis.
+        for e in ok:
+            # re-claim while parked so no broker re-fetches the same
+            # offsets; _serve_deferred_fetch releases at process time
+            e[0].fetch_in_flight = True
         self._fetch_deferred.extend(ok)
         self._serve_deferred_fetch()
 
@@ -1611,85 +1614,85 @@ class Broker:
         rk = self.rk
         check_crcs = rk.conf.get("check.crcs")
         from ..protocol.msgset import iter_legacy_crc_regions
-        for tp, pres, batches, fo, ver in (entry,):
-            # phase B: batched CRC verify for this partition
-            if check_crcs:
-                bad = False
-                if batches:
-                    regions = [b[3][proto.V2_OF_Attributes:]
-                               for b in batches if b[2] >= fo]
-                    infos = [b[0] for b in batches if b[2] >= fo]
-                    if regions:
-                        crcs = rk.codec_provider.crc32c_many(regions)
-                        for info, crc in zip(infos, crcs):
-                            if int(crc) != info.crc:
-                                bad = True
-                                rk.op_err(KafkaError(
-                                    Err._BAD_MSG,
-                                    f"{tp}: CRC mismatch at offset "
-                                    f"{info.base_offset}"))
-                                tp.fetch_backoff_until = \
-                                    time.monotonic() + 0.5
-                                break
-                else:
-                    # legacy MsgVer0/1 blobs: per-message zlib CRC,
-                    # same batched provider seam (MXU GF(2) kernel on
-                    # the tpu backend; reference verifies inline,
-                    # rdkafka_msgset_reader.c v0/v1). The phase-A
-                    # segment split keeps v2 batches out of this walk.
-                    lregions, lowners = [], []
-                    for kind, seg in pres.get("_segments") or []:
-                        if kind != "legacy":
-                            continue
-                        for off, crc, region in iter_legacy_crc_regions(seg):
-                            lregions.append(region)
-                            lowners.append((off, crc))
-                    if lregions:
-                        crcs = rk.codec_provider.crc32_many(lregions)
-                        for (off, want), got in zip(lowners, crcs):
-                            if int(got) != want:
-                                bad = True
-                                rk.op_err(KafkaError(
-                                    Err._BAD_MSG,
-                                    f"{tp}: legacy message CRC mismatch "
-                                    f"at offset {off}"))
-                                tp.fetch_backoff_until = \
-                                    time.monotonic() + 0.5
-                                break
-                if bad:
-                    continue
-            # phase C: batched decompress of this partition's batches.
-            # A failing batch gets payload=None instead of failing the
-            # partition here: phase D skips aborted/control batches
-            # without reading them, so a corrupt batch inside an
-            # aborted transaction must not suppress the partition's
-            # valid committed data
+        tp, pres, batches, fo, ver = entry
+        # phase B: batched CRC verify for this partition
+        if check_crcs:
+            bad = False
             if batches:
-                by_codec: dict[str, list] = {}
-                for b in batches:
-                    info, _payload, last, _full = b
-                    if last >= fo and info.codec:
-                        by_codec.setdefault(info.codec, []).append(b)
-                for codec, items in by_codec.items():
-                    blobs = None
+                regions = [b[3][proto.V2_OF_Attributes:]
+                           for b in batches if b[2] >= fo]
+                infos = [b[0] for b in batches if b[2] >= fo]
+                if regions:
+                    crcs = rk.codec_provider.crc32c_many(regions)
+                    for info, crc in zip(infos, crcs):
+                        if int(crc) != info.crc:
+                            bad = True
+                            rk.op_err(KafkaError(
+                                Err._BAD_MSG,
+                                f"{tp}: CRC mismatch at offset "
+                                f"{info.base_offset}"))
+                            tp.fetch_backoff_until = \
+                                time.monotonic() + 0.5
+                            break
+            else:
+                # legacy MsgVer0/1 blobs: per-message zlib CRC,
+                # same batched provider seam (MXU GF(2) kernel on
+                # the tpu backend; reference verifies inline,
+                # rdkafka_msgset_reader.c v0/v1). The phase-A
+                # segment split keeps v2 batches out of this walk.
+                lregions, lowners = [], []
+                for kind, seg in pres.get("_segments") or []:
+                    if kind != "legacy":
+                        continue
+                    for off, crc, region in iter_legacy_crc_regions(seg):
+                        lregions.append(region)
+                        lowners.append((off, crc))
+                if lregions:
+                    crcs = rk.codec_provider.crc32_many(lregions)
+                    for (off, want), got in zip(lowners, crcs):
+                        if int(got) != want:
+                            bad = True
+                            rk.op_err(KafkaError(
+                                Err._BAD_MSG,
+                                f"{tp}: legacy message CRC mismatch "
+                                f"at offset {off}"))
+                            tp.fetch_backoff_until = \
+                                time.monotonic() + 0.5
+                            break
+            if bad:
+                return
+        # phase C: batched decompress of this partition's batches.
+        # A failing batch gets payload=None instead of failing the
+        # partition here: phase D skips aborted/control batches
+        # without reading them, so a corrupt batch inside an
+        # aborted transaction must not suppress the partition's
+        # valid committed data
+        if batches:
+            by_codec: dict[str, list] = {}
+            for b in batches:
+                info, _payload, last, _full = b
+                if last >= fo and info.codec:
+                    by_codec.setdefault(info.codec, []).append(b)
+            for codec, items in by_codec.items():
+                blobs = None
+                try:
+                    blobs = rk.codec_provider.decompress_many(
+                        codec, [b[1] for b in items])
+                except Exception:
+                    pass   # isolate the failing batch below
+                for i, b in enumerate(items):
+                    if blobs is not None:
+                        b[1] = blobs[i]
+                        continue
                     try:
-                        blobs = rk.codec_provider.decompress_many(
-                            codec, [b[1] for b in items])
+                        b[1] = rk.codec_provider.decompress_many(
+                            codec, [b[1]])[0]
                     except Exception:
-                        pass   # isolate the failing batch below
-                    for i, b in enumerate(items):
-                        if blobs is not None:
-                            b[1] = blobs[i]
-                            continue
-                        try:
-                            b[1] = rk.codec_provider.decompress_many(
-                                codec, [b[1]])[0]
-                        except Exception:
-                            b[1] = None
-            # phase D: record parsing + delivery op for this partition
-            rk.fetch_reply_handle(
-                tp, pres, self,
-                batches=None if batches is None else
-                [(info, payload, last)
-                 for info, payload, last, _full in batches],
-                fo=fo, ver=ver)
+                        b[1] = None
+        # phase D: record parsing + delivery op for this partition
+        rk.fetch_reply_handle(
+            tp, pres, self,
+            batches=None if batches is None else
+            [(info, payload, last)
+             for info, payload, last, _full in batches],
+            fo=fo, ver=ver)

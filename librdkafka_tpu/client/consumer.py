@@ -100,8 +100,10 @@ class Consumer:
         self._rk.cgrp = ConsumerGroup(self._rk, group_id) if group_id else None
         self._assignment: dict[tuple[str, int], Toppar] = {}
         # messages from a batched FETCH op awaiting delivery via poll()
-        self._pending: deque = deque()   # (tp, msgs, version) batches
-        self._cur = None                 # [tp, msgs, version, i] cursor
+        self._pending: deque = deque()   # (tp, msgs, version, mbytes)
+        self._cur = None                 # delivery cursor over the
+                                         # current batch (native
+                                         # tk_enqlane.Cursor / _PyCursor)
         self._auto_store = conf.get("enable.auto.offset.store")
         self._next_tick = 0.0            # cgrp tick time-gate (poll)
         self._closed = False

@@ -26,7 +26,8 @@ from .arena import ArenaBatch, arena_new, batch_msgids, lane_new
 from .broker import Broker, Request
 from .conf import Conf, TopicConf
 from .errors import Err, KafkaError, KafkaException
-from .msg import Message, MsgStatus, PARTITION_UA, partitioner_fn
+from .msg import (FetchMessage, Message, MsgStatus, PARTITION_UA,
+                  partitioner_fn)
 from .partition import FetchState, Toppar
 from .queue import Op, OpQueue, OpType, Timers
 
@@ -902,6 +903,7 @@ class Kafka:
         events, or the background event thread)"""
         conf = self.conf
         return bool(conf.get("dr_msg_cb") or conf.get("dr_cb")
+                    or conf.get("dr_batch_cb")
                     or "dr" in conf.get("enabled_events")
                     or self.background is not None)
 
@@ -914,33 +916,37 @@ class Kafka:
         Message objects HERE — at delivery-report time, off the
         produce() path — carrying ``tp``'s topic/partition and offsets
         from ``base_offset`` (successful batches)."""
+        batch_nbytes = None
         if isinstance(msgs, ArenaBatch):
             if self._dr_out_wanted():
                 st = (MsgStatus.PERSISTED if err is None
                       else MsgStatus.POSSIBLY_PERSISTED
                       if msgs.possibly_persisted
                       else MsgStatus.NOT_PERSISTED)
-                msgs = msgs.to_messages(   # falls through to list path
+                batch_nbytes = msgs.nbytes
+                # LAZY DR materialization: messages hold (arena base,
+                # packed offsets); .value/.key bytes exist only if the
+                # DR callback reads them. The shared error stamps every
+                # record here, so the per-message error loop below is
+                # skipped for batches.
+                msgs = msgs.to_messages_lazy(
                     tp.topic if tp is not None else "",
                     tp.partition if tp is not None else -1,
-                    base_offset=base_offset if err is None else -1,
-                    status=st)
+                    base_offset if err is None else -1, st, err)
             else:
                 with self._msg_cnt_lock:
                     self._lane.acct(-msgs.count, -msgs.nbytes)
                     if self.flushing:
                         self._outq_cond.notify_all()
                 return
-        if err is not None:
+        elif err is not None:
             for m in msgs:
                 m.error = err
         if self.interceptors:
             for m in msgs:
                 self.interceptors.on_acknowledgement(m)
         out = []
-        if (self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
-                or "dr" in self.conf.get("enabled_events")
-                or self.background is not None
+        if (self._dr_out_wanted()
                 or any(m.on_delivery is not None for m in msgs)):
             only_err = self.conf.get("delivery.report.only.error")
             out = msgs if (err or not only_err) else \
@@ -948,8 +954,10 @@ class Kafka:
         # msg_cnt release and dr_cnt claim must be ONE atomic step:
         # a flush() reading between them would see outstanding == 0 and
         # return before the DR reaches the app
+        if batch_nbytes is None:
+            batch_nbytes = sum(m.size for m in msgs)
         with self._msg_cnt_lock:
-            self._lane.acct(-len(msgs), -sum(m.size for m in msgs))
+            self._lane.acct(-len(msgs), -batch_nbytes)
             self.dr_cnt += len(out)
             if self.flushing and not out:
                 self._outq_cond.notify_all()
@@ -989,8 +997,25 @@ class Kafka:
 
     def _serve_rep_op(self, op: Op):
         if op.type == OpType.DR:
+            bcb = self.conf.get("dr_batch_cb")
             cb = self.conf.get("dr_msg_cb") or self.conf.get("dr_cb")
             try:
+                if bcb is not None:
+                    # ONE call per delivered batch (the
+                    # rd_kafka_event_DR message-array contract); any
+                    # per-message on_delivery callbacks still fire
+                    bcb(op.payload)
+                    if cb is None:
+                        # fast-lane DR batches are FetchMessage lists —
+                        # on_delivery is a class-level None there, so
+                        # the per-message scan is skipped entirely
+                        if (op.payload
+                                and type(op.payload[0]) is FetchMessage):
+                            return
+                        for m in op.payload:
+                            if m.on_delivery is not None:
+                                m.on_delivery(m.error, m)
+                        return
                 for m in op.payload:
                     mcb = m.on_delivery or cb
                     if mcb:

@@ -88,7 +88,8 @@ class FetchMessage:
     fetched messages."""
 
     __slots__ = ("topic", "partition", "offset", "timestamp",
-                 "timestamp_type", "error", "_buf", "_v", "_k", "_h")
+                 "timestamp_type", "error", "status",
+                 "_buf", "_v", "_k", "_h")
 
     msgid = 0
     retries = 0
@@ -97,7 +98,6 @@ class FetchMessage:
     enq_time = 0.0
     ts_backoff = 0.0
     latency_us = 0
-    status = MsgStatus.NOT_PERSISTED
 
     @property
     def value(self) -> Optional[bytes]:

@@ -1470,8 +1470,8 @@ done:
 
 static const char *const FM_SLOTS[] = {
     "topic", "partition", "offset", "timestamp", "timestamp_type",
-    "error", "_buf", "_v", "_k", "_h", NULL};
-enum { F_TOPIC, F_PART, F_OFFSET, F_TS, F_TSTYPE, F_ERROR,
+    "error", "status", "_buf", "_v", "_k", "_h", NULL};
+enum { F_TOPIC, F_PART, F_OFFSET, F_TS, F_TSTYPE, F_ERROR, F_STATUS,
        F_BUF, F_V, F_K, F_H, F_NSLOTS };
 static PyTypeObject *fm_type_cached = NULL;
 static Py_ssize_t fm_slot_off[F_NSLOTS];
@@ -1502,8 +1502,8 @@ static inline void fslot_set(PyObject *m, int slot, PyObject *v) {
 static PyObject *mod_materialize_v2_lazy(PyObject *Py_UNUSED(self),
                                          PyObject *const *args,
                                          Py_ssize_t nargs) {
-    if (nargs != 12) {
-        PyErr_SetString(PyExc_TypeError, "materialize_v2_lazy: 12 args");
+    if (nargs != 13) {
+        PyErr_SetString(PyExc_TypeError, "materialize_v2_lazy: 13 args");
         return NULL;
     }
     PyTypeObject *type = (PyTypeObject *)args[0];
@@ -1527,6 +1527,7 @@ static PyObject *mod_materialize_v2_lazy(PyObject *Py_UNUSED(self),
     PyObject *append_ts_obj = args[9];      // PyLong (shared, log_append)
     int log_append = (int)PyLong_AsLong(args[10]);
     PyObject *tstype = args[11];
+    PyObject *status = args[12];
     if (PyErr_Occurred()) { PyBuffer_Release(&rb); return NULL; }
     int64_t rblen = rb.len;
     PyBuffer_Release(&rb);   // `records` object itself is what we keep
@@ -1577,6 +1578,7 @@ static PyObject *mod_materialize_v2_lazy(PyObject *Py_UNUSED(self),
             fslot_set(m, F_TS, ts_o);
             Py_INCREF(tstype);   fslot_set(m, F_TSTYPE, tstype);
             Py_INCREF(Py_None);  fslot_set(m, F_ERROR, Py_None);
+            Py_INCREF(status);   fslot_set(m, F_STATUS, status);
             Py_INCREF(records);  fslot_set(m, F_BUF, records);
             fslot_set(m, F_V, v_o);
             fslot_set(m, F_K, k_o);
@@ -1623,6 +1625,113 @@ fail:
     Py_XDECREF(ts_memo);
     Py_XDECREF(part_obj);
     return NULL;
+}
+
+// materialize_arena_lazy(fm_type, base, klens, vlens, count, topic,
+//                        partition, base_offset, ts_ms, tstype,
+//                        status, error) -> list[FetchMessage]
+// The DR-path analog of materialize_v2_lazy: delivery-report messages
+// hold the arena batch's base buffer + packed offsets; key/value bytes
+// are created only if the app's DR callback reads them (most read
+// only error/offset/topic). Reference analog: DR event batching,
+// rd_kafka_event_message_array (rdkafka_event.c:33).
+static PyObject *mod_materialize_arena_lazy(PyObject *Py_UNUSED(self),
+                                            PyObject *const *args,
+                                            Py_ssize_t nargs) {
+    if (nargs != 12) {
+        PyErr_SetString(PyExc_TypeError, "materialize_arena_lazy: 12 args");
+        return NULL;
+    }
+    PyTypeObject *type = (PyTypeObject *)args[0];
+    if (!PyType_Check(args[0])) {
+        PyErr_SetString(PyExc_TypeError,
+                        "arg 0 must be the FetchMessage type");
+        return NULL;
+    }
+    if (type != fm_type_cached && resolve_fm_slots(type) < 0)
+        return NULL;
+    PyObject *base_obj = args[1];
+    Py_buffer base, kb, vb;
+    if (PyObject_GetBuffer(base_obj, &base, PyBUF_SIMPLE) < 0) return NULL;
+    if (PyObject_GetBuffer(args[2], &kb, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&base); return NULL;
+    }
+    if (PyObject_GetBuffer(args[3], &vb, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&base); PyBuffer_Release(&kb); return NULL;
+    }
+    int64_t count = PyLong_AsLongLong(args[4]);
+    PyObject *topic = args[5];
+    int64_t partition = PyLong_AsLongLong(args[6]);
+    int64_t base_off = PyLong_AsLongLong(args[7]);
+    PyObject *ts_obj = args[8];       // PyLong ms (shared)
+    PyObject *tstype = args[9];
+    PyObject *status = args[10];
+    PyObject *error = args[11];       // KafkaError | None (shared)
+    const int32_t *kl = (const int32_t *)kb.buf;
+    const int32_t *vl = (const int32_t *)vb.buf;
+    int64_t blen = base.len;
+    PyObject *list = NULL, *part_obj = NULL;
+    if (PyErr_Occurred()) goto done;
+    if (count < 0 || (int64_t)kb.len < count * 4
+        || (int64_t)vb.len < count * 4) {
+        PyErr_SetString(PyExc_ValueError, "materialize_arena_lazy: bad args");
+        goto done;
+    }
+    list = PyList_New(0);
+    part_obj = PyLong_FromLongLong(partition);
+    if (!list || !part_obj) goto fail;
+    {
+        int64_t off = 0;
+        for (int64_t i = 0; i < count; i++) {
+            int64_t k_len = kl[i], v_len = vl[i];
+            int64_t need = (k_len > 0 ? k_len : 0) + (v_len > 0 ? v_len : 0);
+            if (off + need > blen) {
+                PyErr_SetString(PyExc_ValueError,
+                                "materialize_arena_lazy: short base");
+                goto fail;
+            }
+            PyObject *m = type->tp_alloc(type, 0);
+            if (!m) goto fail;
+            PyObject *k_o, *v_o;
+            if (k_len >= 0) {
+                k_o = PyLong_FromLongLong((off << 32) | k_len);
+                off += k_len;
+            } else { k_o = Py_None; Py_INCREF(k_o); }
+            if (v_len >= 0) {
+                v_o = PyLong_FromLongLong((off << 32) | v_len);
+                off += v_len;
+            } else { v_o = Py_None; Py_INCREF(v_o); }
+            PyObject *off_o = PyLong_FromLongLong(
+                base_off >= 0 ? base_off + i : -1);
+            if (!k_o || !v_o || !off_o) {
+                Py_XDECREF(k_o); Py_XDECREF(v_o); Py_XDECREF(off_o);
+                Py_DECREF(m); goto fail;
+            }
+            Py_INCREF(topic);    fslot_set(m, F_TOPIC, topic);
+            Py_INCREF(part_obj); fslot_set(m, F_PART, part_obj);
+            fslot_set(m, F_OFFSET, off_o);
+            Py_INCREF(ts_obj);   fslot_set(m, F_TS, ts_obj);
+            Py_INCREF(tstype);   fslot_set(m, F_TSTYPE, tstype);
+            Py_INCREF(error);    fslot_set(m, F_ERROR, error);
+            Py_INCREF(status);   fslot_set(m, F_STATUS, status);
+            Py_INCREF(base_obj); fslot_set(m, F_BUF, base_obj);
+            fslot_set(m, F_V, v_o);
+            fslot_set(m, F_K, k_o);
+            Py_INCREF(Py_None);  fslot_set(m, F_H, Py_None);
+            PyObject_GC_UnTrack(m);   // acyclic leaves only
+            if (PyList_Append(list, m) < 0) { Py_DECREF(m); goto fail; }
+            Py_DECREF(m);
+        }
+    }
+    goto done;
+fail:
+    Py_CLEAR(list);
+done:
+    Py_XDECREF(part_obj);
+    PyBuffer_Release(&base);
+    PyBuffer_Release(&kb);
+    PyBuffer_Release(&vb);
+    return list;
 }
 
 // Delivery cursor: the consumer app thread's per-message walk
@@ -1768,6 +1877,11 @@ static PyMethodDef module_methods[] = {
     {"cursor_new", (PyCFunction)(void (*)(void))mod_cursor_new,
      METH_FASTCALL,
      "cursor_new(tp, msgs, ver, key) -> delivery Cursor"},
+    {"materialize_arena_lazy",
+     (PyCFunction)(void (*)(void))mod_materialize_arena_lazy,
+     METH_FASTCALL,
+     "materialize_arena_lazy(...) -> list[FetchMessage] (DR path; "
+     "key/value created lazily from the arena base buffer)"},
     {"crc32c_many", (PyCFunction)(void (*)(void))mod_crc32c_many,
      METH_FASTCALL, "crc32c_many(buffers) -> list[int] (no join copy)"},
     {"decompress_many", (PyCFunction)(void (*)(void))mod_decompress_many,

@@ -186,7 +186,10 @@ class TpuCodecProvider:
             tempfile.gettempdir(),
             f"tk_transport_{os.getuid()}_{key.replace(',', '-')}.json")
         try:
-            if time.time() - os.stat(cache).st_mtime < self._PROBE_CACHE_TTL:
+            st = os.stat(cache)
+            # /tmp is world-writable: only trust a file we own
+            if (st.st_uid == os.getuid()
+                    and time.time() - st.st_mtime < self._PROBE_CACHE_TTL):
                 with open(cache) as f:
                     self.transport_mb_s = float(json.load(f)["mb_s"])
                 return self.transport_mb_s

@@ -475,7 +475,7 @@ def parse_fetch_messages_v2(info: BatchInfo, records_bytes: bytes,
         out, total, fixups = lazy(
             FetchMessage, records_bytes, fields.ctypes.data, n, topic,
             partition, base_off, fo, base_ts, append_ts,
-            1 if log_append else 0, tstype)
+            1 if log_append else 0, tstype, not_persisted)
         if fixups is not None:
             for idx, ho, nh in fixups:
                 out[idx]._h = _parse_headers(records_bytes, ho, nh)

@@ -141,3 +141,80 @@ def test_interceptors_still_demote():
     finally:
         p.close()
         cluster.stop()
+
+
+def test_dr_batch_cb_one_call_per_batch_lazy_payloads():
+    """dr_batch_cb (r5): ONE callback per delivered batch with the full
+    Message list — the rd_kafka_event_DR message-array contract
+    (reference rdkafka_event.c:33) as a direct callback. Messages are
+    lazy (key/value materialize on access) and carry contiguous
+    offsets, PERSISTED status and error=None on success."""
+    from librdkafka_tpu.client.msg import MsgStatus
+    cluster = MockCluster(num_brokers=1, topics={"bdr": 1})
+    batches = []
+    p = _mk({"dr_batch_cb": lambda msgs: batches.append(msgs)}, cluster,
+            **{"linger.ms": 20})
+    try:
+        for i in range(40):
+            p.produce("bdr", value=b"v%03d" % i, key=b"k%03d" % i,
+                      partition=0)
+        assert p.flush(20.0) == 0
+        assert sum(len(b) for b in batches) == 40
+        assert len(batches) < 40, "callback must batch, not fire per msg"
+        seen = []
+        for b in batches:
+            for m in b:
+                assert m.error is None
+                assert m.status == MsgStatus.PERSISTED
+                assert m.topic == "bdr" and m.partition == 0
+                assert m.value == b"v%03d" % len(seen)
+                assert m.key == b"k%03d" % len(seen)
+                seen.append(m.offset)
+        assert seen == list(range(40))      # contiguous batch offsets
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_dr_batch_cb_error_batches():
+    """Failed deliveries reach dr_batch_cb with the error stamped on
+    every message and the original payloads intact (timeout path)."""
+    cluster = MockCluster(num_brokers=1, topics={"bde": 1})
+    batches = []
+    p = _mk({"dr_batch_cb": lambda msgs: batches.append(msgs)}, cluster,
+            **{"message.timeout.ms": 400, "linger.ms": 5})
+    try:
+        cluster.set_broker_down(1)
+        for i in range(5):
+            p.produce("bde", value=b"x%d" % i, partition=0)
+        deadline = time.monotonic() + 10
+        while sum(len(b) for b in batches) < 5 \
+                and time.monotonic() < deadline:
+            p.poll(0.2)
+        got = [m for b in batches for m in b]
+        assert len(got) == 5
+        for i, m in enumerate(got):
+            assert m.error is not None and m.error.code == Err._MSG_TIMED_OUT
+            assert m.value == b"x%d" % i
+            assert m.offset < 0      # no assigned offset (-1/-1001)
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_dr_batch_cb_composes_with_dr_msg_cb():
+    """Both callbacks set: the batch callback fires once per batch AND
+    the per-message callback fires per message."""
+    cluster = MockCluster(num_brokers=1, topics={"bdc": 1})
+    batch_n, msg_n = [0], [0]
+    p = _mk({"dr_batch_cb": lambda msgs: batch_n.__setitem__(0, batch_n[0] + len(msgs)),
+             "dr_msg_cb": lambda e, m: msg_n.__setitem__(0, msg_n[0] + 1)},
+            cluster)
+    try:
+        for i in range(30):
+            p.produce("bdc", value=b"c%d" % i, partition=0)
+        assert p.flush(20.0) == 0
+        assert batch_n[0] == 30 and msg_n[0] == 30
+    finally:
+        p.close()
+        cluster.stop()
