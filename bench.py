@@ -265,7 +265,14 @@ def codec_offload():
     # differencing) swung 5x run-to-run through the shared tunnel.
     import jax.numpy as jnp
 
-    stack = jax.device_put(np.stack([data, data[::-1].copy()] * 5))  # (10,B,N)
+    # 10 DISTINCT 8MB buffers (r4 verdict weak #1: cycling 2 distinct
+    # payloads let the whole working set live in VMEM — 2 x 8MB is
+    # exactly the v5e VMEM — and the "device time" beat the kernel's
+    # own HBM traffic floor; with 80MB of distinct data every
+    # iteration must stream from HBM)
+    stack = jax.device_put(np.stack(
+        [data] + [rng.integers(0, 256, data.shape, dtype=np.uint8)
+                  for _ in range(9)]))           # (10, B, N)
 
     def make_multi(R):
         def multi(st, terms):
@@ -307,6 +314,17 @@ def codec_offload():
         pass
 
     mb = B * blk / (1 << 20)
+    # achieved-bandwidth % and MFU (r4 verdict #2): the plane-split
+    # kernel's HBM traffic is 8 streaming reads of the raw bytes (one
+    # per bit plane — the expansion fuses into each dot's operand
+    # load); useful work is 8 int8 dots of (B,N)x(N,32). v5e-1 peaks:
+    # ~819 GB/s HBM, ~394 TOPS int8.
+    HBM_GB_S, INT8_TOPS = 819.0, 394.0
+    traffic_gb = 8 * B * blk / 1e9
+    tops = 8 * 2 * B * blk * 32 / 1e12
+    dev_s = tpu_crc_ms / 1000
+    bw_pct = 100.0 * (traffic_gb / dev_s) / HBM_GB_S
+    mfu_pct = 100.0 * (tops / dev_s) / INT8_TOPS
     return {
         "cpu_crc_ms": round(cpu_ms, 3),
         "cpu_crc_ms_median": round(cpu_ms_median, 3),
@@ -314,6 +332,8 @@ def codec_offload():
         "tpu_crc_mb_s": round(mb / (tpu_crc_ms / 1000), 1),
         "cpu_crc_mb_s": round(mb / (cpu_ms / 1000), 1),
         "speedup": round(cpu_ms / tpu_crc_ms, 3),
+        "crc_bw_pct_of_hbm": round(bw_pct, 1),
+        "crc_mfu_pct": round(mfu_pct, 2),
         "rtt_ms": round(rtt1, 1),
         "transport_mb_s": round(transport_mb_s, 2),
         "lz4_device_ms_4x64k": round(lz4_ms, 1) if lz4_ms else None,

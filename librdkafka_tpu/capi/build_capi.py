@@ -25,8 +25,13 @@ typedef struct tk_msg {
     char   *payload;    /* NULL only for null-value records */
     size_t  len;
     int     err;        /* 0 = ok */
-    char   *headers;    /* JSON [[name, value|null], ...] (values are
-                         * latin-1-mapped bytes); NULL when none */
+    /* First-class headers (reference rd_kafka_header_get_all): raw
+     * byte values, no escaping. All arrays are owned by the message
+     * and freed by tk_msg_free. */
+    int     hdr_cnt;
+    char  **hdr_names;    /* NUL-terminated utf-8 names */
+    char  **hdr_vals;     /* raw bytes (NUL-padded); NULL = null value */
+    size_t *hdr_val_lens;
 } tk_msg_t;
 
 /* Handles are opaque integers (0 = error; details in errstr). */
@@ -36,6 +41,15 @@ typedef long long tk_handle_t;
  * err 0 = delivered; opaque is the value passed to tk_produce2. */
 typedef void (*tk_dr_cb_t)(long long opaque, int err,
                            int32_t partition, int64_t offset);
+
+/* Observability callbacks (reference rd_kafka_conf_set_log_cb /
+ * _set_error_cb / _set_stats_cb). Strings are valid only for the
+ * duration of the call — copy if you keep them. They fire on the
+ * thread that calls tk_poll/tk_flush (log may also fire on internal
+ * threads, like the reference's non-queued log_cb). */
+typedef void (*tk_log_cb_t)(int level, const char *fac, const char *msg);
+typedef void (*tk_error_cb_t)(int err, const char *reason);
+typedef void (*tk_stats_cb_t)(const char *json_str);
 """
 
 FUNCS = r"""
@@ -102,6 +116,34 @@ extern int  tk_purge(tk_handle_t h, int in_queue, int in_flight);
 extern int  tk_metadata_json(tk_handle_t h, char *buf, int size,
                              int timeout_ms);
 extern int  tk_conf_dump_json(tk_handle_t h, char *buf, int size);
+
+/* --- r5: callbacks, per-property conf, admin breadth (reference
+ *     rdkafka.h: conf_set/conf_get, log/error/stats callbacks,
+ *     DescribeConfigs/AlterConfigs/CreatePartitions, ListGroups/
+ *     DescribeGroups) --- */
+extern int  tk_set_log_cb(tk_handle_t h, tk_log_cb_t cb);
+extern int  tk_set_error_cb(tk_handle_t h, tk_error_cb_t cb);
+extern int  tk_set_stats_cb(tk_handle_t h, tk_stats_cb_t cb);
+extern int  tk_conf_set(tk_handle_t h, const char *name,
+                        const char *value);
+extern int  tk_conf_get(tk_handle_t h, const char *name,
+                        char *buf, int size);
+/* restype: 2 = TOPIC, 4 = BROKER, 3 = GROUP (reference
+ * rd_kafka_ResourceType_t). describe fills JSON {name: value}. */
+extern int  tk_describe_configs(tk_handle_t h, int restype,
+                                const char *name, char *buf, int size,
+                                int timeout_ms);
+extern int  tk_alter_configs(tk_handle_t h, int restype,
+                             const char *name, const char *conf_json,
+                             int timeout_ms);
+extern int  tk_create_partitions(tk_handle_t h, const char *topic,
+                                 int new_total, int timeout_ms);
+/* JSON [[group_id, protocol_type], ...] */
+extern int  tk_list_groups(tk_handle_t h, char *buf, int size,
+                           int timeout_ms);
+/* JSON {state, protocol_type, protocol, members: [...]} */
+extern int  tk_describe_group(tk_handle_t h, const char *group,
+                              char *buf, int size, int timeout_ms);
 """
 
 CDEF = TYPES + FUNCS
@@ -464,7 +506,10 @@ def tk_consumer_poll(h, timeout_ms, out):
     out.topic = ffi.NULL
     out.key = ffi.NULL
     out.payload = ffi.NULL
-    out.headers = ffi.NULL
+    out.hdr_cnt = 0
+    out.hdr_names = ffi.NULL
+    out.hdr_vals = ffi.NULL
+    out.hdr_val_lens = ffi.NULL
     out.key_len = 0
     out.len = 0
     out.partition = -1
@@ -499,12 +544,26 @@ def tk_consumer_poll(h, timeout_ms, out):
     else:
         out.payload = lib_memdup(m.value)
         out.len = len(m.value)
-    if m.headers:
-        # JSON [[name, value|null], ...]; byte values are latin-1-
-        # mapped (lossless 0-255 <-> codepoint) for C-side parsing
-        hs = [[k, v.decode("latin-1") if isinstance(v, bytes) else v]
-              for k, v in m.headers]
-        out.headers = lib_strdup(json.dumps(hs).encode())
+    hs = m.headers
+    if hs:
+        # first-class header arrays, raw byte values (reference
+        # rd_kafka_header_get_all — no JSON, no escaping)
+        n = len(hs)
+        names = ffi.new("char*[]", n)
+        vals = ffi.new("char*[]", n)
+        lens = ffi.new("size_t[]", n)
+        for i, (hk, hv) in enumerate(hs):
+            names[i] = lib_strdup(hk.encode())
+            if hv is None:
+                vals[i] = ffi.NULL
+                lens[i] = 0
+            else:
+                vals[i] = lib_memdup(hv)
+                lens[i] = len(hv)
+        out.hdr_cnt = n
+        out.hdr_names = _track(names)
+        out.hdr_vals = _track(vals)
+        out.hdr_val_lens = _track(lens)
     return 1
 
 
@@ -523,6 +582,11 @@ def lib_strdup(b):
     return buf
 
 
+def _track(cdata):
+    _allocs[int(ffi.cast("intptr_t", cdata))] = cdata
+    return cdata
+
+
 def _release(ptr):
     if ptr != ffi.NULL:
         _allocs.pop(int(ffi.cast("intptr_t", ptr)), None)
@@ -533,8 +597,18 @@ def tk_msg_free(m):
     _release(m.topic)
     _release(m.key)
     _release(m.payload)
-    _release(m.headers)
-    m.topic = m.key = m.payload = m.headers = ffi.NULL
+    for i in range(m.hdr_cnt):
+        if m.hdr_names != ffi.NULL:
+            _release(m.hdr_names[i])
+        if m.hdr_vals != ffi.NULL:
+            _release(m.hdr_vals[i])
+    _release(ffi.cast("char *", m.hdr_names))
+    _release(ffi.cast("char *", m.hdr_vals))
+    _release(ffi.cast("char *", m.hdr_val_lens))
+    m.topic = m.key = m.payload = ffi.NULL
+    m.hdr_names = m.hdr_vals = ffi.NULL
+    m.hdr_val_lens = ffi.NULL
+    m.hdr_cnt = 0
 
 
 @ffi.def_extern()
@@ -561,6 +635,8 @@ def tk_destroy(h):
     obj = _handles.pop(h, None)
     _dr_cbs.pop(h, None)   # handle ids are never reused: drop the DR
                            # trampoline or registrations leak forever
+    for kind in ("log", "err", "stats"):
+        _obs_cbs.pop((h, kind), None)
     if obj is not None:
         try:
             obj.close()
@@ -730,6 +806,190 @@ def tk_conf_dump_json(h, buf, size):
                                         type(None))) else repr(v))
                 for k, v in d.items()}
         return _write_cstr(buf, size, json.dumps(safe))
+    except Exception:
+        return -1
+
+
+# ---- r5: observability callbacks, per-property conf, admin breadth ----
+
+_obs_cbs = {}     # (handle, kind) -> C function pointer
+
+
+@ffi.def_extern()
+def tk_set_log_cb(h, cb):
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    _obs_cbs[(h, "log")] = cb
+
+    from librdkafka_tpu.client.kafka import Kafka as _K
+
+    def log_cb(level, fac, msg, _h=h):
+        c = _obs_cbs.get((_h, "log"))
+        if c is None or c == ffi.NULL:
+            return
+        lv = (level if isinstance(level, int)
+              else _K._LOG_LEVELS.get(level, 6))
+        c(lv, ffi.new("char[]", str(fac).encode() + b"\0"),
+          ffi.new("char[]", str(msg).encode() + b"\0"))
+    obj._rk.conf.set("log_cb", log_cb)
+    obj._rk.log_cb = log_cb        # live handles read the cached ref
+    return 0
+
+
+@ffi.def_extern()
+def tk_set_error_cb(h, cb):
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    _obs_cbs[(h, "err")] = cb
+
+    def error_cb(err, _h=h):
+        c = _obs_cbs.get((_h, "err"))
+        if c is None or c == ffi.NULL:
+            return
+        c(int(err.code), ffi.new("char[]", str(err).encode() + b"\0"))
+    obj._rk.conf.set("error_cb", error_cb)
+    return 0
+
+
+@ffi.def_extern()
+def tk_set_stats_cb(h, cb):
+    # fires from tk_poll/tk_flush once statistics.interval.ms elapses
+    # (set it in conf_json at creation, or via tk_conf_set)
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    _obs_cbs[(h, "stats")] = cb
+
+    def stats_cb(blob, _h=h):
+        c = _obs_cbs.get((_h, "stats"))
+        if c is None or c == ffi.NULL:
+            return
+        c(ffi.new("char[]", blob.encode() + b"\0"))
+    obj._rk.conf.set("stats_cb", stats_cb)
+    return 0
+
+
+@ffi.def_extern()
+def tk_conf_set(h, name, value):
+    # per-property set on the live handle (reference rd_kafka_conf_set;
+    # post-creation mutation revalidates cached eligibility decisions
+    # through the conf listeners)
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    try:
+        obj._rk.conf.set(ffi.string(name).decode(),
+                         ffi.string(value).decode())
+        return 0
+    except Exception:
+        return -2
+
+
+@ffi.def_extern()
+def tk_conf_get(h, name, buf, size):
+    obj = _handles.get(h)
+    if obj is None:
+        return -1
+    try:
+        v = obj._rk.conf.get(ffi.string(name).decode())
+        if isinstance(v, bool):
+            v = "true" if v else "false"
+        return _write_cstr(buf, size, str(v))
+    except Exception:
+        return -2
+
+
+def _restype_obj(restype, name):
+    from librdkafka_tpu.client.admin import ConfigResource
+    return ConfigResource(int(restype), ffi.string(name).decode())
+
+
+@ffi.def_extern()
+def tk_describe_configs(h, restype, name, buf, size, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        r = _restype_obj(restype, name)
+        futs = a.describe_configs([r],
+                                  operation_timeout=timeout_ms / 1000.0)
+        entries = futs[r].result(timeout_ms / 1000.0)
+        return _write_cstr(buf, size, json.dumps(
+            {n: e.value for n, e in entries.items()}))
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_alter_configs(h, restype, name, conf_json, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        r = _restype_obj(restype, name)
+        for k, v in json.loads(ffi.string(conf_json).decode()).items():
+            r.set_config(k, v)
+        futs = a.alter_configs([r],
+                               operation_timeout=timeout_ms / 1000.0)
+        futs[r].result(timeout_ms / 1000.0)
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_create_partitions(h, topic, new_total, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        from librdkafka_tpu.client.admin import NewPartitions
+        futs = a.create_partitions(
+            [NewPartitions(ffi.string(topic).decode(), int(new_total))],
+            operation_timeout=timeout_ms / 1000.0)
+        for f in futs.values():
+            f.result(timeout_ms / 1000.0)
+        return 0
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_list_groups(h, buf, size, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        fut = a.list_groups(operation_timeout=timeout_ms / 1000.0)
+        return _write_cstr(buf, size,
+                           json.dumps(fut.result(timeout_ms / 1000.0)))
+    except Exception:
+        return -1
+
+
+def _jsonable(v):
+    if isinstance(v, bytes):
+        return v.decode("latin-1")
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return v
+
+
+@ffi.def_extern()
+def tk_describe_group(h, group, buf, size, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        g = ffi.string(group).decode()
+        futs = a.describe_groups([g],
+                                 operation_timeout=timeout_ms / 1000.0)
+        info = futs[g].result(timeout_ms / 1000.0)
+        return _write_cstr(buf, size, json.dumps(_jsonable(info)))
     except Exception:
         return -1
 """

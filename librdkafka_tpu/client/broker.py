@@ -1460,11 +1460,23 @@ class Broker:
 
     def _handle_fetch(self, err, resp, versions, parts):
         self.fetch_inflight_cnt = max(0, self.fetch_inflight_cnt - 1)
-        # clear the in-flight claims FIRST (a parse error below must
-        # not strand partitions unfetchable); deferred entries re-claim
-        # theirs before parking
-        for tp in parts:
-            tp.fetch_in_flight = False
+        # in-flight claim discipline: OK partitions stay claimed
+        # continuously from request to deferred-entry processing (a
+        # clear-then-reclaim window would let another broker double-
+        # fetch the same offsets mid-migration); everything else —
+        # errored partitions, stale versions, and ANY exception before
+        # the ok-list is final — releases in _handle_fetch0's finally.
+        ok_final = None
+        try:
+            ok_final = self._handle_fetch0(err, resp, versions, parts)
+        finally:
+            keep = ({id(e[0]) for e in ok_final}
+                    if ok_final is not None else set())
+            for tp in parts:
+                if id(tp) not in keep:
+                    tp.fetch_in_flight = False
+
+    def _handle_fetch0(self, err, resp, versions, parts):
         if err is not None:
             # a failed fetch to a FOLLOWER falls back to the leader
             # (reference reverts the preferred replica on errors) —
@@ -1559,7 +1571,7 @@ class Broker:
                     tp.fetch_backoff_until = time.monotonic() + \
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
         if not ok:
-            return
+            return None
         # phases B-D run PER PARTITION with decompressed-ahead flow
         # control (r5). Two measured pathologies of whole-response
         # batching: (a) a 1MB-wire partition can decompress to tens of
@@ -1575,12 +1587,11 @@ class Broker:
         # fetchq bound, applied at the decompress stage). Within a
         # partition, CRC and decompress still run as BATCHED provider
         # calls over its ~10 batches — the offload seam's launch axis.
-        for e in ok:
-            # re-claim while parked so no broker re-fetches the same
-            # offsets; _serve_deferred_fetch releases at process time
-            e[0].fetch_in_flight = True
+        # entries park still-claimed (no other broker may re-fetch the
+        # same offsets); _serve_deferred_fetch releases at process time
         self._fetch_deferred.extend(ok)
         self._serve_deferred_fetch()
+        return ok
 
     def _queued_fetch_bytes(self) -> int:
         return sum(tp.fetchq_bytes for tp in self.toppars)

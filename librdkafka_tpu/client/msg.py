@@ -83,9 +83,11 @@ class FetchMessage:
     points into the fetch buffer the same way,
     rdkafka_msgset_reader.c:715).
 
-    Producer-only fields (msgid, retries, status, ...) are class-level
-    constants — consumer apps can read them, nothing ever sets them on
-    fetched messages."""
+    Also the delivery-report message for fast-lane batches
+    (materialize_arena_lazy): ``status`` and ``error`` are per-instance
+    slots stamped per batch at materialization. The remaining
+    producer-internal fields (msgid, retries, on_delivery, ...) are
+    class-level constants — readable, never set on these messages."""
 
     __slots__ = ("topic", "partition", "offset", "timestamp",
                  "timestamp_type", "error", "status",

@@ -197,6 +197,8 @@ class MockCluster:
         self._throttle_ms: dict[int, int] = {}        # broker_id -> report
         self._down: set[int] = set()
         self.request_log: list[tuple[int, int]] = []  # (broker_id, api_key)
+        # AlterConfigs store: (resource_type, name) -> {conf: value}
+        self._resource_configs: dict[tuple, dict] = {}
 
         self._listeners: dict[int, socket.socket] = {}
         self._ports: dict[int, int] = {}
@@ -1016,27 +1018,42 @@ class MockCluster:
                             "error_message": None})
         return {"throttle_time_ms": 0, "topics": out}
 
+    _CONFIG_DEFAULTS = {"retention.ms": "604800000",
+                        "cleanup.policy": "delete"}
+
     def _h_DescribeConfigs(self, conn, corrid, hdr, body, inject):
         out = []
-        for r in body["resources"]:
-            entries = [{"name": "retention.ms", "value": "604800000",
-                        "read_only": False, "source": 5, "sensitive": False,
-                        "synonyms": []},
-                       {"name": "cleanup.policy", "value": "delete",
-                        "read_only": False, "source": 5, "sensitive": False,
-                        "synonyms": []}]
-            out.append({"error_code": inject.wire if inject else 0,
-                        "error_message": None,
-                        "resource_type": r["resource_type"],
-                        "resource_name": r["resource_name"],
-                        "entries": entries})
+        with self._lock:
+            for r in body["resources"]:
+                key = (r["resource_type"], r["resource_name"])
+                merged = dict(self._CONFIG_DEFAULTS)
+                merged.update(self._resource_configs.get(key, {}))
+                entries = [{"name": n, "value": v, "read_only": False,
+                            "source": 5, "sensitive": False,
+                            "synonyms": []}
+                           for n, v in sorted(merged.items())]
+                out.append({"error_code": inject.wire if inject else 0,
+                            "error_message": None,
+                            "resource_type": r["resource_type"],
+                            "resource_name": r["resource_name"],
+                            "entries": entries})
         return {"throttle_time_ms": 0, "resources": out}
 
     def _h_AlterConfigs(self, conn, corrid, hdr, body, inject):
-        out = [{"error_code": inject.wire if inject else 0,
-                "error_message": None, "resource_type": r["resource_type"],
-                "resource_name": r["resource_name"]}
-               for r in body["resources"]]
+        # stateful like a real broker: altered entries are visible to a
+        # following DescribeConfigs
+        out = []
+        with self._lock:
+            for r in body["resources"]:
+                key = (r["resource_type"], r["resource_name"])
+                if not (inject and inject.wire):
+                    store = self._resource_configs.setdefault(key, {})
+                    for e in r.get("entries") or []:
+                        store[e["name"]] = e["value"]
+                out.append({"error_code": inject.wire if inject else 0,
+                            "error_message": None,
+                            "resource_type": r["resource_type"],
+                            "resource_name": r["resource_name"]})
         return {"throttle_time_ms": 0, "resources": out}
 
     def _h_DescribeGroups(self, conn, corrid, hdr, body, inject):

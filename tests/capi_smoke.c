@@ -24,13 +24,44 @@ static void on_dr(long long opaque, int err, int32_t partition,
     else dr_err++;
 }
 
+static int stats_seen = 0;
+static void on_stats(const char *json_str) {
+    if (json_str && strstr(json_str, "\"brokers\"")) stats_seen++;
+}
+
+static int log_seen = 0;
+static void on_log(int level, const char *fac, const char *msg) {
+    (void)level; (void)fac; (void)msg;
+    log_seen++;
+}
+
 int main(void) {
     char errstr[512];
     tk_handle_t p = tk_producer_new(
         "{\"bootstrap.servers\": \"\", \"test.mock.num.brokers\": 1,"
-        " \"linger.ms\": 5, \"compression.codec\": \"lz4\"}",
+        " \"linger.ms\": 5, \"compression.codec\": \"lz4\","
+        " \"statistics.interval.ms\": 100}",
         errstr, sizeof(errstr));
     if (!p) { fprintf(stderr, "producer_new: %s\n", errstr); return 1; }
+
+    /* --- 0. observability callbacks + per-property conf ------------- */
+    if (tk_set_stats_cb(p, on_stats) != 0) {
+        fprintf(stderr, "set_stats_cb\n"); return 1;
+    }
+    if (tk_set_log_cb(p, on_log) != 0) {
+        fprintf(stderr, "set_log_cb\n"); return 1;
+    }
+    if (tk_conf_set(p, "linger.ms", "10") != 0) {
+        fprintf(stderr, "conf_set\n"); return 1;
+    }
+    char cv[64];
+    if (tk_conf_get(p, "linger.ms", cv, sizeof cv) <= 0
+        || strncmp(cv, "10", 2) != 0) {
+        fprintf(stderr, "conf_get linger.ms = %s\n", cv); return 1;
+    }
+    if (tk_conf_set(p, "no.such.property", "x") == 0) {
+        fprintf(stderr, "conf_set accepted junk\n"); return 1;
+    }
 
     /* --- 1. admin: create the topic over the wire ------------------- */
     if (tk_create_topic(p, "ctopic", 2, 10000) != 0) {
@@ -39,17 +70,18 @@ int main(void) {
 
     /* --- 2. produce with headers/timestamp/opaque + DR callback ----- */
     if (tk_set_dr_cb(p, on_dr) != 0) { fprintf(stderr, "set_dr_cb\n"); return 1; }
-    const char *hn[2] = {"source", "seq"};
+    const char *hn[3] = {"source", "seq", "bin"};
+    static const char binval[3] = {'\0', (char)0xff, 'x'};
     char payload[64], key[16], seqv[16];
     for (int i = 0; i < 25; i++) {
         snprintf(payload, sizeof(payload), "c-api-message-%03d", i);
         snprintf(key, sizeof(key), "k%d", i);
         snprintf(seqv, sizeof(seqv), "%d", i);
-        const char *hv[2] = {"capi-smoke", seqv};
-        size_t hl[2] = {strlen("capi-smoke"), strlen(seqv)};
+        const char *hv[3] = {"capi-smoke", seqv, binval};
+        size_t hl[3] = {strlen("capi-smoke"), strlen(seqv), 3};
         if (tk_produce2(p, "ctopic", i % 2, key, strlen(key),
                         payload, strlen(payload),
-                        0 /* timestamp: now */, hn, hv, hl, 2,
+                        0 /* timestamp: now */, hn, hv, hl, 3,
                         (long long)i /* opaque */) != 0) {
             fprintf(stderr, "produce2 %d failed\n", i); return 1;
         }
@@ -93,7 +125,7 @@ int main(void) {
     if (!c) { fprintf(stderr, "consumer_new: %s\n", errstr); return 1; }
     if (tk_subscribe(c, "ctopic") != 0) return 1;
 
-    int got = 0, with_headers = 0, polls = 0;
+    int got = 0, with_headers = 0, bin_ok = 0, polls = 0;
     while (got < 30 && polls++ < 600) {
         tk_msg_t m;
         int r = tk_consumer_poll(c, 100, &m);
@@ -101,14 +133,27 @@ int main(void) {
         if (r == 1) {
             if (m.err == 0) {
                 got++;
-                if (m.headers && strstr(m.headers, "capi-smoke"))
-                    with_headers++;
+                /* first-class header arrays: raw bytes, no escaping */
+                for (int i = 0; i < m.hdr_cnt; i++) {
+                    if (strcmp(m.hdr_names[i], "source") == 0
+                        && m.hdr_val_lens[i] == strlen("capi-smoke")
+                        && memcmp(m.hdr_vals[i], "capi-smoke",
+                                  m.hdr_val_lens[i]) == 0)
+                        with_headers++;
+                    if (strcmp(m.hdr_names[i], "bin") == 0
+                        && m.hdr_val_lens[i] == 3
+                        && memcmp(m.hdr_vals[i], binval, 3) == 0)
+                        bin_ok++;
+                }
             }
             tk_msg_free(&m);
         }
     }
     if (got != 30) { fprintf(stderr, "phase4 got %d/30\n", got); return 1; }
     if (with_headers == 0) { fprintf(stderr, "no headers seen\n"); return 1; }
+    if (bin_ok == 0) {
+        fprintf(stderr, "binary header did not round-trip raw\n"); return 1;
+    }
     if (tk_commit(c, 0) != 0) { fprintf(stderr, "commit\n"); return 1; }
 
     long long c0 = tk_committed(c, "ctopic", 0, 5000);
@@ -201,14 +246,47 @@ int main(void) {
     if (tk_purge(p, 1, 0) != 0) {
         fprintf(stderr, "purge failed\n"); return 1;
     }
+
+    /* --- 8. r5 surface: stats cb, configs admin, group admin --------- */
+    /* stats.interval=100ms: tk_poll serves the stats op -> C callback */
+    for (int i = 0; i < 50 && !stats_seen; i++) tk_poll(p, 100);
+    if (!stats_seen) { fprintf(stderr, "stats callback never fired\n"); return 1; }
+
+    char dbuf[8192];
+    if (tk_describe_configs(p, 2 /* TOPIC */, "ctopic",
+                            dbuf, sizeof dbuf, 10000) <= 0
+        || dbuf[0] != '{') {
+        fprintf(stderr, "describe_configs: %s\n", dbuf); return 1;
+    }
+    if (tk_alter_configs(p, 2, "ctopic",
+                         "{\"retention.bytes\": \"123456\"}", 10000) != 0) {
+        fprintf(stderr, "alter_configs failed\n"); return 1;
+    }
+    if (tk_describe_configs(p, 2, "ctopic", dbuf, sizeof dbuf, 10000) <= 0
+        || !strstr(dbuf, "123456")) {
+        fprintf(stderr, "altered config not visible: %s\n", dbuf); return 1;
+    }
+    if (tk_create_partitions(p, "ctopic", 4, 10000) != 0) {
+        fprintf(stderr, "create_partitions failed\n"); return 1;
+    }
+    char gbuf[8192];
+    if (tk_list_groups(p, gbuf, sizeof gbuf, 10000) <= 0
+        || !strstr(gbuf, "gc")) {
+        fprintf(stderr, "list_groups: %s\n", gbuf); return 1;
+    }
+    if (tk_describe_group(p, "gc", gbuf, sizeof gbuf, 10000) <= 0
+        || !strstr(gbuf, "state")) {
+        fprintf(stderr, "describe_group: %s\n", gbuf); return 1;
+    }
     tk_destroy(c2);
 
     if (tk_delete_topic(p, "ctopic", 10000) != 0) {
         fprintf(stderr, "delete_topic failed\n"); return 1;
     }
     tk_destroy(p);
-    printf("CAPI-OK produce2+headers+dr=%lld batch=%lld consume+commit+"
+    printf("CAPI-OK produce2+rawheaders+dr=%lld batch=%lld consume+commit+"
            "resume+seek+admin+watermarks+times+position+pause+metadata+"
-           "confdump+purge v=%s all pass\n", dr_ok, nb, vbuf);
+           "confdump+purge+stats=%d+configs+groups v=%s all pass\n",
+           dr_ok, nb, stats_seen, vbuf);
     return 0;
 }

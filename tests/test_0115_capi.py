@@ -57,3 +57,28 @@ def test_header_is_self_contained(libtkafka):
             check=True, capture_output=True)
     finally:
         os.unlink(src)
+
+
+def test_cpp_wrapper_round_trip(libtkafka):
+    """The C++ RAII wrapper (tkafka.hpp, the src-cpp/rdkafkacpp.h
+    analog): compile examples/cpp_client.cpp with g++ and run the full
+    produce->consume round trip — DeliveryReportCb, EventCb (stats),
+    raw-byte headers, commit/committed."""
+    exe = os.path.join(build_capi.HERE, "cpp_client")
+    src = os.path.join(os.path.dirname(HERE), "examples", "cpp_client.cpp")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-o", exe, src,
+         "-I", build_capi.HERE,
+         "-L", build_capi.HERE, "-ltkafka",
+         f"-Wl,-rpath,{build_capi.HERE}",
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(HERE) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "CPP-OK" in r.stdout
