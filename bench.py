@@ -153,7 +153,8 @@ def host_pipeline(n_msgs: int, size: int, toppars: int,
     return rate
 
 
-def consumer_pipeline(n_msgs: int, size: int, toppars: int) -> float:
+def consumer_pipeline(n_msgs: int, size: int, toppars: int,
+                      codec: str = "lz4") -> float:
     """End-to-end consumer msgs/s with check.crcs (batched fetch-side
     CRC verify + decompress; the rdkafka_performance -C analog /
     BASELINE config 4) against the external mock."""
@@ -162,7 +163,7 @@ def consumer_pipeline(n_msgs: int, size: int, toppars: int) -> float:
     from librdkafka_tpu import Consumer, Producer
 
     bs = _external_mock(toppars)
-    p = Producer({"bootstrap.servers": bs, "compression.codec": "lz4",
+    p = Producer({"bootstrap.servers": bs, "compression.codec": codec,
                   "batch.num.messages": 10000, "linger.ms": 50,
                   "queue.buffering.max.messages": 2_000_000})
     vals = _payloads(4096, size)
@@ -193,6 +194,42 @@ def consumer_pipeline(n_msgs: int, size: int, toppars: int) -> float:
     if got < n_msgs:
         raise RuntimeError(f"consumer bench incomplete: {got}/{n_msgs}")
     return rate
+
+
+def codec_size_sweep(toppars: int = 16) -> dict:
+    """BASELINE config 3: snappy + zstd over 256B..64KB payloads,
+    producer AND consumer direction (the rdkafka_performance -P/-C
+    sweep, examples/rdkafka_performance.c:555-644). Message counts
+    scale with size to keep each cell around 50-100 MB of payload;
+    rates are one trial per cell (the table's value is the SHAPE of
+    the curve)."""
+    out = {}
+    for codec in ("snappy", "zstd"):
+        for size in (256, 1024, 16384, 65536):
+            n = max(1_000, min(120_000, (48 << 20) // size))
+            cell = {}
+            try:
+                r = host_pipeline(n, size, toppars,
+                                  extra_conf={"compression.codec": codec})
+                cell["producer_msgs_s"] = round(r, 1)
+                cell["producer_mb_s"] = round(r * size / 1e6, 1)
+            except Exception as e:
+                cell["producer_msgs_s"] = None
+                print(f"sweep {codec}/{size} producer: {e!r}",
+                      file=sys.stderr)
+            try:
+                _reset_mock()
+                r = consumer_pipeline(n, size, toppars, codec=codec)
+                cell["consumer_msgs_s"] = round(r, 1)
+                cell["consumer_mb_s"] = round(r * size / 1e6, 1)
+            except Exception as e:
+                cell["consumer_msgs_s"] = None
+                print(f"sweep {codec}/{size} consumer: {e!r}",
+                      file=sys.stderr)
+            finally:
+                _reset_mock()
+            out[f"{codec}_{size}B"] = cell
+    return out
 
 
 def _sync(x) -> np.ndarray:
@@ -414,6 +451,14 @@ def main():
         print(f"idempotent_64tp failed: {e!r}", file=sys.stderr)
     finally:
         _reset_mock()
+    sweep = None
+    if os.environ.get("BENCH_SWEEP", "1") != "0":
+        try:
+            sweep = codec_size_sweep(toppars)
+        except Exception as e:
+            print(f"codec_size_sweep failed: {e!r}", file=sys.stderr)
+        finally:
+            _reset_mock()
     off = codec_offload()
     print(json.dumps({
         "metric": "batched CRC32C codec offload, 128x64KB partition "
@@ -435,6 +480,7 @@ def main():
             round(dr_rate, 1) if dr_rate is not None else None,
         "producer_dr_batch_msgs_s":
             round(dr_batch_rate, 1) if dr_batch_rate is not None else None,
+        "codec_size_sweep": sweep,
         "detail": off,
     }))
 

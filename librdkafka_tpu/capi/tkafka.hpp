@@ -74,9 +74,19 @@ class Conf {
   private:
     static std::string escape(const std::string &s) {
         std::string o;
-        for (char c : s) {
-            if (c == '"' || c == '\\') o += '\\';
-            o += c;
+        char u[8];
+        for (unsigned char c : s) {
+            if (c == '"' || c == '\\') {
+                o += '\\';
+                o += static_cast<char>(c);
+            } else if (c < 0x20) {
+                /* control chars (PEM blobs carry newlines) must be
+                 * \u-escaped or json.loads rejects the conf */
+                std::snprintf(u, sizeof u, "\\u%04x", c);
+                o += u;
+            } else {
+                o += static_cast<char>(c);
+            }
         }
         return o;
     }
@@ -113,9 +123,9 @@ class Message {
         return m_.payload ? std::string(m_.payload, m_.len)
                           : std::string();
     }
-    /* Raw-byte header list (values are std::string buffers; a null
-     * header value becomes an empty string with null=true skipped for
-     * brevity — use headers_raw for the null distinction). */
+    /* Raw-byte header list; a null header value becomes an empty
+     * string here — use headers_raw() when the null/empty distinction
+     * matters. */
     std::vector<std::pair<std::string, std::string>> headers() const {
         std::vector<std::pair<std::string, std::string>> out;
         for (int i = 0; i < m_.hdr_cnt; i++) {
@@ -127,6 +137,9 @@ class Message {
         }
         return out;
     }
+    /* Headers with the null-value signal preserved (value ignored,
+     * null_value=true for headers produced with a NULL value). */
+    std::vector<struct Header> headers_raw() const;
 
   private:
     bool own_ = false;
@@ -239,6 +252,20 @@ struct Header {
     std::string value;
     bool null_value = false;
 };
+
+inline std::vector<Header> Message::headers_raw() const {
+    std::vector<Header> out;
+    for (int i = 0; i < m_.hdr_cnt; i++) {
+        Header h;
+        h.name = m_.hdr_names[i];
+        if (m_.hdr_vals[i])
+            h.value.assign(m_.hdr_vals[i], m_.hdr_val_lens[i]);
+        else
+            h.null_value = true;
+        out.push_back(std::move(h));
+    }
+    return out;
+}
 
 class Producer : public Handle {
   public:

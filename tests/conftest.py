@@ -21,3 +21,8 @@ try:
     jax.config.update("jax_num_cpu_devices", 8)
 except Exception:
     pass  # backend already initialized (e.g. single-test re-entry)
+
+
+# The interop tier's reference build lives in test_0200_interop.py as a
+# module-scoped fixture — it only builds when that module actually runs
+# (a conftest-level hook stalled every pytest invocation for minutes).

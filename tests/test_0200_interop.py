@@ -12,6 +12,7 @@ timestamps are pinned; reference writer:
 All tests skip cleanly when the reference build is absent.
 Build it with:  tests/build_reference.sh
 """
+import os
 import struct
 import subprocess
 import time
@@ -25,10 +26,41 @@ from librdkafka_tpu.mock.cluster import MockCluster
 from librdkafka_tpu.protocol import proto
 from librdkafka_tpu.protocol.msgset import MsgsetWriterV2
 
+# Skip ONLY when the reference source tree is absent (a checkout
+# without /root/reference) or the user explicitly opted out with
+# TK_NO_REFBUILD=1. When the reference exists, the module-scoped
+# fixture below auto-builds .refbuild/ (cached) and a FAILED build
+# fails this tier loudly — a wire-parity regression must not ship
+# behind a silent skip (VERDICT r4 #4).
+_REF_DIR = os.environ.get("REFERENCE_DIR", "/root/reference")
 pytestmark = pytest.mark.skipif(
-    not refclient.available(),
-    reason="reference librdkafka not built (.refbuild/; run "
-           "tests/build_reference.sh)")
+    not os.path.isdir(_REF_DIR) or os.environ.get("TK_NO_REFBUILD") == "1",
+    reason=f"reference source tree not present ({_REF_DIR}) "
+           "or TK_NO_REFBUILD=1")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _refbuild():
+    """Build the reference librdkafka once (cached in the gitignored
+    .refbuild/; a few minutes on first run). Build failure FAILS the
+    tier — it never skips."""
+    if refclient.available():
+        return
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "build_reference.sh")
+    r = subprocess.run(["sh", script], capture_output=True, text=True,
+                       timeout=1800)
+    assert r.returncode == 0 and refclient.available(), (
+        "reference librdkafka build failed:\n"
+        + (r.stderr or r.stdout)[-2000:])
+
+
+def test_reference_build_available():
+    """Fails (never skips) when the reference exists but .refbuild/ is
+    absent or broken — the rest of the tier depends on it."""
+    assert refclient.available(), (
+        "reference librdkafka not built; auto-build failed — run "
+        "tests/build_reference.sh and read its error output")
 
 CODECS = ["none", "gzip", "snappy", "lz4", "zstd"]
 BASE_TS = 1_690_000_000_000
