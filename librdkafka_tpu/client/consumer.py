@@ -683,6 +683,14 @@ class Consumer:
             out.append(r)
         return out
 
+    def cluster_id(self, timeout: float = 5.0):
+        """rd_kafka_clusterid analog."""
+        return self._rk.cluster_id(timeout)
+
+    def controller_id(self, timeout: float = 5.0) -> int:
+        """rd_kafka_controllerid analog."""
+        return self._rk.controller_id(timeout)
+
     def poll_kafka(self, timeout: float = 0.0) -> int:
         return self._rk.poll(timeout)
 

@@ -466,7 +466,11 @@ class Kafka:
                            for b in resp["brokers"]}
             self.metadata["brokers"] = new_brokers
             self.metadata["controller_id"] = resp.get("controller_id", -1)
+            cid = resp.get("cluster_id")
+            if cid:
+                self.metadata["cluster_id"] = cid
             seen = set()
+            failed_topics = []
             for t in resp["topics"]:
                 if self.blacklisted(t["topic"]):
                     continue
@@ -474,6 +478,15 @@ class Kafka:
                 if terr == Err.UNKNOWN_TOPIC_OR_PART:
                     # topic deleted: drop it from the cache
                     self.metadata["topics"].pop(t["topic"], None)
+                    continue
+                if terr in (Err.TOPIC_EXCEPTION,
+                            Err.TOPIC_AUTHORIZATION_FAILED):
+                    # permanent: parked messages must fail NOW, not at
+                    # message.timeout.ms (reference: metadata topic err
+                    # → rd_kafka_topic_metadata_update NOTEXISTS → DR
+                    # failures; tests 0057-invalid_topic analog)
+                    self.metadata["topics"].pop(t["topic"], None)
+                    failed_topics.append((t["topic"], terr))
                     continue
                 if terr != Err.NO_ERROR:
                     # transient (e.g. LEADER_NOT_AVAILABLE during
@@ -496,6 +509,22 @@ class Kafka:
                 # list_topics waits on this to take a coherent snapshot
                 self._metadata_full_ts = time.monotonic()
             self._metadata_cond.notify_all()
+        for name, terr in failed_topics:
+            if self.is_producer:
+                self._fail_topic(name, KafkaError(terr, retriable=False))
+            else:
+                # consumers: surface the permanent topic error as an
+                # error event (reference delivers
+                # ERR_TOPIC_AUTHORIZATION_FAILED to the app); fetching
+                # for the topic stops with the cache entry gone.
+                # NOTE: with topic.metadata.refresh.sparse=false the
+                # full enumeration never names an invalid topic, so
+                # this path needs the (default) sparse refresh; the
+                # non-sparse fallback is message.timeout.ms, matching
+                # the reference's behavior there.
+                self.op_err(KafkaError(
+                    terr, f"topic {name!r}: permanent metadata error",
+                    retriable=False))
         if full and self.cgrp is not None:
             # regex subscription re-evaluation (rdkafka_pattern.c)
             self.cgrp.metadata_update(seen)
@@ -545,6 +574,26 @@ class Kafka:
         # assignment, not just the raw cache update above
         with self._metadata_cond:
             self._metadata_cond.notify_all()
+
+    def cluster_id(self, timeout: float = 5.0) -> Optional[str]:
+        """Cluster id from metadata (reference rd_kafka_clusterid;
+        Metadata v2+ carries it). None when unknown within timeout."""
+        if self.metadata.get("cluster_id") is None:
+            self.metadata_refresh("clusterid")
+            self.metadata_wait(
+                lambda: self.metadata.get("cluster_id") is not None,
+                timeout)
+        return self.metadata.get("cluster_id")
+
+    def controller_id(self, timeout: float = 5.0) -> int:
+        """Controller broker id (reference rd_kafka_controllerid);
+        -1 when unknown within timeout."""
+        if self.metadata.get("controller_id", -1) < 0:
+            self.metadata_refresh("controllerid")
+            self.metadata_wait(
+                lambda: self.metadata.get("controller_id", -1) >= 0,
+                timeout)
+        return self.metadata.get("controller_id", -1)
 
     def metadata_wait(self, predicate, timeout: float) -> bool:
         """Block until ``predicate()`` holds or ``timeout`` elapses,
@@ -622,10 +671,27 @@ class Kafka:
                 leader._wakeup()
         self.dbg("fetch", f"{tp}: back to leader fetch ({reason})")
 
-    def _fail_unknown_partitions(self, topic: str, cnt: int):
+    def _fail_topic(self, name: str, kerr: KafkaError) -> None:
+        """Fail every message queued for ``name`` — UA-parked and
+        per-toppar alike (permanent metadata topic errors:
+        INVALID_TOPIC, TOPIC_AUTHORIZATION_FAILED)."""
+        with self._topics_lock:
+            topic = self.topics.get(name)
+        if topic is not None:
+            with topic.lock:
+                msgs = list(topic.ua_msgq)
+                topic.ua_msgq.clear()
+            if msgs:
+                self.dr_msgq(msgs, kerr)   # dr_msgq stamps m.error
+        self._fail_unknown_partitions(name, 0, kerr)
+
+    def _fail_unknown_partitions(self, topic: str, cnt: int,
+                                 kerr: Optional[KafkaError] = None):
         """Error-DR messages parked on partitions beyond the topic's real
         partition count (reference: rd_kafka_topic_partition_cnt_update →
-        UNKNOWN_PARTITION delivery failures, rdkafka_topic.c)."""
+        UNKNOWN_PARTITION delivery failures, rdkafka_topic.c). ``kerr``
+        overrides the default unknown-partition error (permanent topic
+        errors fail with their own code)."""
         with self._toppars_lock:
             tps = [tp for (t, p), tp in self._toppars.items()
                    if t == topic and p >= cnt]
@@ -662,7 +728,7 @@ class Kafka:
             if fast_cnt:
                 self._lane.acct(-fast_cnt, -fast_bytes)
             if failed:
-                self.dr_msgq(failed, KafkaError(
+                self.dr_msgq(failed, kerr or KafkaError(
                     Err._UNKNOWN_PARTITION,
                     f"{tp}: partition does not exist"))
 

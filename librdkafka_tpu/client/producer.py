@@ -29,6 +29,14 @@ class Producer:
         # path (str encoding + on_delivery handled there)
         self.produce = self._rk.produce
 
+    def cluster_id(self, timeout: float = 5.0):
+        """rd_kafka_clusterid analog."""
+        return self._rk.cluster_id(timeout)
+
+    def controller_id(self, timeout: float = 5.0) -> int:
+        """rd_kafka_controllerid analog."""
+        return self._rk.controller_id(timeout)
+
     def set_topic_conf(self, topic: str, conf: dict) -> None:
         """Per-topic configuration override (rd_kafka_topic_new analog):
         e.g. {'compression.codec': 'snappy'} for one topic."""

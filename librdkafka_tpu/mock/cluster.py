@@ -31,6 +31,16 @@ from ..utils import sockbuf
 from ..protocol.proto import ApiKey
 from ..utils.buf import Slice
 
+_TOPIC_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+
+
+def _valid_topic_name(name: str) -> bool:
+    """Kafka topic-name rules (broker-side validation the real cluster
+    applies): 1-249 chars of [a-zA-Z0-9._-], not '.'/'..'."""
+    return (0 < len(name) <= 249 and name not in (".", "..")
+            and set(name) <= _TOPIC_CHARS)
+
 
 @dataclass
 class MockPartition:
@@ -521,10 +531,19 @@ class MockCluster:
                 names = list(self.topics)
             elif self.auto_create_topics and allow:
                 for t in names:
-                    if t not in self.topics:
+                    if t not in self.topics and _valid_topic_name(t):
                         self.create_topic(t)
             topics = []
             for t in names:
+                if t not in self.topics and not _valid_topic_name(t):
+                    # real brokers reject bad names with
+                    # INVALID_TOPIC_EXCEPTION (reference test
+                    # 0057-invalid_topic); existence wins so a fixture-
+                    # created topic always serves
+                    topics.append({"error_code": Err.TOPIC_EXCEPTION.wire,
+                                   "topic": t, "is_internal": False,
+                                   "partitions": []})
+                    continue
                 if t not in self.topics:
                     topics.append({"error_code": Err.UNKNOWN_TOPIC_OR_PART.wire,
                                    "topic": t, "is_internal": False,
@@ -978,6 +997,10 @@ class MockCluster:
                     err = inject
                 elif t["topic"] in self.topics:
                     err = Err.TOPIC_ALREADY_EXISTS
+                elif not _valid_topic_name(t["topic"]):
+                    # broker-side name validation (real brokers reject
+                    # bad names at creation, not just on metadata)
+                    err = Err.TOPIC_EXCEPTION
                 else:
                     self.create_topic(t["topic"], max(t["num_partitions"], 1))
                     err = Err.NO_ERROR

@@ -243,3 +243,128 @@ def test_ut_handle_produce_response_hook(cluster):
     p.close()
     assert seen, "hook never ran"
     assert drs and drs[-1] is None       # delivered after the retry
+
+
+def test_invalid_topic_fails_delivery(cluster):
+    """0057-invalid_topic: a broker-rejected topic name (bad charset /
+    too long) fails queued messages promptly with INVALID_TOPIC, not at
+    message.timeout.ms."""
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2, "message.timeout.ms": 300000,
+                  "dr_msg_cb": lambda e, m: drs.append(e)})
+    t0 = time.monotonic()
+    p.produce("bad topic!", value=b"x")           # space + '!' invalid
+    p.produce("x" * 250, value=b"y")              # > 249 chars
+    deadline = time.monotonic() + 15
+    while len(drs) < 2 and time.monotonic() < deadline:
+        p.poll(0.2)
+    p.close()
+    assert len(drs) == 2
+    assert all(e is not None and e.code == Err.TOPIC_EXCEPTION
+               for e in drs), drs
+    # prompt (metadata round trip), nowhere near message.timeout.ms
+    assert time.monotonic() - t0 < 15
+
+
+def test_long_valid_topic_name(cluster):
+    """0028-long_topicnames: a 249-char name is VALID and round-trips."""
+    name = "t" + "x" * 248
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2,
+                  "dr_msg_cb": lambda e, m: drs.append(e)})
+    p.produce(name, value=b"long-name", partition=0)
+    assert p.flush(15.0) == 0
+    p.close()
+    assert drs == [None]
+
+
+def test_cluster_and_controller_id(cluster):
+    """0063-clusterid: rd_kafka_clusterid/controllerid analogs."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers()})
+    assert p.cluster_id(10.0) == "mockCluster"
+    assert p.controller_id(10.0) >= 0
+    p.close()
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gcid"})
+    assert c.cluster_id(10.0) == "mockCluster"
+    c.close()
+
+
+def test_allow_auto_create_topics_flag(cluster):
+    """0007-autotopic + KIP-204/361: a PRODUCER always triggers broker
+    auto-creation on metadata; a CONSUMER only does so with
+    allow.auto.create.topics=true (default false)."""
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gauto"})
+    c.subscribe(["auto-no"])
+    deadline = time.monotonic() + 3
+    while time.monotonic() < deadline:
+        c.poll(0.2)
+    c.close()
+    assert "auto-no" not in cluster.topics      # flag default false
+    c2 = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                   "group.id": "gauto2",
+                   "allow.auto.create.topics": True})
+    c2.subscribe(["auto-yes-c"])
+    deadline = time.monotonic() + 10
+    while "auto-yes-c" not in cluster.topics \
+            and time.monotonic() < deadline:
+        c2.poll(0.2)
+    c2.close()
+    assert "auto-yes-c" in cluster.topics
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    p.produce("auto-yes-p", value=b"y")
+    assert p.flush(15.0) == 0
+    p.close()
+    assert "auto-yes-p" in cluster.topics
+
+
+def test_partition_count_growth(cluster):
+    """0044-partition_cnt: after create_partitions grows the topic,
+    produces to the new partitions deliver (metadata refresh picks up
+    the count)."""
+    from librdkafka_tpu.client.admin import AdminClient, NewPartitions
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    p.produce("bh", value=b"p0", partition=0)
+    assert p.flush(10.0) == 0
+    a = AdminClient({"bootstrap.servers": cluster.bootstrap_servers()})
+    futs = a.create_partitions([NewPartitions("bh", 4)],
+                               operation_timeout=10.0)
+    for f in futs.values():
+        f.result(10.0)
+    a.close()
+    drs = []
+    p._rk.conf.set("dr_msg_cb", lambda e, m: drs.append((e, m.partition)))
+    p._rk.metadata_refresh("test growth")
+    deadline = time.monotonic() + 15
+    sent = False
+    while time.monotonic() < deadline:
+        if not sent:
+            try:
+                p.produce("bh", value=b"p3", partition=3)
+                sent = True
+            except KafkaException:
+                time.sleep(0.2)       # count not refreshed yet
+                continue
+        if drs:
+            break
+        p.poll(0.2)
+    p.close()
+    assert drs and drs[0][0] is None and drs[0][1] == 3, drs
+
+
+def test_close_does_not_hang_with_broker_down(cluster):
+    """0020-destroy_hang: close() with undeliverable messages in the
+    queues returns within its bound instead of hanging."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2, "message.timeout.ms": 60000})
+    p.produce("bh", value=b"will-not-deliver", partition=0)
+    cluster.set_broker_down(1)
+    t0 = time.monotonic()
+    p.close(timeout=2.0)
+    assert time.monotonic() - t0 < 10.0
+    cluster.set_broker_down(1, down=False)
