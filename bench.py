@@ -398,6 +398,7 @@ def main():
     # ~250k without; the producer cpu-vs-tpu comparison below stays
     # interleaved so that tax hits both sides of ITS comparison)
     consumer_rate = None
+    consumer_small_rate = None
     try:
         # 5 trials, median: trial 0 pays the VM pager's first-touch
         # cost for the working set (~21 us/page on this infra); the
@@ -405,6 +406,13 @@ def main():
         rates = [consumer_pipeline(n_msgs, size, toppars)
                  for _ in range(5)]
         consumer_rate = sorted(rates)[2]
+        # the reference's >3M msgs/s consumer headline shape: small
+        # uncompressed messages (README.md:12) — median of 3
+        _reset_mock()
+        srates = [consumer_pipeline(min(n_msgs, 400_000), 100, 8,
+                                    codec="none") for _ in range(3)]
+        consumer_small_rate = sorted(srates)[1]
+        _reset_mock()
     except Exception as e:
         # null in the JSON must be diagnosable, never silent
         print(f"consumer_pipeline failed: {e!r}", file=sys.stderr)
@@ -474,6 +482,9 @@ def main():
         "host_pipeline_tpu_backend_msgs_s": round(tpu_backend_rate, 1),
         "consumer_pipeline_msgs_s":
             round(consumer_rate, 1) if consumer_rate is not None else None,
+        "consumer_small_100b_msgs_s":
+            round(consumer_small_rate, 1)
+            if consumer_small_rate is not None else None,
         "idempotent_64tp_msgs_s":
             round(idem_rate, 1) if idem_rate is not None else None,
         "producer_dr_msgs_s":

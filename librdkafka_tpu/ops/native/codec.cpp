@@ -616,6 +616,13 @@ EXPORT int64_t tk_snappy_compress(const uint8_t *src, int64_t n,
         }
     };
 
+    // fast-parse loop (r5; the same techniques as
+    // tk_lz4_block_compress_fast): 8-byte XOR/ctz match extension,
+    // uncapped matches emitted as chained <=64-byte copy tags (what
+    // libsnappy does), sparse table seeding at match ends instead of
+    // insert-all over interiors, and miss-acceleration strides through
+    // incompressible runs. The old insert-all loop measured 1.8 us per
+    // 1KB record in the fused batch builder vs lz4's 0.2.
     int32_t table[1 << SN_HASH_BITS];
     memset(table, -1, sizeof(table));
     int64_t anchor = 0, p = 0;
@@ -625,17 +632,34 @@ EXPORT int64_t tk_snappy_compress(const uint8_t *src, int64_t n,
         int64_t cand = table[h];
         table[h] = (int32_t)p;
         if (cand >= 0 && p - cand <= 65535 && rd32le(src + cand) == seq) {
-            int64_t mmax = n - 5 - p;
-            if (mmax > SN_MAXMATCH) mmax = SN_MAXMATCH;
+            int64_t maxm = n - p;
             int64_t mlen = 4;
-            while (mlen < mmax && src[cand + mlen] == src[p + mlen]) mlen++;
+            while (mlen + 8 <= maxm) {
+                uint64_t a, b;
+                memcpy(&a, src + cand + mlen, 8);
+                memcpy(&b, src + p + mlen, 8);
+                uint64_t x = a ^ b;
+                if (x) { mlen += __builtin_ctzll(x) >> 3; break; }
+                mlen += 8;
+            }
+            if (mlen + 8 > maxm)
+                while (mlen < maxm && src[cand + mlen] == src[p + mlen])
+                    mlen++;
             emit_literal(anchor, p - anchor);
-            emit_copy(p - cand, mlen);
-            for (int64_t q = p + 1; q < p + mlen && q + 4 <= n; q++)
-                table[sn_hash(rd32le(src + q))] = (int32_t)q;
-            p += mlen;
+            int64_t off = p - cand, rem = mlen;
+            while (rem >= 68) { emit_copy(off, 64); rem -= 64; }
+            if (rem > 64) { emit_copy(off, 60); rem -= 60; }
+            emit_copy(off, rem);           /* rem in [4, 64] */
+            int64_t end = p + mlen;
+            if (end - 1 > p && end + 3 <= n)
+                table[sn_hash(rd32le(src + end - 1))] = (int32_t)(end - 1);
+            if (end - 2 > p && end + 2 <= n)
+                table[sn_hash(rd32le(src + end - 2))] = (int32_t)(end - 2);
+            p = end;
             anchor = p;
-        } else p += 1;
+        } else {
+            p += 1 + ((uint32_t)(p - anchor) >> 7);
+        }
     }
     emit_literal(anchor, n - anchor);
     return o;
