@@ -412,10 +412,25 @@ def main():
         srates = [consumer_pipeline(min(n_msgs, 400_000), 100, 8,
                                     codec="none") for _ in range(3)]
         consumer_small_rate = sorted(srates)[1]
-        _reset_mock()
     except Exception as e:
         # null in the JSON must be diagnosable, never silent
         print(f"consumer_pipeline failed: {e!r}", file=sys.stderr)
+    finally:
+        # a failed trial must not leak a wrong-partition-count mock
+        # into the next block
+        _reset_mock()
+    producer_small_rate = None
+    try:
+        # the reference's >1M msgs/s producer headline shape
+        # (README.md:11): small uncompressed messages — median of 3
+        prates = [host_pipeline(min(n_msgs, 400_000), 100, 8,
+                                extra_conf={"compression.codec": "none"})
+                  for _ in range(3)]
+        producer_small_rate = sorted(prates)[1]
+    except Exception as e:
+        print(f"producer small failed: {e!r}", file=sys.stderr)
+    finally:
+        _reset_mock()
     cpu_rates, tpu_rates = [], []
     try:
         for _ in range(3):
@@ -485,6 +500,9 @@ def main():
         "consumer_small_100b_msgs_s":
             round(consumer_small_rate, 1)
             if consumer_small_rate is not None else None,
+        "producer_small_100b_msgs_s":
+            round(producer_small_rate, 1)
+            if producer_small_rate is not None else None,
         "idempotent_64tp_msgs_s":
             round(idem_rate, 1) if idem_rate is not None else None,
         "producer_dr_msgs_s":

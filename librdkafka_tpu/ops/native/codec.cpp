@@ -573,12 +573,15 @@ EXPORT int64_t tk_lz4f_decompress(const uint8_t *src, int64_t n,
 // Raw snappy block format (public spec: format_description.txt):
 //   preamble = uvarint uncompressed length
 //   elements: tag&3 == 0 literal / 1 copy-1byte-offset / 2 copy-2byte / 3 copy-4byte
-// Encoder uses the same deterministic insert-all greedy scheme as LZ4 so a
-// future TPU snappy provider can match it bit-for-bit.
+// Encoder is a fast-parse greedy scheme (r5): uncapped matches emitted
+// as chained <=64-byte copy tags, sparse table seeding, miss
+// acceleration — any spec-valid stream is legal snappy, and both the
+// fused and 3-phase paths share THIS function so their wire bytes
+// stay identical (test_0122). A TPU snappy provider would need its
+// own deterministic spec, as the lz4 one has.
 // [ref: vendored src/snappy.c; java-framing compat handled in msgset reader]
 
 static const int SN_HASH_BITS = 12;
-static const int SN_MAXMATCH = 64;   // copy-2byte max length
 
 static inline uint32_t sn_hash(uint32_t x) {
     return (x * 2654435761u) >> (32 - SN_HASH_BITS);
