@@ -32,10 +32,8 @@
 // cycles for the slice-by-8 table fold.
 
 static uint32_t crc32c_tab[8][256];
-static bool crc32c_init_done = false;
 
-static void crc32c_init() {
-    if (crc32c_init_done) return;
+static void crc32c_init_once() {
     const uint32_t poly = 0x82F63B78u;
     for (uint32_t i = 0; i < 256; i++) {
         uint32_t c = i;
@@ -45,7 +43,13 @@ static void crc32c_init() {
     for (int k = 1; k < 8; k++)
         for (uint32_t i = 0; i < 256; i++)
             crc32c_tab[k][i] = crc32c_tab[0][crc32c_tab[k-1][i] & 0xFF] ^ (crc32c_tab[k-1][i] >> 8);
-    crc32c_init_done = true;
+}
+
+static void crc32c_init() {
+    // function-local static: race-free one-time init (the done-flag
+    // form raced between broker threads — TSAN tier, test_0124)
+    static const bool done = (crc32c_init_once(), true);
+    (void)done;
 }
 
 static uint32_t crc32c_sw(const uint8_t *p, int64_t n, uint32_t crc) {
@@ -70,10 +74,8 @@ static uint32_t crc32c_sw(const uint8_t *p, int64_t n, uint32_t crc) {
 // crc32c_combine, utils/crc.py, in C). Used to stitch the 3-stream
 // hardware fold back together.
 static uint32_t crc32c_zshift[64][32];
-static bool crc32c_zshift_done = false;
 
-static void crc32c_zshift_init() {
-    if (crc32c_zshift_done) return;
+static void crc32c_zshift_init_once() {
     crc32c_init();
     for (int j = 0; j < 32; j++) {       // M^1: one zero byte
         uint32_t reg = 1u << j;
@@ -86,7 +88,11 @@ static void crc32c_zshift_init() {
                 if (v & 1) acc ^= crc32c_zshift[k - 1][b];
             crc32c_zshift[k][j] = acc;
         }
-    crc32c_zshift_done = true;
+}
+
+static void crc32c_zshift_init() {
+    static const bool done = (crc32c_zshift_init_once(), true);
+    (void)done;
 }
 
 // advance raw register `reg` through `n` zero bytes
@@ -163,11 +169,12 @@ static crc32c_fn crc32c_pick() {
     return crc32c_sw;
 }
 
-static crc32c_fn crc32c_impl = nullptr;
-
 EXPORT uint32_t tk_crc32c(const uint8_t *p, int64_t n, uint32_t crc) {
-    if (!crc32c_impl) crc32c_impl = crc32c_pick();
-    return crc32c_impl(p, n, crc);
+    // function-local static: C++11 guarantees race-free one-time init
+    // (the lazy nullable-pointer form was a data race between broker
+    // threads — caught by the TSAN tier, tests/test_0124_tsan.py)
+    static const crc32c_fn impl = crc32c_pick();
+    return impl(p, n, crc);
 }
 
 // sw path kept callable for tests (hw/sw bit-exactness cross-check)

@@ -1,0 +1,43 @@
+"""Race-detection tier for the native codec layer (the reference's TSAN
+discipline: dev-conf.sh:62-74 + tests/Makefile tsan target).
+
+codec.cpp owns real concurrency — the *_many entry points fan work over
+std::thread pools and are called from broker/codec-worker threads of
+multiple client instances at once. tests/tsan_codec.cpp drives exactly
+those shapes; this test builds it with -fsanitize=thread and fails on
+ANY ThreadSanitizer report (halt_on_error with a distinct exit code).
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CODEC = os.path.join(HERE, "..", "librdkafka_tpu", "ops", "native",
+                     "codec.cpp")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_native_codec_under_tsan(tmp_path):
+    exe = str(tmp_path / "tsan_codec")
+    probe = tmp_path / "probe.cpp"
+    probe.write_text("int main(){return 0;}\n")
+    try:
+        subprocess.run(["g++", "-fsanitize=thread", str(probe),
+                        "-o", str(tmp_path / "probe")],
+                       check=True, capture_output=True)
+    except subprocess.CalledProcessError:
+        pytest.skip("toolchain lacks ThreadSanitizer")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-g", "-fsanitize=thread",
+         "-pthread", CODEC, os.path.join(HERE, "tsan_codec.cpp"),
+         "-o", exe],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["TSAN_OPTIONS"] = "halt_on_error=1 exitcode=66"
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (
+        f"rc={r.returncode} (66 = TSAN report)\n{r.stderr[-4000:]}")
+    assert "TSAN-CODEC-OK" in r.stdout

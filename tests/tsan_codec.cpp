@@ -1,0 +1,117 @@
+/* ThreadSanitizer harness for the native codec layer — the rebuild's
+ * analog of the reference's TSAN tier (dev-conf.sh:62-74,
+ * tests/Makefile tsan target). codec.cpp owns real concurrency: the
+ * *_many entry points fan work over std::thread pools, and the client
+ * calls them from broker/codec-worker threads concurrently. This
+ * driver exercises those paths under -fsanitize=thread:
+ *
+ *   - tk_lz4f_compress_many / tk_snappy_compress_many (internal pools)
+ *   - tk_lz4f_decompress_many / tk_crc32c_many
+ *   - the same entry points called from MULTIPLE app threads at once
+ *     (each client instance has several broker threads + a codec
+ *     worker sharing the library)
+ *
+ * Built and run by tests/test_0124_tsan.py; any TSAN report fails.
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int64_t tk_lz4f_bound(int64_t n);
+int64_t tk_snappy_bound(int64_t n);
+int64_t tk_lz4f_compress(const uint8_t *src, int64_t n, uint8_t *dst,
+                         int64_t cap);
+int64_t tk_lz4f_decompress(const uint8_t *src, int64_t n, uint8_t *dst,
+                           int64_t cap);
+void tk_lz4f_compress_many(const uint8_t *base, const int64_t *offs,
+                           const int64_t *lens, int n, uint8_t *outbase,
+                           const int64_t *out_offs, int64_t *out_lens,
+                           int nthreads);
+void tk_snappy_compress_many(const uint8_t *base, const int64_t *offs,
+                             const int64_t *lens, int n, uint8_t *outbase,
+                             const int64_t *out_offs, int64_t *out_lens,
+                             int nthreads);
+void tk_lz4f_decompress_many(const uint8_t *base, const int64_t *offs,
+                             const int64_t *lens, int n, uint8_t *outbase,
+                             const int64_t *out_offs,
+                             const int64_t *out_caps, int64_t *out_lens,
+                             int nthreads);
+void tk_crc32c_many(const uint8_t *base, const int64_t *offs,
+                    const int64_t *lens, uint32_t *crcs, int n);
+uint32_t tk_crc32c(const uint8_t *data, int64_t n, uint32_t seed);
+}
+
+static const int NBUF = 16;
+static const int64_t BUF = 64 * 1024;
+
+struct Fixture {
+    std::vector<uint8_t> base;
+    std::vector<int64_t> offs, lens;
+    Fixture() : base(NBUF * BUF), offs(NBUF), lens(NBUF) {
+        for (int i = 0; i < NBUF; i++) {
+            offs[i] = i * BUF;
+            lens[i] = BUF;
+            for (int64_t j = 0; j < BUF; j++)
+                base[i * BUF + j] = (uint8_t)((j * 31 + i * 7) & 0x7F);
+        }
+    }
+};
+
+static int run_round(const Fixture &fx) {
+    // one "client instance" worth of concurrent codec work
+    int64_t cbound = tk_lz4f_bound(BUF);
+    std::vector<uint8_t> cout((size_t)NBUF * cbound);
+    std::vector<int64_t> couts(NBUF), coffs(NBUF);
+    for (int i = 0; i < NBUF; i++) coffs[i] = i * cbound;
+    tk_lz4f_compress_many(fx.base.data(), fx.offs.data(), fx.lens.data(),
+                          NBUF, cout.data(), coffs.data(), couts.data(),
+                          4);
+    // decompress what we compressed (internal pool again)
+    std::vector<uint8_t> dout(NBUF * BUF);
+    std::vector<int64_t> douts(NBUF), dcaps(NBUF, BUF), doffs(NBUF);
+    for (int i = 0; i < NBUF; i++) doffs[i] = i * BUF;
+    tk_lz4f_decompress_many(cout.data(), coffs.data(), couts.data(),
+                            NBUF, dout.data(), doffs.data(), dcaps.data(),
+                            douts.data(), 4);
+    for (int i = 0; i < NBUF; i++) {
+        if (douts[i] != BUF ||
+            memcmp(dout.data() + i * BUF, fx.base.data() + i * BUF, BUF))
+            return 1;
+    }
+    int64_t sbound = tk_snappy_bound(BUF);
+    std::vector<uint8_t> sout((size_t)NBUF * sbound);
+    std::vector<int64_t> souts(NBUF), soffs(NBUF);
+    for (int i = 0; i < NBUF; i++) soffs[i] = i * sbound;
+    tk_snappy_compress_many(fx.base.data(), fx.offs.data(),
+                            fx.lens.data(), NBUF, sout.data(),
+                            soffs.data(), souts.data(), 4);
+    std::vector<uint32_t> crcs(NBUF);
+    tk_crc32c_many(fx.base.data(), fx.offs.data(), fx.lens.data(),
+                   crcs.data(), NBUF);
+    for (int i = 0; i < NBUF; i++) {
+        if (crcs[i] != tk_crc32c(fx.base.data() + i * BUF, BUF, 0))
+            return 2;
+    }
+    return 0;
+}
+
+int main() {
+    Fixture fx;
+    // several "client" threads concurrently driving the shared library,
+    // each spawning its own internal pools — the shape a process with
+    // multiple producers/consumers has
+    std::vector<std::thread> apps;
+    int rc[4] = {0, 0, 0, 0};
+    for (int t = 0; t < 4; t++)
+        apps.emplace_back([&, t]() {
+            for (int r = 0; r < 3 && rc[t] == 0; r++) rc[t] = run_round(fx);
+        });
+    for (auto &t : apps) t.join();
+    for (int t = 0; t < 4; t++)
+        if (rc[t]) { std::fprintf(stderr, "round failed: %d\n", rc[t]); return 1; }
+    std::printf("TSAN-CODEC-OK\n");
+    return 0;
+}
