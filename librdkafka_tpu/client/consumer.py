@@ -683,6 +683,12 @@ class Consumer:
             out.append(r)
         return out
 
+    def io_event_enable(self, fd: int, payload: bytes = b"1") -> None:
+        """select()/epoll() integration: every op landing on the
+        consumer queue writes ``payload`` to ``fd`` (reference:
+        rd_kafka_queue_io_event_enable on the consumer queue)."""
+        self.queue.io_event_enable(fd, payload)
+
     def cluster_id(self, timeout: float = 5.0):
         """rd_kafka_clusterid analog."""
         return self._rk.cluster_id(timeout)

@@ -29,6 +29,12 @@ class Producer:
         # path (str encoding + on_delivery handled there)
         self.produce = self._rk.produce
 
+    def io_event_enable(self, fd: int, payload: bytes = b"1") -> None:
+        """select()/epoll() integration: every op landing on the reply
+        queue (DRs, errors, stats) writes ``payload`` to ``fd``
+        (reference: rd_kafka_queue_io_event_enable on the main queue)."""
+        self._rk.rep.io_event_enable(fd, payload)
+
     def cluster_id(self, timeout: float = 5.0):
         """rd_kafka_clusterid analog."""
         return self._rk.cluster_id(timeout)

@@ -73,6 +73,25 @@ class OpQueue:
     def set_wakeup_cb(self, cb: Optional[Callable[[], None]]):
         self._wakeup_cb = cb
 
+    def io_event_enable(self, fd: int, payload: bytes = b"1") -> None:
+        """App event-loop integration (reference:
+        rd_kafka_queue_io_event_enable, rdkafka_queue.h:294): every
+        enqueue writes ``payload`` to ``fd`` so the app can select()/
+        epoll() on it alongside its other fds. Pass fd < 0 to disable.
+        The write is non-blocking and best-effort — a full pipe means a
+        wakeup is already pending."""
+        if fd < 0:
+            self._wakeup_cb = None
+            return
+        import os
+
+        def _wake(_fd=fd, _payload=bytes(payload)):
+            try:
+                os.write(_fd, _payload)
+            except (BlockingIOError, OSError):
+                pass
+        self._wakeup_cb = _wake
+
     def push(self, op: Op) -> None:
         with self._lock:
             fwd = self._fwd

@@ -69,3 +69,48 @@ def test_error_event_type():
     p.close()
     dr = [m for e in events if e.type == EVENT_DR for m in e.messages()]
     assert dr and dr[0].error is not None
+
+
+def test_io_event_fd_wakeup():
+    """0040-io_event: with io_event_enable(fd), every op landing on the
+    app-facing queue writes the payload byte to the fd, so an app can
+    select() on it alongside its own fds (reference
+    rd_kafka_queue_io_event_enable, rdkafka_queue.h:294)."""
+    import os
+    import select as _select
+
+    from librdkafka_tpu import Consumer, Producer
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"ioe": 1})
+    try:
+        r, w = os.pipe()
+        os.set_blocking(w, False)
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "linger.ms": 2,
+                      "dr_msg_cb": lambda e, m: None})
+        p.io_event_enable(w, b"D")
+        p.produce("ioe", value=b"x", partition=0)
+        ready, _, _ = _select.select([r], [], [], 10.0)
+        assert ready, "no io-event for the DR op"
+        assert os.read(r, 16)[:1] == b"D"
+        p.flush(10.0)
+        p.close()
+
+        r2, w2 = os.pipe()
+        os.set_blocking(w2, False)
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "gioe",
+                      "auto.offset.reset": "earliest"})
+        c.io_event_enable(w2, b"M")
+        c.subscribe(["ioe"])
+        ready, _, _ = _select.select([r2], [], [], 15.0)
+        assert ready, "no io-event for the fetch op"
+        assert b"M" in os.read(r2, 64)
+        m = c.poll(5.0)
+        assert m is not None
+        c.close()
+        for fd in (r, w, r2, w2):
+            os.close(fd)
+    finally:
+        cluster.stop()
