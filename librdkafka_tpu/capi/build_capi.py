@@ -144,6 +144,8 @@ extern int  tk_list_groups(tk_handle_t h, char *buf, int size,
 /* JSON {state, protocol_type, protocol, members: [...]} */
 extern int  tk_describe_group(tk_handle_t h, const char *group,
                               char *buf, int size, int timeout_ms);
+extern int  tk_delete_group(tk_handle_t h, const char *group,
+                            int timeout_ms);
 """
 
 CDEF = TYPES + FUNCS
@@ -990,6 +992,20 @@ def tk_describe_group(h, group, buf, size, timeout_ms):
                                  operation_timeout=timeout_ms / 1000.0)
         info = futs[g].result(timeout_ms / 1000.0)
         return _write_cstr(buf, size, json.dumps(_jsonable(info)))
+    except Exception:
+        return -1
+
+
+@ffi.def_extern()
+def tk_delete_group(h, group, timeout_ms):
+    try:
+        a = _admin_for(h)
+        if a is None:
+            return -1
+        g = ffi.string(group).decode()
+        futs = a.delete_groups([g], operation_timeout=timeout_ms / 1000.0)
+        futs[g].result(timeout_ms / 1000.0)
+        return 0
     except Exception:
         return -1
 """

@@ -279,6 +279,15 @@ int main(void) {
         fprintf(stderr, "describe_group: %s\n", gbuf); return 1;
     }
     tk_destroy(c2);
+    /* group now memberless: delete it, then it no longer lists */
+    if (tk_delete_group(p, "gc", 10000) != 0) {
+        fprintf(stderr, "delete_group failed\n"); return 1;
+    }
+    if (tk_list_groups(p, gbuf, sizeof gbuf, 10000) > 0
+        && strstr(gbuf, "\"gc\"")) {
+        fprintf(stderr, "group still listed after delete: %s\n", gbuf);
+        return 1;
+    }
 
     if (tk_delete_topic(p, "ctopic", 10000) != 0) {
         fprintf(stderr, "delete_topic failed\n"); return 1;
