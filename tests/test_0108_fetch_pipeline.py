@@ -115,3 +115,49 @@ def test_fetch_num_inflight_cap():
         c.close()
     finally:
         cluster.stop()
+
+
+def test_deferred_fetch_survives_seek():
+    """r5 flow control: with a tiny queued.max.messages.kbytes budget
+    every response parks in the broker's deferred queue. A seek() while
+    entries are parked must not deliver stale offsets (version barrier)
+    nor lose the stream — delivery resumes exactly at the seek point
+    and stays gapless."""
+    import time
+
+    from librdkafka_tpu import Consumer, Producer
+    from librdkafka_tpu.client.consumer import TopicPartition
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"dfs": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5, "compression.codec": "lz4"})
+    for i in range(3000):
+        p.produce("dfs", value=b"m%05d" % i, partition=0)
+    assert p.flush(30.0) == 0
+    p.close()
+
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gdfs", "auto.offset.reset": "earliest",
+                  "check.crcs": True,
+                  "queued.max.messages.kbytes": 1})   # park everything
+    c.subscribe(["dfs"])
+    got = 0
+    deadline = time.monotonic() + 30
+    while got < 100 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got += 1
+    assert got == 100
+    c.seek(TopicPartition("dfs", 0, 50))
+    seq = []
+    deadline = time.monotonic() + 45
+    while len(seq) < 500 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            seq.append(m.offset)
+    c.close()
+    cluster.stop()
+    assert seq[:1] == [50], seq[:5]
+    assert seq == list(range(50, 50 + len(seq))), "gap/dup after seek"
+    assert len(seq) == 500

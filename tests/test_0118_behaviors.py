@@ -368,3 +368,68 @@ def test_close_does_not_hang_with_broker_down(cluster):
     p.close(timeout=2.0)
     assert time.monotonic() - t0 < 10.0
     cluster.set_broker_down(1, down=False)
+
+
+def test_reconsume_after_seek_identical(cluster):
+    """0014-reconsume-191: seeking back and re-consuming yields the
+    exact same messages (offsets, keys, values) as the first pass."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2, "compression.codec": "lz4"})
+    for i in range(40):
+        p.produce("bh", value=b"rc%02d" % i, key=b"k%02d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "grc", "auto.offset.reset": "earliest"})
+    c.subscribe(["bh"])
+
+    def read40():
+        out = []
+        deadline = time.monotonic() + 20
+        while len(out) < 40 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None and m.partition == 0:
+                out.append((m.offset, m.key, m.value))
+        return out
+
+    first = read40()
+    assert len(first) == 40
+    c.seek(TopicPartition("bh", 0, 0))
+    second = read40()
+    c.close()
+    assert first == second
+
+
+def test_subscribe_update_adds_topic(cluster):
+    """0045-subscribe_update / 0050-subscribe_adds: re-subscribing with
+    an extra topic rebalances onto it and its messages flow without
+    recreating the consumer."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(10):
+        p.produce("bh", value=b"a%d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gsub", "auto.offset.reset": "earliest"})
+    c.subscribe(["bh"])
+    got_a = 0
+    deadline = time.monotonic() + 20
+    while got_a < 10 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got_a += 1
+    assert got_a == 10
+    # widen the subscription; produce into the new topic
+    c.subscribe(["bh", "bh2"])
+    for i in range(10):
+        p.produce("bh2", value=b"b%d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+    got_b = 0
+    deadline = time.monotonic() + 25
+    while got_b < 10 and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None and m.topic == "bh2":
+            got_b += 1
+    c.close()
+    assert got_b == 10, f"only {got_b}/10 from the added topic"
