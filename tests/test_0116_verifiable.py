@@ -79,7 +79,7 @@ def test_verifiable_two_consumer_rebalance(mock_proc):
     protocol events and the partition set splits disjointly."""
     import time
 
-    def read_until(proc, name, timeout=30):
+    def read_until(proc, name, timeout=60):
         """Read protocol lines from proc until `name` appears."""
         lines = []
         deadline = time.monotonic() + timeout
