@@ -42,9 +42,16 @@ def main(argv=None) -> int:
                           retention_bytes=args.retention_mb << 20)
     print(cluster.bootstrap_servers(), flush=True)
     try:
+        import os
+        parent = os.getppid()
         deadline = time.monotonic() + args.seconds if args.seconds else None
         while deadline is None or time.monotonic() < deadline:
             time.sleep(0.5)
+            # a SIGKILLed parent (bench timeout, crashed harness)
+            # reparents us to init: exit instead of lingering as an
+            # orphan eating the benchmark host's CPU
+            if os.getppid() != parent:
+                break
     except KeyboardInterrupt:
         pass
     finally:
