@@ -138,8 +138,24 @@ int main() {
         return 1;
     }
 
+    /* admin from C++ */
+    if (p->create_partitions("cppt", 4) != 0) {
+        std::fprintf(stderr, "create_partitions failed\n");
+        return 1;
+    }
+    std::string cfg = p->describe_configs(2 /* TOPIC */, "cppt");
+    if (cfg.empty() || cfg[0] != '{') {
+        std::fprintf(stderr, "describe_configs: %s\n", cfg.c_str());
+        return 1;
+    }
+    std::string groups = p->list_groups();
+    if (groups.find("gcpp") == std::string::npos) {
+        std::fprintf(stderr, "list_groups: %s\n", groups.c_str());
+        return 1;
+    }
+
     std::printf("CPP-OK produced=%d consumed=%d headers-raw=%d stats=%d "
-                "v=%s\n",
+                "admin-ok v=%s\n",
                 N, got, bin_ok, ev.stats_seen,
                 tkafka::version().c_str());
     return 0;

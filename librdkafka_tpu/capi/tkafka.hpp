@@ -322,6 +322,46 @@ class Producer : public Handle {
     int delete_topic(const std::string &t, int timeout_ms = 10000) {
         return tk_delete_topic(h_, t.c_str(), timeout_ms);
     }
+    int create_partitions(const std::string &t, int new_total,
+                          int timeout_ms = 10000) {
+        return tk_create_partitions(h_, t.c_str(), new_total,
+                                    timeout_ms);
+    }
+    /* JSON blob results; empty string = error */
+    std::string describe_configs(int restype, const std::string &name,
+                                 int timeout_ms = 10000) {
+        std::string buf(16384, '\0');
+        int r = tk_describe_configs(h_, restype, name.c_str(), &buf[0],
+                                    (int)buf.size(), timeout_ms);
+        if (r <= 0) return std::string();
+        buf.resize((size_t)r);
+        return buf;
+    }
+    int alter_configs(int restype, const std::string &name,
+                      const std::string &conf_json,
+                      int timeout_ms = 10000) {
+        return tk_alter_configs(h_, restype, name.c_str(),
+                                conf_json.c_str(), timeout_ms);
+    }
+    std::string list_groups(int timeout_ms = 10000) {
+        std::string buf(16384, '\0');
+        int r = tk_list_groups(h_, &buf[0], (int)buf.size(), timeout_ms);
+        if (r <= 0) return std::string();
+        buf.resize((size_t)r);
+        return buf;
+    }
+    std::string describe_group(const std::string &group,
+                               int timeout_ms = 10000) {
+        std::string buf(16384, '\0');
+        int r = tk_describe_group(h_, group.c_str(), &buf[0],
+                                  (int)buf.size(), timeout_ms);
+        if (r <= 0) return std::string();
+        buf.resize((size_t)r);
+        return buf;
+    }
+    int delete_group(const std::string &group, int timeout_ms = 10000) {
+        return tk_delete_group(h_, group.c_str(), timeout_ms);
+    }
 
   private:
     Producer() = default;
