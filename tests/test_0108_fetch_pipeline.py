@@ -161,3 +161,35 @@ def test_deferred_fetch_survives_seek():
     assert seq[:1] == [50], seq[:5]
     assert seq == list(range(50, 50 + len(seq))), "gap/dup after seek"
     assert len(seq) == 500
+
+
+def test_close_with_deferred_entries_is_clean():
+    """Closing mid-stream with fetch responses parked in the deferred
+    queue releases their in-flight claims and returns promptly."""
+    import time
+
+    from librdkafka_tpu import Consumer, Producer
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"dfc": 1})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5, "compression.codec": "lz4"})
+    for i in range(3000):
+        p.produce("dfc", value=b"c%05d" % i, partition=0)
+    assert p.flush(30.0) == 0
+    p.close()
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gdfc", "auto.offset.reset": "earliest",
+                  "queued.max.messages.kbytes": 1})
+    c.subscribe(["dfc"])
+    got = 0
+    deadline = time.monotonic() + 30
+    while got < 50 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got += 1
+    assert got == 50
+    t0 = time.monotonic()
+    c.close()
+    assert time.monotonic() - t0 < 10.0
+    cluster.stop()
