@@ -542,28 +542,6 @@ class AdminClient:
     # ------------------------------------------------------------ metadata --
     def list_topics(self, timeout: float = 10.0) -> dict:
         """Synchronous metadata snapshot: {topic: {partition: leader}}
-        (reference rd_kafka_metadata)."""
-        deadline = time.monotonic() + timeout
-        t0 = time.monotonic()
-        self._rk.metadata_refresh("list_topics")
-        while time.monotonic() < deadline:
-            # wait for a FULL refresh that completed at/after this call
-            # — an older in-flight (possibly partial) refresh finishing
-            # must not satisfy it with a stale snapshot. Blocks on the
-            # metadata condvar (notified per cache update, no polling);
-            # the 0.5s cap re-issues the refresh in case the first one
-            # raced broker bring-up and was dropped.
-            if self._rk.metadata_wait(
-                    lambda: self._rk._metadata_full_ts >= t0,
-                    min(0.5, max(0.0, deadline - time.monotonic()))):
-                # snapshot under the metadata lock: a refresh landing
-                # mid-copy would otherwise mutate the dicts while the
-                # comprehension iterates them
-                with self._rk._metadata_lock:
-                    md = self._rk.metadata
-                    return {"brokers": dict(md["brokers"]),
-                            "controller_id": md.get("controller_id", -1),
-                            "topics": {t: dict(ps)
-                                       for t, ps in md["topics"].items()}}
-            self._rk.metadata_refresh("list_topics retry")
-        raise KafkaException(Err._TIMED_OUT, "metadata not available")
+        (reference rd_kafka_metadata). Delegates to the shared client
+        implementation (Kafka.list_topics)."""
+        return self._rk.list_topics(timeout)

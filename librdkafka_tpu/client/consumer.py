@@ -689,6 +689,10 @@ class Consumer:
         rd_kafka_queue_io_event_enable on the consumer queue)."""
         self.queue.io_event_enable(fd, payload)
 
+    def list_topics(self, timeout: float = 10.0) -> dict:
+        """rd_kafka_metadata analog: full cluster metadata snapshot."""
+        return self._rk.list_topics(timeout)
+
     def cluster_id(self, timeout: float = 5.0):
         """rd_kafka_clusterid analog."""
         return self._rk.cluster_id(timeout)
@@ -696,6 +700,12 @@ class Consumer:
     def controller_id(self, timeout: float = 5.0) -> int:
         """rd_kafka_controllerid analog."""
         return self._rk.controller_id(timeout)
+
+    def memberid(self) -> str:
+        """Group member id after joining (rd_kafka_memberid analog;
+        empty string before the first JoinGroup completes)."""
+        cg = self._rk.cgrp
+        return cg.member_id if cg is not None else ""
 
     def poll_kafka(self, timeout: float = 0.0) -> int:
         return self._rk.poll(timeout)

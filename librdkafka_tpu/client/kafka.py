@@ -400,7 +400,8 @@ class Kafka:
             ups = [b for b in self.brokers.values() if b.is_up()]
         return random.choice(ups) if ups else None
 
-    def metadata_refresh(self, reason: str = ""):
+    def metadata_refresh(self, reason: str = "",
+                         all_topics: bool = False):
         if self.terminating:
             return
         if self._metadata_inflight:
@@ -420,6 +421,8 @@ class Kafka:
             names = list(self.topics) if sparse else None
         if names == []:
             names = None if not self.is_consumer else []
+        if all_topics:
+            names = None          # full enumeration (list_topics)
         if self.cgrp is not None and self.cgrp.patterns:
             # regex subscriptions need the full cluster topic list
             names = None
@@ -574,6 +577,28 @@ class Kafka:
         # assignment, not just the raw cache update above
         with self._metadata_cond:
             self._metadata_cond.notify_all()
+
+    def list_topics(self, timeout: float = 10.0) -> dict:
+        """Synchronous full-metadata snapshot: {brokers, controller_id,
+        topics: {topic: {partition: leader}}} (rd_kafka_metadata)."""
+        deadline = time.monotonic() + timeout
+        t0 = time.monotonic()
+        self.metadata_refresh("list_topics", all_topics=True)
+        while time.monotonic() < deadline:
+            # wait for a FULL refresh completed at/after this call; the
+            # 0.5s cap re-issues it in case the first raced broker
+            # bring-up and was dropped
+            if self.metadata_wait(
+                    lambda: self._metadata_full_ts >= t0,
+                    min(0.5, max(0.0, deadline - time.monotonic()))):
+                with self._metadata_lock:
+                    md = self.metadata
+                    return {"brokers": dict(md["brokers"]),
+                            "controller_id": md.get("controller_id", -1),
+                            "topics": {t: dict(ps)
+                                       for t, ps in md["topics"].items()}}
+            self.metadata_refresh("list_topics retry", all_topics=True)
+        raise KafkaException(Err._TIMED_OUT, "metadata not available")
 
     def cluster_id(self, timeout: float = 5.0) -> Optional[str]:
         """Cluster id from metadata (reference rd_kafka_clusterid;

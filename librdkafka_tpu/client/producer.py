@@ -35,6 +35,10 @@ class Producer:
         (reference: rd_kafka_queue_io_event_enable on the main queue)."""
         self._rk.rep.io_event_enable(fd, payload)
 
+    def list_topics(self, timeout: float = 10.0) -> dict:
+        """rd_kafka_metadata analog: full cluster metadata snapshot."""
+        return self._rk.list_topics(timeout)
+
     def cluster_id(self, timeout: float = 5.0):
         """rd_kafka_clusterid analog."""
         return self._rk.cluster_id(timeout)

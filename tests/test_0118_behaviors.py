@@ -433,3 +433,18 @@ def test_subscribe_update_adds_topic(cluster):
             got_b += 1
     c.close()
     assert got_b == 10, f"only {got_b}/10 from the added topic"
+
+
+def test_memberid_after_join(cluster):
+    """rd_kafka_memberid: empty before joining, the coordinator-assigned
+    id once assigned."""
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gmid"})
+    assert c.memberid() == ""
+    c.subscribe(["bh"])
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and not c.assignment():
+        c.poll(0.2)
+    mid = c.memberid()
+    c.close()
+    assert mid and isinstance(mid, str)
