@@ -100,14 +100,53 @@ def _fused_builder():
     return getattr(m, "build_batch", None) if m else None
 
 
+class _PendingCodec:
+    """A codec phase whose CRC launch is in flight on the async offload
+    engine (ops/engine.py): frame + compress + assemble are done; the
+    writers in ``assembled`` await their ticket's checksums.  finish()
+    patches CRCs and returns the results in ``ready`` order."""
+
+    __slots__ = ("by_idx", "n", "assembled", "ticket")
+
+    def __init__(self, by_idx: dict, n: int, assembled: list, ticket):
+        self.by_idx = by_idx
+        self.n = n
+        self.assembled = assembled      # [(idx, (tp, msgs, writer))]
+        self.ticket = ticket
+
+    def done(self) -> bool:
+        return self.ticket.done()
+
+    def finish(self) -> list:
+        try:
+            crcs = self.ticket.result()
+        except Exception as e:
+            for i, (tp, msgs, _w) in self.assembled:
+                self.by_idx[i] = (tp, msgs, None, e)
+        else:
+            for (i, (tp, msgs, w)), crc in zip(self.assembled, crcs):
+                self.by_idx[i] = (tp, msgs, w.patch_crc(int(crc)), None)
+        return [self.by_idx[i] for i in range(self.n)]
+
+
 def _run_codec_phase(rk, ready: list) -> list:
-    """Compress + assemble + CRC a batch set. Pure compute — safe on the
-    codec worker thread. Returns [(tp, msgs, wire|None, exc|None)] in
-    ``ready`` order (same-tp batches must stay FIFO).
+    """Compress + assemble + CRC a batch set, synchronously. Pure
+    compute — safe on any thread. Returns
+    [(tp, msgs, wire|None, exc|None)] in ``ready`` order (same-tp
+    batches must stay FIFO)."""
+    results, pending = _begin_codec_phase(rk, ready)
+    return results if pending is None else pending.finish()
+
+
+def _begin_codec_phase(rk, ready: list):
+    """Phase 2 with an async seam: returns ``(results, None)`` when the
+    whole phase resolved synchronously, or ``(None, _PendingCodec)``
+    when the provider accepted the CRC batch as an async ticket — the
+    caller overlaps other work and calls pending.finish() later.
 
     ArenaBatches carrying a _FusedJob take the fused native path; the
     rest (Message batches, non-native codecs, device-routed providers)
-    run the 3-phase writer pipeline below."""
+    run the 3-phase writer pipeline."""
     build = _fused_builder()
     by_idx: dict[int, tuple] = {}
     writer_items: list[tuple[int, tuple]] = []
@@ -125,56 +164,74 @@ def _run_codec_phase(rk, ready: list) -> list:
                 by_idx[i] = (tp, msgs, None, e)
         else:
             writer_items.append((i, item))
+    pending = None
     if writer_items:
-        sub = _run_codec_phase_writers(rk, [t for _, t in writer_items])
-        for (i, _), r in zip(writer_items, sub):
-            by_idx[i] = r
-    return [by_idx[i] for i in range(len(ready))]
+        pending = _begin_writer_phase(rk, writer_items, by_idx, len(ready))
+    if pending is not None:
+        return None, pending
+    return [by_idx[i] for i in range(len(ready))], None
 
 
-def _run_codec_phase_writers(rk, ready: list) -> list:
+def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
+                        n: int):
+    """Compress + assemble the non-fused batches, filling ``by_idx`` for
+    failures; the CRC batch goes to the provider's async submit seam
+    when it has one (TpuCodecProvider.crc32c_submit -> Ticket), else it
+    is computed synchronously here.  Returns a _PendingCodec or None."""
     provider = rk.codec_provider
-    results = []
     try:
-        blobs = [None] * len(ready)
+        blobs = {}
         # compression.codec and compression.level are topic-scoped:
         # group the fan-in by (codec, level) so one serve pass honors
         # every topic's settings (each writer carries its own codec,
         # resolved at batch formation via Broker._codec_for)
         by_key: dict = {}
-        for i, (tp, _msgs, w) in enumerate(ready):
+        for i, (tp, _msgs, w) in writer_items:
             if w.codec is None:
                 continue
             lvl = rk.topic_conf_for(tp.topic).get("compression.level")
             by_key.setdefault((w.codec, lvl), []).append(i)
+        items = {i: item for i, item in writer_items}
         for (cdc, lvl), idxs in by_key.items():
             out = provider.compress_many(
-                cdc, [ready[i][2].records_bytes for i in idxs], lvl)
+                cdc, [items[i][2].records_bytes for i in idxs], lvl)
             for i, blob in zip(idxs, out):
                 blobs[i] = blob
     except Exception as e:
-        return [(tp, msgs, None, e) for tp, msgs, _w in ready]
+        for i, (tp, msgs, _w) in writer_items:
+            by_idx[i] = (tp, msgs, None, e)
+        return None
 
-    assembled = []                # (tp, msgs, writer)
+    assembled = []                # (idx, (tp, msgs, writer))
     regions = []                  # CRC region per batch
-    for (tp, msgs, writer), blob in zip(ready, blobs):
+    for i, (tp, msgs, writer) in writer_items:
+        blob = blobs.get(i)
         try:
             if blob is not None and len(blob) >= len(writer.records_bytes):
                 blob = None       # incompressible: send plain
                 writer.codec = None
             regions.append(writer.assemble(blob))
-            assembled.append((tp, msgs, writer))
+            assembled.append((i, (tp, msgs, writer)))
         except Exception as e:
-            results.append((tp, msgs, None, e))
-    if assembled:
+            by_idx[i] = (tp, msgs, None, e)
+    if not assembled:
+        return None
+    submit = getattr(provider, "crc32c_submit", None)
+    if submit is not None:
         try:
-            crcs = provider.crc32c_many(regions)
-            for (tp, msgs, writer), crc in zip(assembled, crcs):
-                results.append((tp, msgs, writer.patch_crc(int(crc)), None))
-        except Exception as e:
-            for tp, msgs, _w in assembled:
-                results.append((tp, msgs, None, e))
-    return results
+            ticket = submit(regions)
+        except Exception:
+            ticket = None
+        if ticket is not None:
+            return _PendingCodec(by_idx, n, assembled, ticket)
+    try:
+        crcs = provider.crc32c_many(regions)
+        for (i, (tp, msgs, writer)), crc in zip(assembled, crcs):
+            by_idx[i] = (tp, msgs, writer.patch_crc(int(crc)), None)
+    except Exception as e:
+        for i, (tp, msgs, _w) in assembled:
+            by_idx[i] = (tp, msgs, None, e)
+    return None
 
 
 class CodecWorker(threading.Thread):
@@ -190,6 +247,14 @@ class CodecWorker(threading.Thread):
         import queue as _q
         self.rk = rk
         self.jobs = _q.Queue()
+        # max codec jobs whose CRC tickets may be outstanding before
+        # the worker blocks on the oldest — mirrors the broker-side
+        # codec.pipeline.depth gate so results can't pile up unbounded
+        self.max_inflight = max(
+            2, int(getattr(rk, "codec_pipeline_depth", 2) or 2))
+        # test/bench observability: high-water mark of concurrently
+        # in-flight async CRC tickets (>=2 proves pipeline overlap)
+        self.inflight_hwm = 0
         self.start()
 
     def submit(self, broker: "Broker", ready: list,
@@ -208,19 +273,56 @@ class CodecWorker(threading.Thread):
             if self.rk.interceptors:
                 self.rk.interceptors.on_thread_exit("codec", self.name)
 
+    def _post(self, broker, results, ts_codec, pepoch) -> None:
+        broker.ops.push(Op(OpType.BROKER_WAKEUP,
+                           payload=("codec_done", results, ts_codec,
+                                    pepoch)))
+
+    def _finish(self, entry) -> None:
+        broker, pending, ts_codec, pepoch = entry
+        self._post(broker, pending.finish(), ts_codec, pepoch)
+
     def _run(self):
+        """Pipelined consume loop: phase-2 work whose CRC went to the
+        async offload engine parks in ``pending`` as a ticket; the
+        worker frames + compresses the NEXT job while the device
+        executes, and patches checksums when tickets resolve — the
+        double-buffered overlap of ISSUE 1 (the r5 loop blocked inside
+        _run_codec_phase for every device round-trip).  ``pending``
+        drains strictly FIFO so per-partition send order — and with it
+        idempotent sequence order — is preserved."""
+        import queue as _q
+        pending: deque = deque()
         while True:
-            job = self.jobs.get()
+            # reap resolved tickets (FIFO — stop at the first unresolved)
+            while pending and pending[0][1].done():
+                self._finish(pending.popleft())
+            # cap the in-flight window: block on the oldest ticket
+            while len(pending) >= self.max_inflight:
+                self._finish(pending.popleft())
+            try:
+                # with tickets in flight, poll briefly so the next job
+                # overlaps the device; idle otherwise blocks for real
+                job = self.jobs.get(timeout=0.002 if pending else None)
+            except _q.Empty:
+                if pending:
+                    self._finish(pending.popleft())
+                continue
             if job is None:
+                while pending:
+                    self._finish(pending.popleft())
                 return
             broker, ready, ts_codec, pepoch = job
             try:
-                results = _run_codec_phase(self.rk, ready)
+                results, pend = _begin_codec_phase(self.rk, ready)
             except Exception as e:      # belt & braces: fail every batch
-                results = [(tp, msgs, None, e) for tp, msgs, _w in ready]
-            broker.ops.push(Op(OpType.BROKER_WAKEUP,
-                               payload=("codec_done", results, ts_codec,
-                                        pepoch)))
+                results, pend = ([(tp, msgs, None, e)
+                                  for tp, msgs, _w in ready], None)
+            if pend is None:
+                self._post(broker, results, ts_codec, pepoch)
+            else:
+                pending.append((broker, pend, ts_codec, pepoch))
+                self.inflight_hwm = max(self.inflight_hwm, len(pending))
 
 
 class Broker:
@@ -355,10 +457,16 @@ class Broker:
                 time.sleep(0.05)
         self._disconnect(KafkaError(Err._DESTROY, "terminating"))
         # release deferred partitions' in-flight claims so another
-        # broker (or a later instance) can fetch them
-        for entry in self._fetch_deferred:
-            entry[0].fetch_in_flight = False
-        self._fetch_deferred.clear()
+        # broker (or a later instance) can fetch them.  Guarded: close()
+        # tears these structures down concurrently once the join times
+        # out, and a release raced that way must not kill the exit path
+        # ("deque mutated during iteration")
+        try:
+            for entry in list(self._fetch_deferred):
+                entry[0].fetch_in_flight = False
+            self._fetch_deferred.clear()
+        except Exception:
+            pass
         if self.rk.interceptors:
             self.rk.interceptors.on_thread_exit("broker", self.name)
 
@@ -1603,6 +1711,19 @@ class Broker:
         each processed entry's own contribution — per-entry re-sums
         were O(partitions^2) on wide brokers; app-side drains between
         iterations only make the estimate conservative."""
+        # migrated partitions release their claims FIRST, regardless of
+        # the queued-bytes budget: the new leader's fetch is blocked on
+        # fetch_in_flight, and an undrained old-broker backlog must not
+        # starve it (their parked data is stale — the new broker
+        # re-fetches the same offsets)
+        if any(e[0] not in self.toppars for e in self._fetch_deferred):
+            kept: deque = deque()
+            for entry in self._fetch_deferred:
+                if entry[0] in self.toppars:
+                    kept.append(entry)
+                else:
+                    entry[0].fetch_in_flight = False
+            self._fetch_deferred = kept
         budget = self.rk.conf.get("queued.max.messages.kbytes") * 1024
         queued = self._queued_fetch_bytes()
         while self._fetch_deferred:

@@ -38,6 +38,11 @@ class ConsumerGroup:
         self.subscription: list[str] = []
         self.patterns: list = []            # compiled ^regex subscriptions
         self._matched: set[str] = set()     # topics currently matching
+        # literal subscription topics whose metadata is known: a topic
+        # whose metadata arrives AFTER the JoinGroup must trigger a
+        # rejoin too (reference: rd_kafka_cgrp_metadata_update_check,
+        # rdkafka_cgrp.c:3412, rejoins for literal and regex alike)
+        self._lit_known: set[str] = set()
         # bumped by rejoin(); a JoinGroup begun under an older version is
         # abandoned on response instead of syncing a stale subscription
         self.sub_version = 0
@@ -49,6 +54,7 @@ class ConsumerGroup:
         self.last_poll = time.monotonic()
         self.max_poll_exceeded = False
         self._pending = False          # a request is in flight
+        self._unknown_topic_scan = 0.0  # last unknown-literal re-query
         self._wait_rebalance_cb = False
         self._auto_commit_next = 0.0
         self.terminated = False
@@ -78,6 +84,13 @@ class ConsumerGroup:
         self.subscription = list(topics)
         self.patterns = pats
         self._matched = set()
+        # literal topics already in the metadata cache won't fire a
+        # metadata_update rejoin; unknown ones rejoin when their
+        # metadata lands (the assignor needs the partition counts)
+        with self.rk._metadata_lock:
+            known = set(self.rk.metadata["topics"])
+        self._lit_known = {t for t in topics
+                           if not t.startswith("^") and t in known}
         # literals after patterns are installed: their metadata_refresh
         # must request the FULL topic list for pattern discovery
         for t in topics:
@@ -92,27 +105,46 @@ class ConsumerGroup:
         lits = [t for t in self.subscription if not t.startswith("^")]
         return sorted(set(lits) | self._matched)
 
-    def metadata_update(self, topic_names) -> None:
-        """Re-evaluate regex patterns against a fresh full topic list
-        (reference: rd_kafka_cgrp_metadata_update_check); rejoin when the
-        matched set changes so the group rebalances onto new topics."""
-        if not self.patterns:
-            return
-        matched = {t for t in topic_names
-                   if not self.rk.blacklisted(t)
-                   and any(p.search(t) for p in self.patterns)}
-        if matched == self._matched:
-            return
-        added = matched - self._matched
-        self._matched = matched
-        for t in added:
-            self.rk.get_topic(t)
-        self.rejoin(f"regex match changed (+{sorted(added)})")
+    def metadata_update(self, topic_names, full: bool = True) -> None:
+        """Re-evaluate the subscription against fresh metadata
+        (reference: rd_kafka_cgrp_metadata_update_check,
+        rdkafka_cgrp.c:3412 — rejoins for literal AND regex
+        subscriptions): a literal topic whose metadata arrives after the
+        JoinGroup rejoins so the leader's assignor finally sees its
+        partitions; a regex match-set change rebalances onto the new
+        topics.  ``full=False`` is a sparse (per-topic) update: literal
+        arrival still counts, but patterns are only re-evaluated against
+        full enumerations (a sparse list would shrink the match set)."""
+        topic_names = set(topic_names)
+        reasons = []
+        lits = {t for t in self.subscription if not t.startswith("^")}
+        newly = (lits & topic_names) - self._lit_known
+        self._lit_known |= newly
+        if full:
+            # full enumeration: a deleted topic re-arms its trigger so
+            # a later re-create rejoins again
+            self._lit_known &= topic_names
+        if newly:
+            reasons.append(f"literal topic metadata arrived "
+                           f"({sorted(newly)})")
+        if self.patterns and full:
+            matched = {t for t in topic_names
+                       if not self.rk.blacklisted(t)
+                       and any(p.search(t) for p in self.patterns)}
+            if matched != self._matched:
+                added = matched - self._matched
+                self._matched = matched
+                for t in added:
+                    self.rk.get_topic(t)
+                reasons.append(f"regex match changed (+{sorted(added)})")
+        if reasons:
+            self.rejoin("; ".join(reasons))
 
     def unsubscribe(self):
         self.subscription = []
         self.patterns = []
         self._matched = set()
+        self._lit_known = set()
         self.sub_version += 1    # abandon any JoinGroup in flight
         self._leave()
 
@@ -147,6 +179,16 @@ class ConsumerGroup:
                     f"({int(mpi * 1000)}ms) exceeded"))
                 self._leave()
                 return
+            # a subscribed literal topic with no metadata yet (created
+            # after subscribe(), or still propagating) is re-queried on
+            # a 1s scan — the reference's rd_kafka_1s_tmr topic scan —
+            # so its arrival can fire the metadata_update rejoin; the
+            # periodic refresh timer alone is minutes away
+            if now - self._unknown_topic_scan >= 1.0 and any(
+                    not t.startswith("^") and t not in self._lit_known
+                    for t in self.subscription):
+                self._unknown_topic_scan = now
+                self.rk.metadata_refresh("unknown subscribed topic(s)")
         if self.state != "up":
             # the coordinator lookup runs even without a subscription:
             # commit()/committed() on an assign()-based or fresh consumer
@@ -394,7 +436,7 @@ class ConsumerGroup:
         self._auto_commit_next = now + ival
         offsets = self.rk.consumer.stored_offsets()
         if offsets:
-            self.commit_offsets(offsets, None)
+            self.commit_offsets(offsets, None, from_store=True)
 
     @staticmethod
     def _synth_offset_resp(items: dict, with_offsets: bool) -> dict:
@@ -410,7 +452,7 @@ class ConsumerGroup:
                            for t, ps in by_topic.items()]}
 
     def commit_offsets(self, offsets: dict[tuple[str, int], int],
-                       cb) -> bool:
+                       cb, from_store: bool = False) -> bool:
         # values may be plain offsets or (offset, metadata) — the
         # commit-metadata string of rd_kafka_topic_partition_t
         # (reference test 0099-commit_metadata); normalize here
@@ -429,8 +471,14 @@ class ConsumerGroup:
         # re-committed per retry.
         if store is not None:
             # offset.store.method=none: offsets for these topics are not
-            # stored anywhere (reference RD_KAFKA_OFFSET_METHOD_NONE)
-            none_keys = [k for k in offsets if store.method(k[0]) == "none"]
+            # stored anywhere (reference RD_KAFKA_OFFSET_METHOD_NONE).
+            # Only STORE-DERIVED auto-commit offsets are filtered — an
+            # explicitly requested commit (commit(message=...) /
+            # commit(offsets=...)) must reach the broker, not vanish
+            # behind a synthetic success callback
+            none_keys = ([k for k in offsets
+                          if store.method(k[0]) == "none"]
+                         if from_store else [])
             if none_keys:
                 offsets = {k: v for k, v in offsets.items()
                            if k not in none_keys}
@@ -590,6 +638,6 @@ class ConsumerGroup:
                 done.append(err)
                 reply.post()
 
-            self.commit_offsets(offsets, _cb)
+            self.commit_offsets(offsets, _cb, from_store=True)
             reply.wait(lambda: bool(done), 1.0)
         self._leave()

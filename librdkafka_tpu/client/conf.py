@@ -313,6 +313,21 @@ PROPERTIES: list[Prop] = [
        "slow dev tunnel) every launch costs more in transfer than the "
        "whole CPU checksum, so the provider self-routes to CPU. "
        "0 disables the gate.", vmin=0, vmax=1_000_000),
+    _p("tpu.pipeline.depth", GLOBAL, "int", 2,
+       "Async offload engine (ops/engine.py): max device launches kept "
+       "in flight by the dedicated dispatch thread (double buffering — "
+       "the codec worker frames batch k while batch k+1 executes on the "
+       "device). 0 disables the engine: every provider call dispatches "
+       "synchronously. No effect with compression.backend=cpu.",
+       vmin=0, vmax=8),
+    _p("tpu.pipeline.fanin.us", GLOBAL, "int", 500,
+       "Async offload engine: bounded fan-in window (microseconds) a "
+       "below-quorum async CRC submission waits for other brokers' "
+       "batches to merge into one launch (cross-broker micro-batch "
+       "aggregation), so tpu.launch.min.batches is met at high toppar "
+       "counts instead of falling back to the CPU provider. 0 "
+       "dispatches immediately. No effect with compression.backend=cpu.",
+       vmin=0, vmax=100_000),
     _p("tpu.lz4.force", GLOBAL, "bool", False,
        "Route lz4 block compression to the device encoder even though it "
        "is slower than the native CPU path (PERF.md: LZ4's match search "
@@ -446,6 +461,8 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "tpu.lz4.force"),
     (GLOBAL, "tpu.mesh.devices"),
     (GLOBAL, "tpu.transport.min.mb.s"),
+    (GLOBAL, "tpu.pipeline.depth"),
+    (GLOBAL, "tpu.pipeline.fanin.us"),
     (GLOBAL, "codec.pipeline.depth"),
     (GLOBAL, "allow.auto.create.topics"),       # KIP-361 (post-1.3.0)
     (GLOBAL, "consume.callback.max.messages"),  # global mirror of the

@@ -234,7 +234,9 @@ class Kafka:
                 min_batches=conf.get("tpu.launch.min.batches"),
                 mesh_devices=conf.get("tpu.mesh.devices"),
                 lz4_force=conf.get("tpu.lz4.force"),
-                min_transport_mb_s=conf.get("tpu.transport.min.mb.s"))
+                min_transport_mb_s=conf.get("tpu.transport.min.mb.s"),
+                pipeline_depth=conf.get("tpu.pipeline.depth"),
+                fanin_us=conf.get("tpu.pipeline.fanin.us"))
         else:
             from ..ops.cpu import CpuCodecProvider
             self.codec_provider = CpuCodecProvider()
@@ -528,9 +530,12 @@ class Kafka:
                 self.op_err(KafkaError(
                     terr, f"topic {name!r}: permanent metadata error",
                     retriable=False))
-        if full and self.cgrp is not None:
-            # regex subscription re-evaluation (rdkafka_pattern.c)
-            self.cgrp.metadata_update(seen)
+        if self.cgrp is not None:
+            # subscription re-evaluation (rdkafka_pattern.c; literal
+            # arrival counts on sparse updates too — a topic created
+            # after subscribe() must rejoin the group when its
+            # per-topic metadata lands, rdkafka_cgrp.c:3412)
+            self.cgrp.metadata_update(seen, full=full)
         # leaderless partitions (election in progress): re-query on the
         # fast interval (topic.metadata.refresh.fast.interval.ms;
         # reference rd_kafka_metadata_refresh fast path)
@@ -1585,6 +1590,14 @@ class Kafka:
             self.background.stop()
         if self.codec_worker is not None:
             self.codec_worker.stop()
+        # async offload engine: drain in-flight launches + stop its
+        # dispatch thread (TpuCodecProvider; CPU provider has no close)
+        pclose = getattr(self.codec_provider, "close", None)
+        if pclose is not None:
+            try:
+                pclose()
+            except Exception:
+                pass
         # Release the fat buffers NOW, not at the next gen2 GC pass:
         # the client object graph is cyclic (rk<->brokers<->toppars<->
         # queues<->callbacks), so without this the arena slabs, socket
@@ -1609,6 +1622,12 @@ class Kafka:
             except Exception:
                 pass
         for b in brokers:
+            # only reap a broker whose thread really exited: a stuck
+            # thread (join timed out above) still OWNS these structures
+            # — clearing them under it races its serve loop ("deque
+            # mutated during iteration", claims lost mid-release)
+            if b.thread.is_alive():
+                continue
             b._rbuf = bytearray()
             b._fetch_deferred.clear()
             b.outq.clear()

@@ -8,6 +8,8 @@ offload of the reference's hot loops (rdkafka_msgset_writer.c:1129
 compress, crc32c.c:39 checksum). ``__graft_entry__.entry()`` delegates
 here.
 """
-from .codec_step import batched_codec_step, example_inputs
+from .codec_step import (batched_codec_step, example_inputs,
+                         pipelined_codec_step)
 
-__all__ = ["batched_codec_step", "example_inputs"]
+__all__ = ["batched_codec_step", "example_inputs",
+           "pipelined_codec_step"]

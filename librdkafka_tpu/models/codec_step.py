@@ -36,6 +36,26 @@ def batched_codec_step(block_bytes: int = 4096, n_blocks: int = 8):
     return step
 
 
+def pipelined_codec_step(engine, block_bytes: int = 4096,
+                         n_blocks: int = 8):
+    """Drive the fused batched codec step through the async offload
+    engine (ops/engine.py): returns ``submit(data, lens) -> Ticket``.
+    The engine's dispatch thread owns the launch and keeps up to its
+    configured depth in flight, so a caller can overlap host-side batch
+    prep of step *k+1* with step *k*'s device execution — the same
+    double-buffered discipline the producer CRC seam uses.  Each ticket
+    resolves to the host tuple ``(compressed, out_lens, crcs)`` via one
+    bulk readback."""
+    import jax
+
+    step = jax.jit(batched_codec_step(block_bytes, n_blocks))
+
+    def submit(data, lens):
+        return engine.submit_compute(step, data, lens)
+
+    return submit
+
+
 def example_inputs(block_bytes: int = 4096, n_blocks: int = 8, seed: int = 0):
     """Deterministic example (data, lens) matching batched_codec_step."""
     rng = np.random.default_rng(seed)

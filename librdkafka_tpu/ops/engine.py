@@ -1,0 +1,399 @@
+"""Pipelined async device-offload engine (the producer seam's
+double-buffered dispatch axis).
+
+BENCH_r05 showed the device-time CRC kernel at 14.6x the CPU provider
+while the end-to-end TPU backend sat at 1.04x: every ``crc32c_many``
+call blocked its caller through host->device copy, launch and readback
+(ops/tpu.py).  The reference hides exactly this class of latency by
+pipelining the msgset writer against broker IO
+(rdkafka_msgset_writer.c -> rdkafka_broker.c request queues); this
+module gives the offload seam the same overlap:
+
+  * ``submit()`` returns a :class:`Ticket` immediately; a dedicated
+    dispatch thread owns every device interaction, keeping up to
+    ``depth`` launches in flight so the codec worker frames and
+    CRC-patches batch *k* on the host while batch *k+1* executes on the
+    device.
+  * Host staging buffers are persistent per ``(B, block)`` pow2 bucket
+    and recycled through a ring of ``depth + 1`` copies (double
+    buffering): filling launch *k+1*'s staging never races launch *k*'s
+    in-flight transfer, and no fresh ``pad_left`` allocation is paid per
+    call.
+  * Cross-submitter micro-batch aggregation: jobs arriving within a
+    bounded fan-in window (default 500 us) merge into ONE launch, so
+    the ``min_batches`` launch quorum is met at high toppar counts
+    instead of each broker's small batch falling back to CPU.
+  * Bulk readback: one ``np.asarray`` per launch plus a vectorized
+    uint32 view — no per-item ``int(x)`` host sync loop.
+
+The engine never changes bytes: block split, left-padding, the GF(2)
+affine term and the host-side combine are exactly ``_crc_many_mxu``
+(ops/crc32c_jax.py), and below the launch quorum jobs are served by the
+caller-supplied CPU fallback — bit-identical either way.  jax is
+imported lazily on the dispatch thread so CPU-only installs importing
+this module never pay for it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+import numpy as np
+
+
+class Ticket:
+    """Handle for one submitted job; resolves to a uint32 ndarray of
+    per-buffer checksums (or raises the launch's exception)."""
+
+    __slots__ = ("_ev", "_result", "_exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result: Optional[np.ndarray] = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("offload ticket not resolved in time")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    # dispatch-thread side -------------------------------------------------
+    def _complete(self, result) -> None:
+        self._result = result
+        self._ev.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._ev.set()
+
+
+class _Job:
+    __slots__ = ("kind", "bufs", "poly", "ticket", "window", "fn", "args")
+
+    def __init__(self, kind, bufs, poly, ticket, window, fn=None, args=()):
+        self.kind = kind            # "crc" | "compute"
+        self.bufs = bufs
+        self.poly = poly
+        self.ticket = ticket
+        self.window = window        # may wait the fan-in window
+        self.fn = fn
+        self.args = args
+
+
+class _Staging:
+    """Persistent host staging arrays per (B, block) bucket, recycled
+    through a ring of ``copies`` buffers so the fill of the next launch
+    never overwrites one still feeding an in-flight transfer."""
+
+    def __init__(self, copies: int):
+        self.copies = max(2, copies)
+        self._rings: dict[tuple[int, int], list[np.ndarray]] = {}
+        self._next: dict[tuple[int, int], int] = {}
+
+    def take(self, B: int, N: int) -> np.ndarray:
+        key = (B, N)
+        ring = self._rings.setdefault(key, [])
+        if len(ring) < self.copies:
+            arr = np.zeros((B, N), dtype=np.uint8)
+            ring.append(arr)
+            return arr
+        i = self._next.get(key, 0)
+        self._next[key] = (i + 1) % self.copies
+        arr = ring[i]
+        arr.fill(0)
+        return arr
+
+    def nbytes(self) -> int:
+        return sum(a.nbytes for ring in self._rings.values() for a in ring)
+
+
+class _Launch:
+    """One in-flight device launch awaiting readback."""
+
+    __slots__ = ("kind", "jobs", "spans", "outs", "chunk_lens", "combine",
+                 "ticket", "out_tree")
+
+    def __init__(self, kind):
+        self.kind = kind
+        self.jobs: list[_Job] = []
+        self.spans: list[tuple[int, int]] = []   # (first_block, nblocks)/buf
+        self.outs: list = []                     # device arrays per chunk
+        self.chunk_lens: list[int] = []          # live rows per chunk
+        self.combine = None
+        self.ticket: Optional[Ticket] = None     # compute kind only
+        self.out_tree = None
+
+
+class AsyncOffloadEngine:
+    """Double-buffered producer/consumer pipeline around the MXU CRC
+    kernels (and, generically, any jitted step fn via
+    :meth:`submit_compute`)."""
+
+    def __init__(self, *, depth: int = 2, fanin_window_s: float = 0.0005,
+                 min_batches: int = 4,
+                 cpu_fallback: Optional[Callable] = None,
+                 name: str = "tpu-engine"):
+        # depth: launches kept in flight before the oldest is read back
+        self.depth = max(1, int(depth))
+        self.fanin_window_s = max(0.0, float(fanin_window_s))
+        self.min_batches = max(1, int(min_batches))
+        # cpu_fallback(bufs, poly) -> list[int]; serves below-quorum jobs
+        self.cpu_fallback = cpu_fallback
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque[_Job] = deque()
+        self._closed = False
+        self._staging = _Staging(copies=self.depth + 1)
+        # observability (PERF.md pipeline section)
+        self.stats = {"launches": 0, "blocks": 0, "jobs": 0,
+                      "aggregated": 0, "cpu_fallback_jobs": 0,
+                      "fanin_waits": 0}
+        self._thread = threading.Thread(target=self._main, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    # ------------------------------------------------------------ public --
+    def submit(self, bufs: list, poly: str = "crc32c",
+               window: bool = True) -> Ticket:
+        """Queue a CRC job; returns immediately.  ``window=False`` skips
+        the fan-in wait (synchronous callers that already meet the
+        quorum shouldn't pay the aggregation latency — whatever is
+        queued at dispatch time still merges in)."""
+        t = Ticket()
+        job = _Job("crc", [bytes(b) for b in bufs], poly, t, window)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine closed")
+            self._queue.append(job)
+            self._cond.notify()
+        return t
+
+    def submit_compute(self, fn, *args) -> Ticket:
+        """Generic pipelined dispatch: run jitted ``fn(*args)`` on the
+        dispatch thread with the same in-flight depth and bulk-readback
+        discipline (used to drive models/codec_step.py through the
+        engine)."""
+        t = Ticket()
+        job = _Job("compute", None, None, t, False, fn=fn, args=args)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine closed")
+            self._queue.append(job)
+            self._cond.notify()
+        return t
+
+    def close(self, timeout: float = 30.0) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout)
+
+    # ---------------------------------------------------- dispatch thread --
+    def _main(self):
+        inflight: deque[_Launch] = deque()
+        while True:
+            with self._cond:
+                if not self._queue and not self._closed:
+                    # with launches in flight, linger only briefly: a
+                    # pipelining submitter's NEXT job should launch
+                    # before the oldest readback blocks this thread
+                    self._cond.wait(timeout=0.0002 if inflight else None)
+                if self._closed and not self._queue and not inflight:
+                    return
+                jobs = self._pop_jobs_locked()
+            if jobs:
+                jobs = self._fanin(jobs)
+                for group in self._group(jobs):
+                    rec = self._launch(group)
+                    if rec is not None:
+                        inflight.append(rec)
+                    # pipeline full: sync the oldest — the newer
+                    # launches keep executing on the device meanwhile
+                    while len(inflight) > self.depth:
+                        self._readback(inflight.popleft())
+                continue            # re-check the queue before syncing
+            if inflight:
+                # nothing new queued: drain completed work rather than
+                # hold results hostage waiting for more submissions
+                self._readback(inflight.popleft())
+
+    def _pop_jobs_locked(self) -> list[_Job]:
+        jobs = list(self._queue)
+        self._queue.clear()
+        return jobs
+
+    def _fanin(self, jobs: list[_Job]) -> list[_Job]:
+        """Bounded fan-in: when the windowed CRC jobs are below the
+        launch quorum, wait up to the window for more submitters (the
+        cross-broker micro-batch aggregation) before dispatching."""
+        if self.fanin_window_s <= 0:
+            return jobs
+        nbufs = sum(len(j.bufs) for j in jobs
+                    if j.kind == "crc" and j.window)
+        if nbufs == 0 or nbufs >= self.min_batches:
+            return jobs
+        self.stats["fanin_waits"] += 1
+        deadline = time.monotonic() + self.fanin_window_s
+        with self._cond:
+            while nbufs < self.min_batches:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed:
+                    break
+                self._cond.wait(left)
+                more = self._pop_jobs_locked()
+                jobs.extend(more)
+                nbufs += sum(len(j.bufs) for j in more
+                             if j.kind == "crc" and j.window)
+        return jobs
+
+    def _group(self, jobs: list[_Job]):
+        """Launch groups: CRC jobs merge per polynomial (shared kernel
+        shape); compute jobs launch individually."""
+        by_poly: dict[str, list[_Job]] = {}
+        order = []
+        for j in jobs:
+            if j.kind == "compute":
+                order.append([j])
+            else:
+                if j.poly not in by_poly:
+                    by_poly[j.poly] = []
+                    order.append(by_poly[j.poly])
+                by_poly[j.poly].append(j)
+        return order
+
+    # -------------------------------------------------------------- launch --
+    def _launch(self, group: list[_Job]) -> Optional[_Launch]:
+        try:
+            if group[0].kind == "compute":
+                return self._launch_compute(group[0])
+            return self._launch_crc(group)
+        except Exception as e:
+            for j in group:
+                j.ticket._fail(e)
+            return None
+
+    def _launch_compute(self, job: _Job) -> _Launch:
+        rec = _Launch("compute")
+        rec.ticket = job.ticket
+        rec.out_tree = job.fn(*job.args)     # async dispatch
+        return rec
+
+    def _launch_crc(self, group: list[_Job]) -> Optional[_Launch]:
+        from ..utils.crc import crc32_combine, crc32c_combine
+        from .crc32c_jax import _MXU_BLOCK, _MXU_MAX_B, _term_host
+        from .packing import next_pow2
+
+        poly = group[0].poly
+        self.stats["jobs"] += len(group)
+        if len(group) > 1:
+            self.stats["aggregated"] += len(group)
+
+        blk = _MXU_BLOCK
+        blocks: list[bytes] = []
+        spans: list[tuple[int, int]] = []
+        for j in group:
+            for b in j.bufs:
+                first = len(blocks)
+                if not b:
+                    spans.append((first, 0))
+                    continue
+                for pos in range(0, len(b), blk):
+                    blocks.append(b[pos:pos + blk])
+                spans.append((first, len(blocks) - first))
+
+        if len(blocks) < self.min_batches and self.cpu_fallback is not None:
+            # below the launch quorum even after fan-in: the CPU
+            # provider serves these (bit-identical), still off the
+            # submitter's thread
+            self.stats["cpu_fallback_jobs"] += len(group)
+            for j in group:
+                try:
+                    vals = self.cpu_fallback(j.bufs, poly)
+                    j.ticket._complete(np.asarray(vals, dtype=np.uint32))
+                except Exception as e:
+                    j.ticket._fail(e)
+            return None
+
+        import jax
+
+        from .crc32c_jax import _jit_mxu
+
+        rec = _Launch("crc")
+        rec.jobs = group
+        rec.spans = spans
+        rec.combine = crc32c_combine if poly == "crc32c" else crc32_combine
+        self.stats["launches"] += 1
+        self.stats["blocks"] += len(blocks)
+
+        for start in range(0, len(blocks), _MXU_MAX_B):
+            chunk = blocks[start:start + _MXU_MAX_B]
+            B = next_pow2(len(chunk))
+            if len(chunk) >= 64:
+                B = max(B, 128)     # MXU tile floor (crc32c_jax.py)
+            # persistent staging: one ring buffer per (B, blk) bucket,
+            # zeroed + row-filled in place (left pad: leading zeros are
+            # a CRC no-op under a zero register)
+            data = self._staging.take(B, blk)
+            terms = np.zeros((B,), dtype=np.uint32)
+            full_term = _term_host(blk, poly)
+            for i, b in enumerate(chunk):
+                n = len(b)
+                data[i, blk - n:] = np.frombuffer(b, dtype=np.uint8)
+                terms[i] = (full_term if n == blk
+                            else _term_host(n, poly))
+            # async dispatch: device_put + kernel launch return
+            # immediately; the readback (np.asarray) is the only sync
+            d = jax.device_put(data)
+            t = jax.device_put(terms)
+            rec.outs.append(_jit_mxu(B, blk, poly)(d, t))
+            rec.chunk_lens.append(len(chunk))
+        return rec
+
+    # ------------------------------------------------------------ readback --
+    def _readback(self, rec: _Launch) -> None:
+        try:
+            if rec.kind == "compute":
+                import jax
+                rec.ticket._complete(
+                    jax.tree_util.tree_map(np.asarray, rec.out_tree))
+                return
+            self._readback_crc(rec)
+        except Exception as e:
+            if rec.kind == "compute":
+                rec.ticket._fail(e)
+            else:
+                for j in rec.jobs:
+                    j.ticket._fail(e)
+
+    def _readback_crc(self, rec: _Launch) -> None:
+        from .crc32c_jax import _MXU_BLOCK
+        blk = _MXU_BLOCK
+        # ONE bulk host sync per chunk + vectorized uint32 view — no
+        # per-item int(x) loop
+        parts = [np.asarray(o).astype(np.uint32)[:n]
+                 for o, n in zip(rec.outs, rec.chunk_lens)]
+        crcs = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        # host-side combine of multi-block buffers (µs each), then slice
+        # results back out per job in submission order
+        it = iter(rec.spans)
+        for j in rec.jobs:
+            out = np.zeros((len(j.bufs),), dtype=np.uint32)
+            for i, b in enumerate(j.bufs):
+                first, nb = next(it)
+                if nb == 0:
+                    continue
+                acc = int(crcs[first])
+                off = blk
+                for k in range(1, nb):
+                    acc = rec.combine(acc, int(crcs[first + k]),
+                                      min(blk, len(b) - off))
+                    off += blk
+                out[i] = acc
+            j.ticket._complete(out)

@@ -47,7 +47,8 @@ class TpuCodecProvider:
 
     def __init__(self, min_batches: int = 4, warmup: bool = True,
                  mesh_devices: int = 0, lz4_force: bool = False,
-                 min_transport_mb_s: float = 100.0):
+                 min_transport_mb_s: float = 100.0,
+                 pipeline_depth: int = 2, fanin_us: int = 500):
         # below this many independent buffers a launch isn't worth it;
         # fall back to the CPU provider (identical bytes either way).
         self.min_batches = max(1, int(min_batches))
@@ -67,6 +68,14 @@ class TpuCodecProvider:
         # self-routes to CPU.  0 disables the gate (always offload).
         self.min_transport_mb_s = float(min_transport_mb_s)
         self.transport_mb_s: float | None = None      # measured by probe
+        # tpu.pipeline.depth / tpu.pipeline.fanin.us: the async
+        # double-buffered dispatch engine (ops/engine.py).  depth=0
+        # disables it — every call dispatches synchronously like r5.
+        self.pipeline_depth = int(pipeline_depth)
+        self.fanin_us = int(fanin_us)
+        self._engine = None
+        self._engine_closed = False
+        self._engine_lock = None    # created lazily with the engine
         self._mesh = None
         self._cpu = _cpu.CpuCodecProvider()
         self._warmup_thread = None
@@ -254,11 +263,71 @@ class TpuCodecProvider:
         # backend's win is the CRC seam.
         return self._cpu.decompress_many(codec, bufs, size_hints)
 
+    # ------------------------------------------------- pipelined offload --
+
+    def _get_engine(self):
+        """The shared async offload engine (ops/engine.py), created on
+        first use.  None when tpu.pipeline.depth=0."""
+        if self.pipeline_depth <= 0 or self._engine_closed:
+            return None
+        if self._engine is None:
+            import threading
+            if self._engine_lock is None:
+                self._engine_lock = threading.Lock()
+            with self._engine_lock:
+                if self._engine is None:
+                    from .engine import AsyncOffloadEngine
+                    self._engine = AsyncOffloadEngine(
+                        depth=self.pipeline_depth,
+                        fanin_window_s=self.fanin_us / 1e6,
+                        min_batches=self.min_batches,
+                        cpu_fallback=self._cpu_crc_fallback,
+                        name="tpu-codec-engine")
+        return self._engine
+
+    def _cpu_crc_fallback(self, bufs: list[bytes], poly: str) -> list[int]:
+        return (self._cpu.crc32c_many(bufs) if poly == "crc32c"
+                else self._cpu.crc32_many(bufs))
+
+    def crc32c_submit(self, bufs: list[bytes]):
+        """Async pipelined CRC32C: returns a Ticket resolving to a
+        uint32 ndarray (one checksum per buffer, bit-identical to the
+        CPU provider), or None when the CPU path is the right route
+        (transport gate closed / pipeline disabled) — the caller then
+        computes synchronously.  Below-quorum submissions ride the
+        engine's bounded fan-in window, merging with other brokers'
+        batches into one launch instead of falling back to CPU."""
+        if not self._offload_pays():
+            return None
+        eng = self._get_engine()
+        if eng is None:
+            return None
+        return eng.submit(bufs, poly="crc32c",
+                          window=len(bufs) < self.min_batches)
+
+    def close(self) -> None:
+        """Tear down the async engine (drains in-flight launches); the
+        provider keeps serving synchronously afterwards — a straggling
+        codec job must not respawn a dispatch thread post-close."""
+        self._engine_closed = True
+        eng, self._engine = self._engine, None
+        if eng is not None:
+            eng.close()
+
     def crc32c_many(self, bufs: list[bytes]) -> list[int]:
         if len(bufs) >= self.min_batches and self._offload_pays():
+            eng = self._get_engine()
+            if eng is not None:
+                # engine route: persistent staging buffers + bulk
+                # readback; window=False — a synchronous caller already
+                # at quorum must not pay the fan-in latency
+                return eng.submit(bufs, "crc32c",
+                                  window=False).result().tolist()
             # ONE GF(2) matmul per 64KB block on the MXU (crc32c_jax.py;
-            # 8.5x native CPU at 128x64KB in device time on v5e-1)
-            return [int(x) for x in _crc32c_many_mxu(bufs)]
+            # 8.5x native CPU at 128x64KB in device time on v5e-1);
+            # .tolist() is one vectorized uint32->int conversion, not a
+            # per-item host sync
+            return np.asarray(_crc32c_many_mxu(bufs)).tolist()
         return self._cpu.crc32c_many(bufs)
 
     def fused_codec_id(self, codec: str) -> int | None:
@@ -285,8 +354,12 @@ class TpuCodecProvider:
         for in-flight requests."""
         if len(bufs) >= self.min_batches and self._offload_pays():
             if self._crc32_ready:
+                eng = self._get_engine()
+                if eng is not None:
+                    return eng.submit(bufs, "crc32",
+                                      window=False).result().tolist()
                 from .crc32c_jax import crc32_many_mxu
-                return [int(x) for x in crc32_many_mxu(bufs)]
+                return np.asarray(crc32_many_mxu(bufs)).tolist()
             self._warm_crc32()
         return self._cpu.crc32_many(bufs)
 

@@ -25,8 +25,10 @@ def tpu_provider():
     # keeps lz4 on the native CPU path — see test_lz4_routes_to_cpu.
     # min_transport_mb_s=0: the gate must not silently route these
     # equivalence tests to the CPU provider on slow transport.
-    return TpuCodecProvider(min_batches=1, lz4_force=True,
+    prov = TpuCodecProvider(min_batches=1, lz4_force=True,
                             min_transport_mb_s=0)
+    yield prov
+    prov.close()      # stop the async engine's dispatch thread cleanly
 
 
 def test_lz4_routes_to_cpu_by_default(monkeypatch):
@@ -198,6 +200,234 @@ def test_other_codecs_fall_back(tpu_provider):
 def test_provider_crc_interface(tpu_provider):
     bufs = [CORPORA["semi"], CORPORA["random_1k"], b"", b"q"]
     assert tpu_provider.crc32c_many(bufs) == [crc32c(b) for b in bufs]
+
+
+# ------------------------------------------------- async offload engine ----
+
+def _cpu_fallback(bufs, poly):
+    prov = cpu.CpuCodecProvider()
+    return (prov.crc32c_many(bufs) if poly == "crc32c"
+            else prov.crc32_many(bufs))
+
+
+def test_engine_crc_bitexact():
+    """The pipelined engine's CRC path (persistent staging buffers,
+    async dispatch, bulk readback, host combine) must be bit-identical
+    to the CPU provider for every size class and both polynomials —
+    across enough submissions to cycle the staging ring."""
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+    from librdkafka_tpu.utils.crc import crc32
+
+    eng = AsyncOffloadEngine(depth=2, fanin_window_s=0.0005,
+                             min_batches=1, cpu_fallback=_cpu_fallback)
+    try:
+        rng = np.random.default_rng(7)
+        bufs = [b"", b"a", b"123456789", bytes(100)] + [
+            rng.integers(0, 256, int(n), dtype=np.uint8).tobytes()
+            for n in [1, 63, 1000, 65535, 65536, 65537, 200_000]]
+        # several rounds so every staging ring slot gets reused with
+        # different contents (a stale-buffer bug would surface here)
+        for round_ in range(4):
+            batch = bufs[round_:] + bufs[:round_]
+            got = eng.submit(batch, "crc32c", window=False).result(120)
+            assert got.tolist() == [crc32c(b) for b in batch]
+        got32 = eng.submit(bufs, "crc32", window=False).result(120)
+        assert got32.tolist() == [crc32(b) for b in bufs]
+    finally:
+        eng.close()
+
+
+def test_engine_fanin_aggregation_and_quorum_fallback():
+    """Below-quorum windowed submissions either merge with concurrent
+    jobs into one launch (cross-broker micro-batch aggregation) or, if
+    the window expires alone, are served by the CPU fallback — bytes
+    identical either way."""
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    eng = AsyncOffloadEngine(depth=2, fanin_window_s=0.002,
+                             min_batches=8, cpu_fallback=_cpu_fallback)
+    try:
+        rng = np.random.default_rng(8)
+        bufs = [rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+                for _ in range(4)]
+        want = [crc32c(b) for b in bufs]
+        # alone below quorum: window expires -> CPU fallback
+        t = eng.submit(bufs[:2], "crc32c", window=True)
+        assert t.result(60).tolist() == want[:2]
+        assert eng.stats["cpu_fallback_jobs"] >= 1
+        # two concurrent below-quorum submitters merge to meet quorum
+        t1 = eng.submit(bufs, "crc32c", window=True)
+        t2 = eng.submit(bufs, "crc32c", window=True)
+        assert t1.result(60).tolist() == want
+        assert t2.result(60).tolist() == want
+    finally:
+        eng.close()
+
+
+def test_engine_submit_compute_codec_step():
+    """models/codec_step.py driven through the engine's generic compute
+    seam: same outputs as the direct step call, via one bulk readback."""
+    from librdkafka_tpu.models.codec_step import (batched_codec_step,
+                                                  example_inputs,
+                                                  pipelined_codec_step)
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    eng = AsyncOffloadEngine(depth=2, min_batches=1,
+                             cpu_fallback=_cpu_fallback)
+    try:
+        data, lens = example_inputs(1024, 4)
+        submit = pipelined_codec_step(eng, 1024, 4)
+        out, olen, crcs = submit(data, lens).result(300)
+        w_out, w_olen, w_crcs = batched_codec_step(1024, 4)(data, lens)
+        assert np.array_equal(out, np.asarray(w_out))
+        assert np.array_equal(olen, np.asarray(w_olen))
+        assert np.array_equal(crcs, np.asarray(w_crcs))
+        # and the CRC lanes are oracle-exact
+        assert [int(c) for c in crcs] == [
+            crc32c(data[i].tobytes()) for i in range(4)]
+    finally:
+        eng.close()
+
+
+def test_provider_pipelined_crc_bitexact(tpu_provider):
+    """TpuCodecProvider's async submit seam resolves to the same values
+    as the synchronous interface and the oracle."""
+    bufs = [CORPORA["semi"], CORPORA["random_1k"], b"", b"q",
+            CORPORA["near_64k"], CORPORA["over_64k"]]
+    want = [crc32c(b) for b in bufs]
+    ticket = tpu_provider.crc32c_submit(bufs)
+    assert ticket is not None
+    assert ticket.result(120).tolist() == want
+    assert tpu_provider.crc32c_many(bufs) == want
+
+
+def test_provider_submit_declines_below_gate():
+    """A closed transport gate returns None from crc32c_submit so the
+    caller stays on the synchronous CPU path (no engine thread spun)."""
+    prov = TpuCodecProvider(min_batches=1, warmup=False,
+                            min_transport_mb_s=100.0)
+    prov.transport_mb_s = 2.0
+    assert prov.crc32c_submit([b"x" * 100]) is None
+    assert prov._engine is None
+    # pipeline disabled: sync route only
+    off = TpuCodecProvider(min_batches=1, warmup=False,
+                           min_transport_mb_s=0, pipeline_depth=0)
+    assert off.crc32c_submit([b"x" * 100]) is None
+
+
+class _SlowTicket:
+    def __init__(self, values, delay):
+        import threading as _t
+        self._ev = _t.Event()
+        self._values = values
+        _t.Timer(delay, self._ev.set).start()
+
+    def done(self):
+        return self._ev.is_set()
+
+    def result(self, timeout=None):
+        self._ev.wait(timeout)
+        return self._values
+
+
+class _SlowProvider:
+    """Fake device provider: every CRC batch resolves after ``delay``
+    seconds, asynchronously — models a device round-trip without jax."""
+
+    def __init__(self, delay=0.2):
+        self.delay = delay
+        self.outstanding = 0
+        self.max_outstanding = 0
+        self._cpu = cpu.CpuCodecProvider()
+        import threading as _t
+        self._lock = _t.Lock()
+
+    def compress_many(self, codec, bufs, level=-1):
+        return self._cpu.compress_many(codec, bufs, level)
+
+    def crc32c_submit(self, regions):
+        vals = np.asarray(self._cpu.crc32c_many([bytes(r) for r in regions]),
+                          dtype=np.uint32)
+        with self._lock:
+            self.outstanding += 1
+            self.max_outstanding = max(self.max_outstanding,
+                                       self.outstanding)
+        t = _SlowTicket(vals, self.delay)
+
+        def _done():
+            with self._lock:
+                self.outstanding -= 1
+        import threading as _t
+        _t.Timer(self.delay, _done).start()
+        return t
+
+    def crc32c_many(self, bufs):
+        import time as _t
+        _t.sleep(self.delay)
+        return self._cpu.crc32c_many(bufs)
+
+
+def test_codec_worker_overlaps_slow_provider():
+    """The codec worker must NOT block for the device round-trip: with a
+    fake provider whose CRC resolves 200 ms after submission, N jobs
+    must overlap (>=2 tickets concurrently in flight) and finish in far
+    less than N * delay — the r5 loop serialized them."""
+    import threading
+    import time as _time
+    from types import SimpleNamespace
+
+    from librdkafka_tpu.client.broker import CodecWorker
+    from librdkafka_tpu.client.msg import Message
+    from librdkafka_tpu.client.queue import OpQueue
+    from librdkafka_tpu.protocol.msgset import MsgsetWriterV2
+
+    delay = 0.2
+    prov = _SlowProvider(delay)
+    rk = SimpleNamespace(
+        interceptors=None,
+        codec_provider=prov,
+        codec_pipeline_depth=4,
+        topic_conf_for=lambda t: {"compression.level": -1})
+    worker = CodecWorker(rk)
+    broker = SimpleNamespace(ops=OpQueue("fake-broker-ops"))
+    tp = SimpleNamespace(topic="t", partition=0)
+
+    def job(i):
+        msgs = []
+        for k in range(4):
+            m = Message("t", value=b"v%d-%d" % (i, k) * 50)
+            msgs.append(m)
+        w = MsgsetWriterV2(codec=None)
+        w.build(msgs, 1700000000000 + i)
+        return [(tp, msgs, w)]
+
+    njobs = 4
+    t0 = _time.perf_counter()
+    for i in range(njobs):
+        worker.submit(broker, job(i), _time.monotonic(), 0)
+    done = []
+    deadline = _time.monotonic() + 10
+    while len(done) < njobs and _time.monotonic() < deadline:
+        op = broker.ops.pop(0.2)
+        if op is not None:
+            done.append(op)
+    elapsed = _time.perf_counter() - t0
+    worker.stop()
+    worker.join(5)
+    assert len(done) == njobs
+    # overlap proof: >=2 device round-trips in flight at once, and the
+    # wall clock beats strict serialization (njobs * delay = 0.8s) by a
+    # wide margin
+    assert prov.max_outstanding >= 2, prov.max_outstanding
+    assert worker.inflight_hwm >= 2, worker.inflight_hwm
+    assert elapsed < njobs * delay * 0.8, elapsed
+    # results arrive in submission order with correct wire bytes
+    for i, op in enumerate(done):
+        kind, results, _ts, _pe = op.payload
+        assert kind == "codec_done"
+        (tp_r, msgs_r, wire, exc) = results[0]
+        assert exc is None
+        assert wire is not None and len(wire) > 61
 
 
 # ------------------------------------------------------------- e2e produce --

@@ -114,3 +114,75 @@ def test_file_corruption_falls_back_to_reset_policy(cluster, tmp_path):
             got.append(m.value)
     c.close()
     assert got == [b"only"]    # auto.offset.reset=earliest kicked in
+
+
+def test_store_method_none_explicit_commit_reaches_broker(cluster,
+                                                          tmp_path):
+    """offset.store.method=none must only suppress STORE-DERIVED
+    auto-commit offsets (reference RD_KAFKA_OFFSET_METHOD_NONE is about
+    the local store): an explicitly requested commit(message=...) /
+    commit(offsets=...) still reaches the broker — the r5 filter
+    swallowed it behind a synthetic success callback."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(10):
+        p.produce("filo", value=b"m%02d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c = _consumer(cluster, tmp_path, group="gnone",
+                  **{"offset.store.method": "none"})
+    c.subscribe(["filo"])
+    got = []
+    deadline = time.monotonic() + 20
+    while len(got) < 5 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    assert len(got) == 5
+    c.commit(message=got[-1])
+    committed = c.committed([TopicPartition("filo", 0)])
+    assert committed[0].offset == got[-1].offset + 1
+    # and no offset file appeared (method=none stores nowhere locally)
+    assert not list(tmp_path.iterdir())
+    c.close()
+
+
+def test_store_method_none_filters_auto_commit(cluster, tmp_path):
+    """The store-derived auto-commit path IS filtered under method=none:
+    consumed-but-uncommitted progress must not reach the broker."""
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(10):
+        p.produce("filo", value=b"m%02d" % i, partition=0)
+    assert p.flush(10.0) == 0
+    p.close()
+
+    c = _consumer(cluster, tmp_path, group="gnone2",
+                  **{"offset.store.method": "none",
+                     "enable.auto.commit": True,
+                     "auto.commit.interval.ms": 50})
+    c.subscribe(["filo"])
+    got = []
+    deadline = time.monotonic() + 20
+    while len(got) < 10 and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    assert len(got) == 10
+    time.sleep(0.5)               # several auto-commit intervals
+    committed = c.committed([TopicPartition("filo", 0)])
+    assert committed[0].offset in (-1, None), committed[0].offset
+    c.close()
+    # close()'s final auto-commit is store-derived too: still nothing
+    c2 = _consumer(cluster, tmp_path, group="gnone2",
+                   **{"offset.store.method": "none"})
+    cm = None
+    deadline = time.monotonic() + 10
+    while cm is None and time.monotonic() < deadline:
+        try:
+            cm = c2.committed([TopicPartition("filo", 0)], timeout=5.0)
+        except Exception:
+            time.sleep(0.2)
+    assert cm is not None and cm[0].offset in (-1, None)
+    c2.close()

@@ -1,0 +1,132 @@
+"""Deferred-fetch claim discipline + close() teardown guards (ISSUE 1
+satellites): a partition migrated off a broker must get its
+``fetch_in_flight`` claim released even while the old broker's
+queued-bytes budget is exhausted (its new leader is otherwise blocked
+by an undrained backlog), and close() must not rip shared structures
+out from under a broker thread that failed to join."""
+import threading
+import time
+from collections import deque
+from types import SimpleNamespace
+
+from librdkafka_tpu.client.broker import Broker, Request
+from librdkafka_tpu.protocol.proto import ApiKey
+
+
+class _FakeTp:
+    def __init__(self, name, part=0, qbytes=0):
+        self.topic = name
+        self.partition = part
+        self.fetch_in_flight = True
+        self.fetchq_bytes = qbytes
+
+
+def _fake_broker(budget_kb: int = 0) -> Broker:
+    """A Broker shell with just the state _serve_deferred_fetch needs —
+    no socket, no thread."""
+    b = Broker.__new__(Broker)
+    b.name = "fake:0/1"
+    b.rk = SimpleNamespace(
+        conf=SimpleNamespace(
+            get=lambda k: {"queued.max.messages.kbytes": budget_kb}[k]),
+        log=lambda *a, **k: None)
+    b.toppars = set()
+    b._fetch_deferred = deque()
+    return b
+
+
+def test_migrated_partition_released_despite_exhausted_budget():
+    """Budget 0 (every drain returns immediately): the migrated
+    partition's claim must still be released, while the owned
+    partition's entry stays parked AND claimed."""
+    b = _fake_broker(budget_kb=0)
+    owned = _FakeTp("owned")
+    migrated = _FakeTp("migrated")
+    b.toppars = {owned}
+    b._fetch_deferred.extend([
+        (migrated, {}, None, 0, 0),
+        (owned, {}, None, 0, 0),
+    ])
+    b._serve_deferred_fetch()
+    assert migrated.fetch_in_flight is False
+    assert owned.fetch_in_flight is True
+    assert len(b._fetch_deferred) == 1
+    assert b._fetch_deferred[0][0] is owned
+
+
+def test_owned_partition_processed_when_budget_allows():
+    b = _fake_broker(budget_kb=1024)
+    owned = _FakeTp("owned")
+    migrated = _FakeTp("migrated")
+    b.toppars = {owned}
+    processed = []
+    b._process_fetch_partition = lambda entry: processed.append(entry[0])
+    b._fetch_deferred.extend([
+        (migrated, {}, None, 0, 0),
+        (owned, {}, None, 0, 0),
+    ])
+    b._serve_deferred_fetch()
+    assert processed == [owned]
+    assert owned.fetch_in_flight is False
+    assert migrated.fetch_in_flight is False
+    assert not b._fetch_deferred
+
+
+def test_close_leaves_stuck_broker_structures_alone():
+    """close() only reaps a broker's buffers/queues when its thread
+    really exited: a stuck thread still owns them (clearing under it
+    races the serve loop)."""
+    from librdkafka_tpu import Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "linger.ms": 2})
+    p.produce("guard", value=b"x", partition=0)
+    assert p.flush(10.0) == 0
+    rk = p._rk
+    with rk._brokers_lock:
+        brokers = list(rk.brokers.values())
+    # wedge one broker's serve loop: it never processes ops, so the
+    # TERMINATE op close() pushes is never seen and the join times out
+    stuck = brokers[0]
+    stuck._serve = lambda: time.sleep(0.05)
+    time.sleep(0.3)               # let any in-progress serve pass drain
+    stuck._rbuf += b"sentinel"
+    stuck.waitresp[999999] = Request(ApiKey.Metadata, {})
+    try:
+        p.close()
+        assert stuck.thread.is_alive()
+        # the stuck broker kept its structures...
+        assert bytes(stuck._rbuf).endswith(b"sentinel")
+        assert 999999 in stuck.waitresp
+        # ...while cleanly-exited brokers were reaped
+        for b in brokers[1:]:
+            if not b.thread.is_alive():
+                assert not b.waitresp
+    finally:
+        stuck.terminate = True    # let the wedged thread exit
+        stuck.thread.join(5)
+
+
+class _Evil:
+    """Deque stand-in whose iteration raises like a mutated deque."""
+
+    def __iter__(self):
+        raise RuntimeError("deque mutated during iteration")
+
+    def clear(self):
+        raise RuntimeError("deque mutated during iteration")
+
+
+def test_broker_exit_deferred_release_survives_concurrent_clear():
+    """The thread-exit deferred-release loop is guarded: a concurrent
+    clear (close() racing a stuck exit path) mutating the deque must
+    not raise out of _thread_main."""
+    conf = {"reconnect.backoff.ms": 100}
+    rk = SimpleNamespace(conf=SimpleNamespace(get=lambda k: conf[k]),
+                         interceptors=None,
+                         dbg=lambda *a, **k: None,
+                         log=lambda *a, **k: None)
+    b = Broker(rk, 1, "localhost", 1)
+    b.terminate = True
+    b._fetch_deferred = _Evil()
+    b._thread_main()          # must return cleanly, not raise
