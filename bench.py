@@ -510,7 +510,224 @@ def pipeline_bench() -> dict:
     return out
 
 
+_HOST_POOL = None
+
+
+def _host_pool():
+    """Persistent worker pool for the fake provider's off-thread work —
+    models the engine's long-lived dispatch thread (a fresh thread per
+    ticket would charge ~0.1 ms of spawn latency per job to the
+    pipeline, an artifact the real engine doesn't have)."""
+    global _HOST_POOL
+    if _HOST_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+        _HOST_POOL = ThreadPoolExecutor(max_workers=8)
+    return _HOST_POOL
+
+
+class _HostJobTicket:
+    """Runs ``fn`` on the pool — the engine's host-job dispatch (the
+    native decompress releases the GIL, so this is true overlap,
+    exactly what AsyncOffloadEngine.submit_compute(host=True) does)."""
+
+    def __init__(self, fn):
+        self._fut = _host_pool().submit(fn)
+
+    def done(self):
+        return self._fut.done()
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+
+class _FakeFetchProvider(_FakeLatencyProvider):
+    """Consumer-side fake: CRC tickets resolve after the modeled device
+    RTT (like _FakeLatencyProvider); the decompress submit seam runs
+    the native inflate on a worker thread, modeling the engine's
+    dispatch thread inflating payloads while the 'device' executes the
+    CRC launch.  The sync interface charges both costs inline, like the
+    pre-ISSUE-2 broker thread did."""
+
+    def crc32_many(self, bufs):
+        time.sleep(self.lat_s)
+        return self._cpu.crc32_many(bufs)
+
+    def crc32c_submit(self, bufs):
+        # the real submit only enqueues: the RTT and the checksum both
+        # happen off the submitting thread ('on the device')
+        def work():
+            time.sleep(self.lat_s)
+            return np.asarray(self._cpu.crc32c_many(bufs),
+                              dtype=np.uint32)
+        return _HostJobTicket(work)
+
+    def decompress_many(self, codec, bufs, size_hints=None):
+        return self._cpu.decompress_many(codec, bufs, size_hints)
+
+    def decompress_submit(self, codec, bufs, size_hints=None):
+        return _HostJobTicket(
+            lambda: self._cpu.decompress_many(codec, bufs, size_hints))
+
+
+def _drive_fetch_sync(provider, jobs):
+    """The r5/pre-ISSUE-2 consumer codec phase: per partition, a
+    blocking CRC verify then a blocking decompress."""
+    outs = []
+    t0 = time.perf_counter()
+    for regions, codec, blobs in jobs:
+        crcs = provider.crc32c_many(regions)
+        outs.append((list(crcs), provider.decompress_many(codec, blobs)))
+    return time.perf_counter() - t0, outs
+
+
+def _drive_fetch_pipelined(provider, jobs, depth=2):
+    """The broker's _PendingFetch admit/reap pattern: submit phase-B
+    CRC + phase-C decompress tickets per partition, park up to
+    ``depth`` entries, resolve strictly FIFO."""
+    from collections import deque
+    pend = deque()
+    outs = []
+
+    def _reap(block):
+        while pend and (block or pend[0][0].done()):
+            block = False
+            ct, dt = pend.popleft()
+            outs.append(([int(x) for x in ct.result(300)],
+                         dt.result(300)))
+
+    t0 = time.perf_counter()
+    for regions, codec, blobs in jobs:
+        while len(pend) >= depth:
+            _reap(True)
+        ct = provider.crc32c_submit(regions)
+        dt = provider.decompress_submit(codec, blobs)
+        pend.append((ct, dt))
+        _reap(False)
+    while pend:
+        _reap(True)
+    return time.perf_counter() - t0, outs
+
+
+def fetch_pipeline_bench() -> dict:
+    """bench.py --fetch-pipeline: synchronous vs pipelined consumer
+    fetch codec phases (ISSUE 2 acceptance) — the PR 1 methodology on
+    the consumer half.  Each job models one fetch-response partition:
+    ``batches`` CRC regions to verify plus the same batches' compressed
+    payloads to inflate.  Two legs:
+
+      fake_latency — CRC rides a modeled device round trip
+        (BENCH_PIPE_LAT_MS, default 2 ms); decompress is host-side in
+        both modes.  Measures exactly the dispatch-overlap win on any
+        host.
+      engine — the real AsyncOffloadEngine: crc32c_submit +
+        decompress_submit (host job on the dispatch thread) vs the
+        synchronous provider calls, over this host's jax backend.
+
+    Both legs assert the CRCs and decompressed payloads are
+    bit-identical to the native CPU provider, and a codec sweep
+    (lz4/snappy/gzip/zstd where available) asserts sync == pipelined
+    per codec.  Env knobs: BENCH_FETCH_JOBS (24), BENCH_FETCH_BATCHES
+    (8), BENCH_PIPE_LAT_MS (2.0), BENCH_FETCH_DEPTH (4 — the shipped
+    tpu.fetch.pipeline.depth default), BENCH_PIPE_DEPTH (2, the engine
+    launch depth of the real-engine leg).
+    """
+    from librdkafka_tpu.ops import cpu as _c
+
+    n_jobs = int(os.environ.get("BENCH_FETCH_JOBS", 24))
+    batches = int(os.environ.get("BENCH_FETCH_BATCHES", 8))
+    lat_ms = float(os.environ.get("BENCH_PIPE_LAT_MS", 2.0))
+    depth = int(os.environ.get("BENCH_FETCH_DEPTH", 4))
+    eng_depth = int(os.environ.get("BENCH_PIPE_DEPTH", 2))
+    prov_cpu = _c.CpuCodecProvider()
+
+    def _make_jobs(codec, n, nb, size=65536):
+        payloads = _payloads(n * nb, size)
+        jobs = []
+        for j in range(n):
+            batch = payloads[j * nb:(j + 1) * nb]
+            blobs = prov_cpu.compress_many(codec, batch)
+            # the CRC regions of a real fetch are the batch bodies —
+            # the compressed wire bytes
+            jobs.append((blobs, codec, blobs))
+        return jobs
+
+    def _want(jobs):
+        return [([int(x) for x in prov_cpu.crc32c_many(regions)],
+                 prov_cpu.decompress_many(codec, blobs))
+                for regions, codec, blobs in jobs]
+
+    jobs = _make_jobs("lz4", n_jobs, batches)
+    want = _want(jobs)
+    out = {"jobs": n_jobs, "batches_per_job": batches, "depth": depth,
+           "codec": "lz4"}
+
+    # --- leg 1: fake-latency provider (overlap win, host-independent)
+    fake = _FakeFetchProvider(lat_ms / 1e3)
+    sync_s, got_sync = _drive_fetch_sync(fake, jobs)
+    pipe_s, got_pipe = _drive_fetch_pipelined(fake, jobs, depth)
+    assert [(list(c), d) for c, d in got_sync] == want
+    assert got_pipe == want
+    out["fake_latency"] = {
+        "latency_ms": lat_ms,
+        "sync_s": round(sync_s, 4),
+        "pipelined_s": round(pipe_s, 4),
+        "overlap_speedup": round(sync_s / max(pipe_s, 1e-9), 2),
+    }
+
+    # --- leg 2: the real engine over this host's jax backend
+    try:
+        from librdkafka_tpu.ops.tpu import TpuCodecProvider
+
+        sync_prov = TpuCodecProvider(min_batches=1, warmup=False,
+                                     min_transport_mb_s=0,
+                                     pipeline_depth=0)
+        pipe_prov = TpuCodecProvider(min_batches=1, warmup=False,
+                                     min_transport_mb_s=0,
+                                     pipeline_depth=eng_depth,
+                                     fanin_us=0)
+        sync_prov.crc32c_many(jobs[0][0])        # compile + warm
+        pipe_prov.crc32c_submit(jobs[0][0]).result(300)
+        sync_s, got_sync = _drive_fetch_sync(sync_prov, jobs)
+        pipe_s, got_pipe = _drive_fetch_pipelined(pipe_prov, jobs, depth)
+        assert [(list(c), d) for c, d in got_sync] == want
+        assert got_pipe == want
+        import jax
+        out["engine"] = {
+            "backend": jax.devices()[0].platform,
+            "sync_s": round(sync_s, 4),
+            "pipelined_s": round(pipe_s, 4),
+            "overlap_speedup": round(sync_s / max(pipe_s, 1e-9), 2),
+            "engine_stats": dict(pipe_prov._engine.stats),
+        }
+        pipe_prov.close()
+    except Exception as e:
+        out["engine"] = {"error": repr(e)}
+
+    # --- codec sweep: sync == pipelined, bit-identical per codec
+    sweep = {}
+    for codec in ("lz4", "snappy", "gzip", "zstd"):
+        try:
+            cj = _make_jobs(codec, 4, 4, size=16384)
+        except Exception as e:
+            sweep[codec] = f"unavailable: {e.__class__.__name__}"
+            continue
+        cw = _want(cj)
+        fake2 = _FakeFetchProvider(0.0005)
+        _, s_out = _drive_fetch_sync(fake2, cj)
+        _, p_out = _drive_fetch_pipelined(fake2, cj, depth)
+        assert [(list(c), d) for c, d in s_out] == cw == p_out
+        sweep[codec] = "bit-identical"
+    out["codec_sweep"] = sweep
+    return out
+
+
 def main():
+    if "--fetch-pipeline" in sys.argv:
+        print(json.dumps({"metric": "pipelined vs synchronous consumer "
+                                    "fetch codec phases (bench.py "
+                                    "--fetch-pipeline)",
+                          **fetch_pipeline_bench()}))
+        return
     if "--pipeline" in sys.argv:
         print(json.dumps({"metric": "pipelined vs synchronous codec "
                                     "offload dispatch (bench.py "

@@ -101,31 +101,69 @@ def _fused_builder():
 
 
 class _PendingCodec:
-    """A codec phase whose CRC launch is in flight on the async offload
-    engine (ops/engine.py): frame + compress + assemble are done; the
-    writers in ``assembled`` await their ticket's checksums.  finish()
-    patches CRCs and returns the results in ``ready`` order."""
+    """A codec phase in flight on the async offload engine
+    (ops/engine.py), as a two-stage state machine:
 
-    __slots__ = ("by_idx", "n", "assembled", "ticket")
+      stage "compress" — the per-(codec,level) compress groups ride the
+        engine as host-job tickets (``comp_tickets``), so compression
+        of batch k+1 runs on the dispatch thread while batch k's CRC
+        launch executes on the device.  When they resolve, the writers
+        assemble and the CRC batch is submitted.
+      stage "crc" — the writers in ``assembled`` await their ticket's
+        checksums; finish() patches CRCs and returns the results in
+        ``ready`` order.
 
-    def __init__(self, by_idx: dict, n: int, assembled: list, ticket):
+    done() advances the state machine opportunistically so the codec
+    worker's poll loop pipelines both stages without blocking."""
+
+    __slots__ = ("rk", "by_idx", "n", "writer_items", "assembled",
+                 "ticket", "comp_tickets")
+
+    def __init__(self, rk, by_idx: dict, n: int, writer_items: list):
+        self.rk = rk
         self.by_idx = by_idx
         self.n = n
-        self.assembled = assembled      # [(idx, (tp, msgs, writer))]
-        self.ticket = ticket
+        self.writer_items = writer_items    # [(idx, (tp, msgs, writer))]
+        self.comp_tickets = None            # [(idxs, ticket)] stage 1
+        self.assembled = []                 # [(idx, (tp, msgs, writer))]
+        self.ticket = None                  # CRC ticket, stage 2
 
     def done(self) -> bool:
-        return self.ticket.done()
+        if self.comp_tickets is not None:
+            if not all(t.done() for _i, t in self.comp_tickets):
+                return False
+            self._assemble()
+        return self.ticket is None or self.ticket.done()
+
+    def _assemble(self) -> None:
+        """Compress tickets resolved: incompressible check + writer
+        assembly + CRC submit — exactly the synchronous phase tail."""
+        tickets, self.comp_tickets = self.comp_tickets, None
+        blobs: dict[int, bytes] = {}
+        try:
+            for idxs, t in tickets:
+                for i, blob in zip(idxs, t.result(120)):
+                    blobs[i] = blob
+        except Exception as e:      # a failed group fails the batch set
+            for i, (tp, msgs, _w) in self.writer_items:
+                self.by_idx[i] = (tp, msgs, None, e)
+            return
+        self.assembled, self.ticket = _assemble_and_submit_crc(
+            self.rk, self.writer_items, self.by_idx, blobs)
 
     def finish(self) -> list:
-        try:
-            crcs = self.ticket.result()
-        except Exception as e:
-            for i, (tp, msgs, _w) in self.assembled:
-                self.by_idx[i] = (tp, msgs, None, e)
-        else:
-            for (i, (tp, msgs, w)), crc in zip(self.assembled, crcs):
-                self.by_idx[i] = (tp, msgs, w.patch_crc(int(crc)), None)
+        if self.comp_tickets is not None:
+            self._assemble()        # blocks on the compress tickets
+        if self.ticket is not None:
+            try:
+                crcs = self.ticket.result()
+            except Exception as e:
+                for i, (tp, msgs, _w) in self.assembled:
+                    self.by_idx[i] = (tp, msgs, None, e)
+            else:
+                for (i, (tp, msgs, w)), crc in zip(self.assembled, crcs):
+                    self.by_idx[i] = (tp, msgs, w.patch_crc(int(crc)),
+                                      None)
         return [self.by_idx[i] for i in range(self.n)]
 
 
@@ -175,23 +213,45 @@ def _begin_codec_phase(rk, ready: list):
 def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
                         n: int):
     """Compress + assemble the non-fused batches, filling ``by_idx`` for
-    failures; the CRC batch goes to the provider's async submit seam
-    when it has one (TpuCodecProvider.crc32c_submit -> Ticket), else it
-    is computed synchronously here.  Returns a _PendingCodec or None."""
+    failures.  With an engine-backed provider BOTH codec stages go
+    async: compression rides ``compress_submit`` (an engine host job,
+    overlapping the previous batch's in-flight CRC launch) and the CRC
+    batch rides ``crc32c_submit``; otherwise each stage runs
+    synchronously here.  Returns a _PendingCodec or None (phase fully
+    resolved into ``by_idx``)."""
     provider = rk.codec_provider
+    # compression.codec and compression.level are topic-scoped:
+    # group the fan-in by (codec, level) so one serve pass honors
+    # every topic's settings (each writer carries its own codec,
+    # resolved at batch formation via Broker._codec_for)
+    by_key: dict = {}
+    for i, (tp, _msgs, w) in writer_items:
+        if w.codec is None:
+            continue
+        lvl = rk.topic_conf_for(tp.topic).get("compression.level")
+        by_key.setdefault((w.codec, lvl), []).append(i)
+    items = {i: item for i, item in writer_items}
+
+    csub = getattr(provider, "compress_submit", None)
+    if csub is not None and by_key:
+        comp_tickets = []
+        for (cdc, lvl), idxs in by_key.items():
+            try:
+                t = csub(cdc, [items[i][2].records_bytes for i in idxs],
+                         lvl)
+            except Exception:
+                t = None
+            if t is None:           # pipeline disabled: sync route below
+                comp_tickets = None
+                break
+            comp_tickets.append((idxs, t))
+        if comp_tickets is not None:
+            pend = _PendingCodec(rk, by_idx, n, writer_items)
+            pend.comp_tickets = comp_tickets
+            return pend
+
     try:
         blobs = {}
-        # compression.codec and compression.level are topic-scoped:
-        # group the fan-in by (codec, level) so one serve pass honors
-        # every topic's settings (each writer carries its own codec,
-        # resolved at batch formation via Broker._codec_for)
-        by_key: dict = {}
-        for i, (tp, _msgs, w) in writer_items:
-            if w.codec is None:
-                continue
-            lvl = rk.topic_conf_for(tp.topic).get("compression.level")
-            by_key.setdefault((w.codec, lvl), []).append(i)
-        items = {i: item for i, item in writer_items}
         for (cdc, lvl), idxs in by_key.items():
             out = provider.compress_many(
                 cdc, [items[i][2].records_bytes for i in idxs], lvl)
@@ -202,6 +262,24 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
             by_idx[i] = (tp, msgs, None, e)
         return None
 
+    assembled, ticket = _assemble_and_submit_crc(rk, writer_items,
+                                                 by_idx, blobs)
+    if ticket is None:
+        return None
+    pend = _PendingCodec(rk, by_idx, n, writer_items)
+    pend.assembled = assembled
+    pend.ticket = ticket
+    return pend
+
+
+def _assemble_and_submit_crc(rk, writer_items: list, by_idx: dict,
+                             blobs: dict):
+    """Incompressible check + writer assembly; the CRC batch goes to
+    the provider's async submit seam when it has one
+    (``crc32c_submit`` -> Ticket), else it is computed synchronously
+    into ``by_idx``.  Returns ``(assembled, ticket)`` — ticket None
+    means the CRC stage fully resolved here."""
+    provider = rk.codec_provider
     assembled = []                # (idx, (tp, msgs, writer))
     regions = []                  # CRC region per batch
     for i, (tp, msgs, writer) in writer_items:
@@ -215,7 +293,7 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
         except Exception as e:
             by_idx[i] = (tp, msgs, None, e)
     if not assembled:
-        return None
+        return [], None
     submit = getattr(provider, "crc32c_submit", None)
     if submit is not None:
         try:
@@ -223,7 +301,7 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
         except Exception:
             ticket = None
         if ticket is not None:
-            return _PendingCodec(by_idx, n, assembled, ticket)
+            return assembled, ticket
     try:
         crcs = provider.crc32c_many(regions)
         for (i, (tp, msgs, writer)), crc in zip(assembled, crcs):
@@ -231,7 +309,33 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
     except Exception as e:
         for i, (tp, msgs, _w) in assembled:
             by_idx[i] = (tp, msgs, None, e)
-    return None
+    return [], None
+
+
+class _PendingFetch:
+    """A fetch partition whose phase-B CRC verify and phase-C decompress
+    are in flight as offload tickets (the consumer mirror of
+    _PendingCodec): phase-A framing/splitting is done, the partition's
+    ``fetch_in_flight`` claim is still held, and phase D (parse +
+    delivery) runs at resolve time — strictly FIFO per broker, so
+    per-partition delivery order is preserved exactly."""
+
+    __slots__ = ("entry", "crc_ticket", "crc_infos",
+                 "legacy_ticket", "legacy_owners", "dec_tickets")
+
+    def __init__(self, entry):
+        self.entry = entry          # (tp, pres, batches, fo, ver)
+        self.crc_ticket = None      # v2 batch-CRC (crc32c) ticket
+        self.crc_infos = ()         # batch infos in crc_ticket order
+        self.legacy_ticket = None   # MsgVer0/1 zlib-poly CRC ticket
+        self.legacy_owners = ()     # (offset, wanted_crc) per region
+        self.dec_tickets = ()       # [(codec, items, ticket)]
+
+    def done(self) -> bool:
+        for t in (self.crc_ticket, self.legacy_ticket):
+            if t is not None and not t.done():
+                return False
+        return all(t.done() for _c, _i, t in self.dec_tickets)
 
 
 class CodecWorker(threading.Thread):
@@ -374,6 +478,10 @@ class Broker:
         # fetch responses' partitions awaiting decompress+parse under
         # the decompressed-ahead budget (see _serve_deferred_fetch)
         self._fetch_deferred: deque = deque()
+        # partitions whose codec phases (CRC verify / decompress) are in
+        # flight as offload tickets (_PendingFetch FIFO; claims held
+        # until phase D resolves — see _reap_fetch_pending)
+        self._fetch_pending: deque = deque()
         self._tls_handshaking = False
         self._codec_outstanding = 0     # async codec jobs in flight
         self._last_throttle = 0         # throttle_cb change detection
@@ -465,6 +573,9 @@ class Broker:
             for entry in list(self._fetch_deferred):
                 entry[0].fetch_in_flight = False
             self._fetch_deferred.clear()
+            for pend in list(self._fetch_pending):
+                pend.entry[0].fetch_in_flight = False
+            self._fetch_pending.clear()
         except Exception:
             pass
         if self.rk.interceptors:
@@ -477,7 +588,7 @@ class Broker:
         # it already received (their toppars hold fetch_in_flight until
         # processed, so leaving them parked would starve the partitions
         # on every broker)
-        if self._fetch_deferred:
+        if self._fetch_deferred or self._fetch_pending:
             self._serve_deferred_fetch()
         if self.state in (BrokerState.INIT, BrokerState.DOWN):
             # sparse connections (reference enable.sparse.connections,
@@ -1708,9 +1819,18 @@ class Broker:
         """Process deferred fetch partitions while the app-side queue
         has room (called from _handle_fetch and each serve pass). The
         queued-bytes sum is computed once per drain and advanced by
-        each processed entry's own contribution — per-entry re-sums
+        each resolved entry's own contribution — per-entry re-sums
         were O(partitions^2) on wide brokers; app-side drains between
-        iterations only make the estimate conservative."""
+        iterations only make the estimate conservative.
+
+        Codec phases are pipelined: each admitted partition's CRC
+        regions and decompress jobs are SUBMITTED as offload tickets
+        (_begin_fetch_partition) and parked in the _PendingFetch FIFO
+        up to tpu.fetch.pipeline.depth deep, so this thread frames and
+        splits the NEXT partition (or fetch response) while the engine
+        dispatch thread and the device execute; tickets resolve in
+        order (_reap_fetch_pending), preserving delivery order, the
+        seek-stamp discard and the CRC-mismatch semantics exactly."""
         # migrated partitions release their claims FIRST, regardless of
         # the queued-bytes budget: the new leader's fetch is blocked on
         # fetch_in_flight, and an undrained old-broker backlog must not
@@ -1724,48 +1844,126 @@ class Broker:
                 else:
                     entry[0].fetch_in_flight = False
             self._fetch_deferred = kept
+        self._reap_fetch_pending(block=False)
         budget = self.rk.conf.get("queued.max.messages.kbytes") * 1024
+        depth = max(1, int(getattr(self.rk, "fetch_pipeline_depth", 2)
+                           or 1))
         queued = self._queued_fetch_bytes()
         while self._fetch_deferred:
             if queued >= budget:
                 return
+            if len(self._fetch_pending) >= depth:
+                # pipeline full: block on the oldest entry's tickets —
+                # the newer launches keep executing meanwhile (the
+                # CodecWorker in-flight gate, consumer side)
+                queued += self._reap_fetch_pending(block=True)
+                continue
             entry = self._fetch_deferred.popleft()
             tp = entry[0]
-            tp.fetch_in_flight = False
             if tp not in self.toppars:
-                continue          # migrated away while deferred
-            before = tp.fetchq_bytes
+                tp.fetch_in_flight = False   # migrated while deferred
+                continue
             try:
-                self._process_fetch_partition(entry)
+                self._fetch_pending.append(
+                    self._begin_fetch_partition(entry))
+            except Exception as e:
+                tp.fetch_in_flight = False
+                self.rk.log("ERROR",
+                            f"{self.name}: fetch partition process: {e!r}")
+                continue
+            # opportunistic reap: keeps the budget accounting current,
+            # and with pre-resolved tickets (CPU provider) preserves the
+            # sync path's strict process-then-admit ordering
+            queued += self._reap_fetch_pending(block=False)
+        self._reap_fetch_pending(block=False)
+
+    def _reap_fetch_pending(self, block: bool) -> int:
+        """Resolve pending fetch partitions strictly FIFO; returns the
+        delivered fetchq-bytes delta for the budget accounting.
+        ``block=True`` waits for the OLDEST entry's tickets (pipeline
+        full), then keeps draining whatever else already resolved."""
+        delta = 0
+        while self._fetch_pending and (block
+                                       or self._fetch_pending[0].done()):
+            block = False
+            pend = self._fetch_pending.popleft()
+            tp = pend.entry[0]
+            before = tp.fetchq_bytes
+            # release-then-process, the sync path's ordering; migrated
+            # partitions only release (their parked data is stale — the
+            # new broker re-fetches the same offsets)
+            tp.fetch_in_flight = False
+            try:
+                if tp in self.toppars:
+                    self._finish_fetch_partition(pend)
             except Exception as e:
                 self.rk.log("ERROR",
                             f"{self.name}: fetch partition process: {e!r}")
-            queued += max(0, tp.fetchq_bytes - before)
+            delta += max(0, tp.fetchq_bytes - before)
+        return delta
 
-    def _process_fetch_partition(self, entry) -> None:
+    @staticmethod
+    def _codec_submit(provider, submit_name: str, sync_fn, regions):
+        """Submit a CRC batch through the provider's async seam
+        (``crc32c_submit`` / ``crc32_submit``), falling back to a
+        pre-resolved ticket computed synchronously right here — an
+        exception is carried in the ticket and re-raises at resolve
+        time, exactly where the synchronous path raised it."""
+        from ..ops.engine import SyncTicket
+        submit = getattr(provider, submit_name, None)
+        if submit is not None:
+            try:
+                t = submit(regions)
+            except Exception:
+                t = None
+            if t is not None:
+                return t
+        try:
+            return SyncTicket(sync_fn(regions))
+        except Exception as e:
+            return SyncTicket(exc=e)
+
+    @staticmethod
+    def _decompress_submit(provider, codec: str, bufs: list):
+        from ..ops.engine import SyncTicket
+        sub = getattr(provider, "decompress_submit", None)
+        if sub is not None:
+            try:
+                t = sub(codec, bufs)
+            except Exception:
+                t = None
+            if t is not None:
+                return t
+        try:
+            return SyncTicket(provider.decompress_many(codec, bufs))
+        except Exception as e:
+            return SyncTicket(exc=e)
+
+    def _begin_fetch_partition(self, entry) -> _PendingFetch:
+        """Phases B+C with the async seam: submit this partition's CRC
+        verify regions (both polynomials) and decompress jobs as
+        offload tickets and return a _PendingFetch.  Submission order —
+        CRC first, then the host decompress job — matches the engine's
+        dispatch order, so the device executes the CRC launch while the
+        dispatch thread inflates the payloads.  Providers without an
+        async seam resolve through pre-resolved SyncTickets: same code
+        path, synchronous schedule, identical bytes."""
         rk = self.rk
-        check_crcs = rk.conf.get("check.crcs")
+        provider = rk.codec_provider
         from ..protocol.msgset import iter_legacy_crc_regions
         tp, pres, batches, fo, ver = entry
+        pend = _PendingFetch(entry)
         # phase B: batched CRC verify for this partition
-        if check_crcs:
-            bad = False
+        if rk.conf.get("check.crcs"):
             if batches:
                 regions = [b[3][proto.V2_OF_Attributes:]
                            for b in batches if b[2] >= fo]
-                infos = [b[0] for b in batches if b[2] >= fo]
                 if regions:
-                    crcs = rk.codec_provider.crc32c_many(regions)
-                    for info, crc in zip(infos, crcs):
-                        if int(crc) != info.crc:
-                            bad = True
-                            rk.op_err(KafkaError(
-                                Err._BAD_MSG,
-                                f"{tp}: CRC mismatch at offset "
-                                f"{info.base_offset}"))
-                            tp.fetch_backoff_until = \
-                                time.monotonic() + 0.5
-                            break
+                    pend.crc_infos = [b[0] for b in batches
+                                      if b[2] >= fo]
+                    pend.crc_ticket = self._codec_submit(
+                        provider, "crc32c_submit", provider.crc32c_many,
+                        regions)
             else:
                 # legacy MsgVer0/1 blobs: per-message zlib CRC,
                 # same batched provider seam (MXU GF(2) kernel on
@@ -1780,47 +1978,72 @@ class Broker:
                         lregions.append(region)
                         lowners.append((off, crc))
                 if lregions:
-                    crcs = rk.codec_provider.crc32_many(lregions)
-                    for (off, want), got in zip(lowners, crcs):
-                        if int(got) != want:
-                            bad = True
-                            rk.op_err(KafkaError(
-                                Err._BAD_MSG,
-                                f"{tp}: legacy message CRC mismatch "
-                                f"at offset {off}"))
-                            tp.fetch_backoff_until = \
-                                time.monotonic() + 0.5
-                            break
-            if bad:
-                return
-        # phase C: batched decompress of this partition's batches.
-        # A failing batch gets payload=None instead of failing the
-        # partition here: phase D skips aborted/control batches
-        # without reading them, so a corrupt batch inside an
-        # aborted transaction must not suppress the partition's
-        # valid committed data
+                    pend.legacy_owners = lowners
+                    pend.legacy_ticket = self._codec_submit(
+                        provider, "crc32_submit", provider.crc32_many,
+                        lregions)
+        # phase C: batched decompress, submitted eagerly (not gated on
+        # the CRC results): a mismatch is the rare path and its
+        # decompressed bytes are simply discarded at resolve time —
+        # wire-visible behavior is identical to verify-then-decompress
         if batches:
             by_codec: dict[str, list] = {}
             for b in batches:
                 info, _payload, last, _full = b
                 if last >= fo and info.codec:
                     by_codec.setdefault(info.codec, []).append(b)
-            for codec, items in by_codec.items():
-                blobs = None
+            pend.dec_tickets = [
+                (codec, items, self._decompress_submit(
+                    provider, codec, [b[1] for b in items]))
+                for codec, items in by_codec.items()]
+        return pend
+
+    def _finish_fetch_partition(self, pend: _PendingFetch) -> None:
+        """Resolve a partition's codec tickets and run phase D, with
+        the synchronous path's exact observable semantics: a CRC
+        mismatch emits Err._BAD_MSG + 0.5s fetch backoff and drops the
+        partition's batches; a failing decompress isolates per batch
+        (payload=None) so a corrupt batch inside an aborted transaction
+        does not suppress the partition's valid committed data; the
+        delivery is stamped with the (fetch_offset, version) snapshot
+        so post-seek resolutions get discarded."""
+        rk = self.rk
+        tp, pres, batches, fo, ver = pend.entry
+        if pend.crc_ticket is not None:
+            crcs = pend.crc_ticket.result(60.0)
+            for info, crc in zip(pend.crc_infos, crcs):
+                if int(crc) != info.crc:
+                    rk.op_err(KafkaError(
+                        Err._BAD_MSG,
+                        f"{tp}: CRC mismatch at offset "
+                        f"{info.base_offset}"))
+                    tp.fetch_backoff_until = time.monotonic() + 0.5
+                    return
+        if pend.legacy_ticket is not None:
+            crcs = pend.legacy_ticket.result(60.0)
+            for (off, want), got in zip(pend.legacy_owners, crcs):
+                if int(got) != want:
+                    rk.op_err(KafkaError(
+                        Err._BAD_MSG,
+                        f"{tp}: legacy message CRC mismatch "
+                        f"at offset {off}"))
+                    tp.fetch_backoff_until = time.monotonic() + 0.5
+                    return
+        for codec, items, ticket in pend.dec_tickets:
+            blobs = None
+            try:
+                blobs = ticket.result(60.0)
+            except Exception:
+                pass   # isolate the failing batch below
+            for i, b in enumerate(items):
+                if blobs is not None:
+                    b[1] = blobs[i]
+                    continue
                 try:
-                    blobs = rk.codec_provider.decompress_many(
-                        codec, [b[1] for b in items])
+                    b[1] = rk.codec_provider.decompress_many(
+                        codec, [b[1]])[0]
                 except Exception:
-                    pass   # isolate the failing batch below
-                for i, b in enumerate(items):
-                    if blobs is not None:
-                        b[1] = blobs[i]
-                        continue
-                    try:
-                        b[1] = rk.codec_provider.decompress_many(
-                            codec, [b[1]])[0]
-                    except Exception:
-                        b[1] = None
+                    b[1] = None
         # phase D: record parsing + delivery op for this partition
         rk.fetch_reply_handle(
             tp, pres, self,

@@ -328,6 +328,16 @@ PROPERTIES: list[Prop] = [
        "counts instead of falling back to the CPU provider. 0 "
        "dispatches immediately. No effect with compression.backend=cpu.",
        vmin=0, vmax=100_000),
+    _p("tpu.fetch.pipeline.depth", GLOBAL, "int", 4,
+       "Consumer fetch codec pipeline: max fetch partitions per broker "
+       "whose CRC-verify/decompress offload tickets may be in flight "
+       "before the serve loop blocks on the oldest (the consumer-side "
+       "mirror of tpu.pipeline.depth — that knob still sizes the device "
+       "engine's launch depth; this one bounds how many partitions may "
+       "be decompressed ahead of the queued.max.messages.kbytes "
+       "accounting). With compression.backend=cpu tickets resolve "
+       "eagerly, so the depth has no effect there.", vmin=1, vmax=64,
+       app=C),
     _p("tpu.lz4.force", GLOBAL, "bool", False,
        "Route lz4 block compression to the device encoder even though it "
        "is slower than the native CPU path (PERF.md: LZ4's match search "
@@ -463,6 +473,7 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "tpu.transport.min.mb.s"),
     (GLOBAL, "tpu.pipeline.depth"),
     (GLOBAL, "tpu.pipeline.fanin.us"),
+    (GLOBAL, "tpu.fetch.pipeline.depth"),
     (GLOBAL, "codec.pipeline.depth"),
     (GLOBAL, "allow.auto.create.topics"),       # KIP-361 (post-1.3.0)
     (GLOBAL, "consume.callback.max.messages"),  # global mirror of the

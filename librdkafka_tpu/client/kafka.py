@@ -248,6 +248,9 @@ class Kafka:
         # codec pipeline thread (codec.pipeline.depth; SURVEY.md §5
         # axis 2 — overlap batch build/socket IO with codec launches)
         self.codec_pipeline_depth = conf.get("codec.pipeline.depth")
+        # consumer fetch codec pipeline: max _PendingFetch entries in
+        # flight per broker (broker.py _serve_deferred_fetch)
+        self.fetch_pipeline_depth = conf.get("tpu.fetch.pipeline.depth")
         self.codec_worker = None
         if self.is_producer and self.codec_pipeline_depth > 0:
             from .broker import CodecWorker

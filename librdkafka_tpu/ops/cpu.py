@@ -507,6 +507,29 @@ class CpuCodecProvider:
             return ext.crc32c_many(bufs)
         return [int(x) for x in crc32c_many(bufs)]
 
+    # ------------------------------------------------ ticket-shaped seam --
+    # The async offload submit interface, resolved eagerly: the work
+    # runs synchronously right here (no dispatch thread — backend=cpu
+    # spawns nothing), but callers get the same Ticket contract as the
+    # TPU provider, so the broker's fetch/codec pipelines run ONE
+    # submit/park/resolve code path for both backends and tier-1
+    # exercises the pipelined path on every test run.
+
+    def crc32c_submit(self, bufs: list[bytes]):
+        from .engine import SyncTicket
+        return SyncTicket(np.asarray(self.crc32c_many(bufs),
+                                     dtype=np.uint32))
+
+    def crc32_submit(self, bufs: list[bytes]):
+        from .engine import SyncTicket
+        return SyncTicket(np.asarray(self.crc32_many(bufs),
+                                     dtype=np.uint32))
+
+    def decompress_submit(self, codec: str, bufs: list[bytes],
+                          size_hints: list[int] | None = None):
+        from .engine import SyncTicket
+        return SyncTicket(self.decompress_many(codec, bufs, size_hints))
+
     def fused_codec_id(self, codec: str) -> int | None:
         """Wire attribute id when the fused native batch builder
         (tk_enqlane.build_batch: frame+compress+CRC+header in one

@@ -65,20 +65,45 @@ class Ticket:
         return self._result
 
     # dispatch-thread side -------------------------------------------------
+    # (first resolution wins: the shutdown sweep failing stragglers must
+    # not clobber a result the dispatch thread already delivered)
     def _complete(self, result) -> None:
-        self._result = result
-        self._ev.set()
+        if not self._ev.is_set():
+            self._result = result
+            self._ev.set()
 
     def _fail(self, exc: BaseException) -> None:
+        if not self._ev.is_set():
+            self._exc = exc
+            self._ev.set()
+
+
+class SyncTicket:
+    """Pre-resolved ticket: the CPU provider's (and any synchronous
+    fallback's) ticket-shaped result, so pipelined and synchronous
+    codec paths flow through ONE submit/park/resolve code path in the
+    broker instead of two diverging branches."""
+
+    __slots__ = ("_result", "_exc")
+
+    def __init__(self, result=None, exc: Optional[BaseException] = None):
+        self._result = result
         self._exc = exc
-        self._ev.set()
+
+    def done(self) -> bool:
+        return True
+
+    def result(self, timeout: Optional[float] = None):
+        if self._exc is not None:
+            raise self._exc
+        return self._result
 
 
 class _Job:
     __slots__ = ("kind", "bufs", "poly", "ticket", "window", "fn", "args")
 
     def __init__(self, kind, bufs, poly, ticket, window, fn=None, args=()):
-        self.kind = kind            # "crc" | "compute"
+        self.kind = kind            # "crc" | "compute" | "host"
         self.bufs = bufs
         self.poly = poly
         self.ticket = ticket
@@ -154,7 +179,7 @@ class AsyncOffloadEngine:
         # observability (PERF.md pipeline section)
         self.stats = {"launches": 0, "blocks": 0, "jobs": 0,
                       "aggregated": 0, "cpu_fallback_jobs": 0,
-                      "fanin_waits": 0}
+                      "fanin_waits": 0, "host_jobs": 0}
         self._thread = threading.Thread(target=self._main, daemon=True,
                                         name=name)
         self._thread.start()
@@ -175,13 +200,21 @@ class AsyncOffloadEngine:
             self._cond.notify()
         return t
 
-    def submit_compute(self, fn, *args) -> Ticket:
-        """Generic pipelined dispatch: run jitted ``fn(*args)`` on the
-        dispatch thread with the same in-flight depth and bulk-readback
-        discipline (used to drive models/codec_step.py through the
-        engine)."""
+    def submit_compute(self, fn, *args, host: bool = False) -> Ticket:
+        """Generic pipelined dispatch: run ``fn(*args)`` on the dispatch
+        thread.  ``host=False`` treats the return value as a tree of
+        device arrays with the same in-flight depth and bulk-readback
+        discipline (drives models/codec_step.py through the engine);
+        ``host=True`` runs a plain host function (e.g. the native
+        ``*_decompress_many`` paths of the consumer fetch seam) to
+        completion on the dispatch thread and resolves the ticket with
+        its raw return value — no jax import, no readback.  A host job
+        naturally overlaps any device launch already in flight: the
+        device executes while the dispatch thread runs the (GIL-
+        releasing) native call."""
         t = Ticket()
-        job = _Job("compute", None, None, t, False, fn=fn, args=args)
+        job = _Job("host" if host else "compute", None, None, t, False,
+                   fn=fn, args=args)
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine closed")
@@ -190,14 +223,50 @@ class AsyncOffloadEngine:
         return t
 
     def close(self, timeout: float = 30.0) -> None:
+        """Stop the dispatch thread.  Outstanding work drains
+        deterministically: queued + in-flight jobs are completed by the
+        exiting thread, and anything it could not reach (a wedged or
+        crashed dispatch thread, or a join timeout) is FAILED rather
+        than left to hang its waiter forever in Ticket.result()."""
         with self._cond:
             self._closed = True
             self._cond.notify()
         self._thread.join(timeout)
+        if self._thread.is_alive():
+            # join timed out: the dispatch thread is wedged (e.g. a hung
+            # device launch).  Fail every job still visible so waiters
+            # unblock; first-resolution-wins keeps this safe against the
+            # thread completing them concurrently.
+            with self._cond:
+                stranded = self._pop_jobs_locked()
+            exc = RuntimeError("offload engine closed (dispatch thread "
+                               "did not exit in time)")
+            for j in stranded:
+                j.ticket._fail(exc)
 
     # ---------------------------------------------------- dispatch thread --
     def _main(self):
         inflight: deque[_Launch] = deque()
+        try:
+            self._main_loop(inflight)
+        finally:
+            # deterministic shutdown: whether the loop exited cleanly
+            # (drained) or died on an unexpected error, no ticket may be
+            # left unresolved — a parked _PendingFetch/_PendingCodec
+            # would otherwise block its thread forever in result()
+            with self._cond:
+                stranded = self._pop_jobs_locked()
+            exc = RuntimeError("offload engine dispatch thread exited")
+            for j in stranded:
+                j.ticket._fail(exc)
+            for rec in inflight:
+                if rec.kind == "crc":
+                    for j in rec.jobs:
+                        j.ticket._fail(exc)
+                elif rec.ticket is not None:
+                    rec.ticket._fail(exc)
+
+    def _main_loop(self, inflight: deque):
         while True:
             with self._cond:
                 if not self._queue and not self._closed:
@@ -255,11 +324,11 @@ class AsyncOffloadEngine:
 
     def _group(self, jobs: list[_Job]):
         """Launch groups: CRC jobs merge per polynomial (shared kernel
-        shape); compute jobs launch individually."""
+        shape); compute/host jobs launch individually."""
         by_poly: dict[str, list[_Job]] = {}
         order = []
         for j in jobs:
-            if j.kind == "compute":
+            if j.kind != "crc":
                 order.append([j])
             else:
                 if j.poly not in by_poly:
@@ -271,6 +340,14 @@ class AsyncOffloadEngine:
     # -------------------------------------------------------------- launch --
     def _launch(self, group: list[_Job]) -> Optional[_Launch]:
         try:
+            if group[0].kind == "host":
+                # host compute (native decompress/compress): runs to
+                # completion here, overlapping whatever device launches
+                # are already in flight
+                job = group[0]
+                self.stats["host_jobs"] += 1
+                job.ticket._complete(job.fn(*job.args))
+                return None
             if group[0].kind == "compute":
                 return self._launch_compute(group[0])
             return self._launch_crc(group)

@@ -263,6 +263,34 @@ class TpuCodecProvider:
         # backend's win is the CRC seam.
         return self._cpu.decompress_many(codec, bufs, size_hints)
 
+    def decompress_submit(self, codec: str, bufs: list[bytes],
+                          size_hints: list[int] | None = None):
+        """Pipelined fetch-phase-C decompress: run the native
+        ``*_decompress_many`` path on the engine's dispatch thread as a
+        host job and return a Ticket, so the fetch-parsing broker
+        thread frames the NEXT partition while this one inflates —
+        overlapping any in-flight CRC launch too.  None when the
+        pipeline is disabled (the caller decompresses synchronously,
+        bit-identical bytes either way)."""
+        eng = self._get_engine()
+        if eng is None:
+            return None
+        return eng.submit_compute(self._cpu.decompress_many, codec,
+                                  bufs, size_hints, host=True)
+
+    def compress_submit(self, codec: str, bufs: list[bytes],
+                        level: int = -1):
+        """Pipelined producer-phase-2 compress: run compress_many as an
+        engine host job so compression of batch k+1 overlaps the
+        in-flight CRC launch of batch k (the codec worker previously
+        blocked on the native compress before it could submit the next
+        CRC).  None when the pipeline is disabled."""
+        eng = self._get_engine()
+        if eng is None:
+            return None
+        return eng.submit_compute(self.compress_many, codec, bufs, level,
+                                  host=True)
+
     # ------------------------------------------------- pipelined offload --
 
     def _get_engine(self):
@@ -303,6 +331,24 @@ class TpuCodecProvider:
         if eng is None:
             return None
         return eng.submit(bufs, poly="crc32c",
+                          window=len(bufs) < self.min_batches)
+
+    def crc32_submit(self, bufs: list[bytes]):
+        """Async pipelined legacy (zlib-poly) CRC — the crc32 mirror of
+        :meth:`crc32c_submit`, feeding the consumer's MsgVer0/1 fetch
+        verify.  Returns None (caller computes synchronously on the CPU
+        path) until the background-compiled crc32 kernel is ready, so
+        the first legacy fetches never stall the broker thread behind
+        an XLA compile (see crc32_many)."""
+        if not self._offload_pays():
+            return None
+        if not self._crc32_ready:
+            self._warm_crc32()
+            return None
+        eng = self._get_engine()
+        if eng is None:
+            return None
+        return eng.submit(bufs, poly="crc32",
                           window=len(bufs) < self.min_batches)
 
     def close(self) -> None:

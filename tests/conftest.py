@@ -23,6 +23,32 @@ except Exception:
     pass  # backend already initialized (e.g. single-test re-entry)
 
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_engine_threads():
+    """Every test must leave zero live offload-engine dispatch threads
+    (AsyncOffloadEngine close() joined): a leaked engine means some
+    provider/client teardown path lost track of its pipeline, and such
+    regressions should fail HERE as a thread leak instead of surfacing
+    later as flaky cross-test timeouts or stuck teardowns."""
+    yield
+    deadline = time.monotonic() + 2.0   # grace for in-progress close()
+
+    def leaked():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and "engine" in t.name]
+
+    while leaked() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not leaked(), \
+        f"leaked offload-engine dispatch threads: {leaked()}"
+
+
 # The interop tier's reference build lives in test_0200_interop.py as a
 # module-scoped fixture — it only builds when that module actually runs
 # (a conftest-level hook stalled every pytest invocation for minutes).

@@ -18,14 +18,18 @@ from librdkafka_tpu.utils.crc import crc32c
 from test_0017_codecs import CORPORA, IDS
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture
 def tpu_provider():
     # lz4_force=True: this suite exists to prove the DEVICE lz4 encoder
     # is bit-exact.  Production routing (tpu.lz4.force=false, default)
     # keeps lz4 on the native CPU path — see test_lz4_routes_to_cpu.
     # min_transport_mb_s=0: the gate must not silently route these
     # equivalence tests to the CPU provider on slow transport.
-    prov = TpuCodecProvider(min_batches=1, lz4_force=True,
+    # Function-scoped (warmup=False) so each test's engine is closed
+    # before the conftest thread-leak check runs; the expensive XLA
+    # compiles live in module-level lru_caches, paid once per process
+    # regardless of provider lifetime.
+    prov = TpuCodecProvider(min_batches=1, lz4_force=True, warmup=False,
                             min_transport_mb_s=0)
     yield prov
     prov.close()      # stop the async engine's dispatch thread cleanly
@@ -76,11 +80,14 @@ def test_crc_transport_gate(monkeypatch):
     monkeypatch.setattr(tpu_mod, "_crc32c_many_mxu",
                         crc32c_jax.crc32c_many_mxu)
     assert fast.crc32c_many(bufs) == want
+    fast.close()
     # gate disabled: offloads regardless of measured transport
     off = TpuCodecProvider(min_batches=1, warmup=False,
                            min_transport_mb_s=0)
     off.transport_mb_s = 2.0
     assert off.crc32c_many(bufs) == want
+    off.close()
+    slow.close()
 
 
 # ------------------------------------------------------------------ crc32c --
@@ -287,6 +294,80 @@ def test_engine_submit_compute_codec_step():
             crc32c(data[i].tobytes()) for i in range(4)]
     finally:
         eng.close()
+
+
+def test_engine_host_compute_jobs():
+    """submit_compute(host=True) runs a plain host fn on the dispatch
+    thread and resolves the ticket with its raw return value (no jax
+    readback) — the fetch decompress seam; a raising host fn fails its
+    own ticket without killing the engine."""
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    eng = AsyncOffloadEngine(depth=2, min_batches=1,
+                             cpu_fallback=_cpu_fallback)
+    try:
+        prov = cpu.CpuCodecProvider()
+        payloads = [b"host-job-%d" % i * 40 for i in range(5)]
+        comp = prov.compress_many("lz4", payloads)
+        t = eng.submit_compute(prov.decompress_many, "lz4", comp,
+                               [len(p) for p in payloads], host=True)
+        assert t.result(120) == payloads
+
+        def boom():
+            raise ValueError("host job failed")
+
+        with pytest.raises(ValueError):
+            eng.submit_compute(boom, host=True).result(120)
+        # the engine still serves CRC launches after a failed host job
+        got = eng.submit([b"123456789"], "crc32c", window=False)
+        assert got.result(120).tolist() == [0xE3069283]
+    finally:
+        eng.close()
+
+
+def test_engine_close_with_inflight_resolves_every_ticket():
+    """close() must drain or fail outstanding tickets deterministically
+    (ISSUE 2 satellite): no Ticket.result() may hang forever after
+    close() returns — queued jobs drain on a clean exit, and jobs a
+    wedged dispatch thread cannot reach are FAILED."""
+    import threading
+    import time as _time
+
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    # clean close: queued work drains (results, not errors)
+    eng = AsyncOffloadEngine(depth=2, min_batches=1,
+                             cpu_fallback=_cpu_fallback)
+    tickets = [eng.submit_compute(lambda i=i: (_time.sleep(0.02), i)[1],
+                                  host=True) for i in range(8)]
+    eng.close()
+    for i, t in enumerate(tickets):
+        assert t.done(), "ticket left unresolved after close()"
+        assert t.result(0) == i
+    with pytest.raises(RuntimeError):     # post-close submits refused
+        eng.submit([b"x"], "crc32c", window=False)
+
+    # wedged dispatch thread: close(timeout) expires while a host job
+    # holds the thread — the job queued BEHIND it must be failed, not
+    # left hanging its waiter; the in-flight job itself still completes
+    eng2 = AsyncOffloadEngine(depth=1, min_batches=1,
+                              cpu_fallback=_cpu_fallback)
+    started = threading.Event()
+
+    def wedge():
+        started.set()
+        _time.sleep(0.8)
+        return "wedge-done"
+
+    t_wedge = eng2.submit_compute(wedge, host=True)
+    assert started.wait(10)
+    t_stuck = eng2.submit_compute(lambda: 2, host=True)
+    eng2.close(timeout=0.1)
+    with pytest.raises(RuntimeError):
+        t_stuck.result(5)
+    assert t_wedge.result(5) == "wedge-done"
+    eng2._thread.join(5)
+    assert not eng2._thread.is_alive()
 
 
 def test_provider_pipelined_crc_bitexact(tpu_provider):

@@ -3,15 +3,21 @@ rdkafka_msgset_reader.c:950-1016 CRC verify + :258-530 decompress; the
 rebuild runs both as ONE provider call per Fetch response): corrupted
 wire bytes are rejected by the batched CRC check, compressed multi-
 partition fetches decode through the batched decompress, and clean
-traffic round-trips."""
+traffic round-trips — including through the ASYNC ticketed fetch
+pipeline (ISSUE 2): CRC mismatch semantics, seek-stamp discard and
+wire-visible delivery must be identical when phases B/C resolve as
+offload tickets instead of synchronous provider calls."""
 import struct
+import threading
 import time
 
+import numpy as np
 import pytest
 
 from librdkafka_tpu import Consumer, Producer
 from librdkafka_tpu.client.errors import Err
 from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.ops.cpu import CpuCodecProvider
 from librdkafka_tpu.protocol import proto
 
 
@@ -72,6 +78,194 @@ def test_corrupted_batch_rejected_by_batched_crc(cluster):
     c.close()
     assert any(e.code == Err._BAD_MSG for e in errs), errs
     assert not got, "corrupted batch must not be delivered"
+
+
+# ------------------------------------------------ async ticketed fetch ----
+
+class _GatedTicket:
+    """Ticket that refuses to resolve until the shared gate opens —
+    deterministic control over when the broker's _PendingFetch reap can
+    run phase D."""
+
+    def __init__(self, fn, gate):
+        self._fn = fn
+        self._gate = gate
+        self._result = None
+        self._resolved = False
+
+    def done(self):
+        if not self._gate.is_set():
+            return False
+        self._resolve()
+        return True
+
+    def _resolve(self):
+        if not self._resolved:
+            self._result = self._fn()
+            self._resolved = True
+
+    def result(self, timeout=None):
+        if not self._gate.wait(timeout):
+            raise TimeoutError("gated fetch ticket")
+        self._resolve()
+        return self._result
+
+
+class _GatedProvider:
+    """CPU-correct provider whose submit seams hand out _GatedTickets:
+    the broker parks _PendingFetch entries until the test opens the
+    gate — an engine round trip with a hand on the clock."""
+
+    def __init__(self, gate_open=False):
+        self._cpu = CpuCodecProvider()
+        self.gate = threading.Event()
+        if gate_open:
+            self.gate.set()
+        self.submits = 0
+
+    def _ticket(self, fn):
+        self.submits += 1
+        return _GatedTicket(fn, self.gate)
+
+    def crc32c_submit(self, bufs):
+        bufs = [bytes(b) for b in bufs]
+        return self._ticket(lambda: np.asarray(
+            self._cpu.crc32c_many(bufs), dtype=np.uint32))
+
+    def crc32_submit(self, bufs):
+        bufs = [bytes(b) for b in bufs]
+        return self._ticket(lambda: np.asarray(
+            self._cpu.crc32_many(bufs), dtype=np.uint32))
+
+    def decompress_submit(self, codec, bufs, size_hints=None):
+        bufs = [bytes(b) for b in bufs]
+        return self._ticket(
+            lambda: self._cpu.decompress_many(codec, bufs, size_hints))
+
+    def __getattr__(self, name):          # sync interface passthrough
+        return getattr(self._cpu, name)
+
+
+def test_crc_mismatch_through_ticket_errs_and_backs_off(cluster):
+    """Phase B resolving through an async ticket must keep the exact
+    mismatch semantics: Err._BAD_MSG via op_err, a 0.5s fetch backoff,
+    and the partition's batches dropped undelivered."""
+    _produce(cluster, 10, codec="none", parts=1)
+    part = cluster.partition("fv", 0)
+    base, blob = part.log[0]
+    corrupt = bytearray(blob)
+    corrupt[proto.V2_HEADER_SIZE + 2] ^= 0xFF
+    part.log[0] = (base, bytes(corrupt))
+
+    errs = []
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "gtcrc", "auto.offset.reset": "earliest",
+                  "check.crcs": True,
+                  "error_cb": lambda e: errs.append(e)})
+    prov = _GatedProvider(gate_open=True)
+    c._rk.codec_provider = prov
+    c.subscribe(["fv"])
+    got = []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not errs:
+        m = c.poll(0.3)
+        if m is not None and m.error is None:
+            got.append(m)
+    tp = c._rk.get_toppar("fv", 0, create=False)
+    backoff_left = (tp.fetch_backoff_until - time.monotonic()
+                    if tp is not None else -1.0)
+    c.close()
+    assert prov.submits > 0, "fetch path never used the async seam"
+    assert any(e.code == Err._BAD_MSG for e in errs), errs
+    assert not got, "corrupted batch must not be delivered"
+    assert 0.0 < backoff_left <= 0.5, backoff_left
+
+
+def test_seek_with_ticket_in_flight_discards_stale_delivery():
+    """A seek() bumping tp.version while the partition's codec tickets
+    are parked in the _PendingFetch FIFO must discard that resolution
+    (no stale offsets delivered) and resume exactly at the seek
+    point."""
+    from librdkafka_tpu.client.consumer import TopicPartition
+
+    cluster = MockCluster(num_brokers=1, topics={"fvs": 1})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "linger.ms": 5, "compression.codec": "lz4"})
+        for i in range(200):
+            p.produce("fvs", value=b"s%05d" % i, partition=0)
+        assert p.flush(15.0) == 0
+        p.close()
+
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "gseek", "auto.offset.reset": "earliest",
+                      "check.crcs": True})
+        prov = _GatedProvider(gate_open=False)   # park every resolution
+        c._rk.codec_provider = prov
+        c.subscribe(["fvs"])
+        # poll until the first fetch's tickets are parked (assignment +
+        # fetch + _begin_fetch_partition all happened); no messages can
+        # arrive while the gate is shut
+        deadline = time.monotonic() + 20
+        while prov.submits == 0 and time.monotonic() < deadline:
+            assert c.poll(0.1) is None, "delivery while codec gate shut"
+        assert prov.submits > 0, "no ticket ever submitted"
+        c.seek(TopicPartition("fvs", 0, 120))    # stale: tickets cover 0..
+        prov.gate.set()                          # resolve the parked entry
+        seq = []
+        deadline = time.monotonic() + 20
+        while len(seq) < 80 and time.monotonic() < deadline:
+            m = c.poll(0.3)
+            if m is not None and m.error is None:
+                seq.append(m.offset)
+        c.close()
+        assert seq, "stream lost after seek"
+        assert seq[0] == 120, f"stale pre-seek delivery leaked: {seq[:5]}"
+        assert seq == list(range(120, 120 + len(seq))), "gap/dup after seek"
+        assert len(seq) == 80
+    finally:
+        cluster.stop()
+
+
+def _consume_all(cluster, group, n, provider=None):
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": group, "auto.offset.reset": "earliest",
+                  "check.crcs": True})
+    if provider is not None:
+        c._rk.codec_provider = provider
+    c.subscribe(["fv"])
+    got, errs = [], []
+    deadline = time.monotonic() + 25
+    while len(got) < n and time.monotonic() < deadline:
+        m = c.poll(0.3)
+        if m is not None:
+            (errs if m.error is not None else got).append(m)
+    c.close()
+    assert not errs, [m.error for m in errs]
+    return sorted((m.partition, m.offset, m.key, m.value) for m in got)
+
+
+def _have_codec(codec):
+    try:
+        CpuCodecProvider().compress_many(codec, [b"probe" * 10])
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.parametrize("codec", ["lz4", "snappy", "gzip", "zstd"])
+def test_sync_vs_ticketed_delivery_bit_identical(cluster, codec):
+    """Acceptance: with check.crcs=on, the ticketed fetch pipeline's
+    wire-visible behavior (delivered records, offsets, partitions) is
+    bit-identical to the synchronous path for every codec."""
+    if not _have_codec(codec):
+        pytest.skip(f"{codec} support not available in this build")
+    _produce(cluster, 45, codec=codec)
+    sync = _consume_all(cluster, f"gsync-{codec}", 45, provider=None)
+    ticketed = _consume_all(cluster, f"gtick-{codec}", 45,
+                            provider=_GatedProvider(gate_open=True))
+    assert sync == ticketed
+    assert len(sync) == 45
 
 
 def test_check_crcs_disabled_skips_verify(cluster):

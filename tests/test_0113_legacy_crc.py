@@ -40,8 +40,14 @@ def test_provider_crc32_many_parity():
         time.sleep(0.1)
     assert prov._crc32_ready, "crc32 device kernel never became ready"
     tpu = prov.crc32_many(bufs)
+    # the async mirror resolves to the same values through the engine
+    ticket = prov.crc32_submit(bufs)
+    assert ticket is not None
+    got_async = ticket.result(120).tolist()
+    prov.close()
     assert first == cpu
-    assert cpu == tpu == [zlib.crc32(b) & 0xFFFFFFFF for b in bufs]
+    assert cpu == tpu == got_async == \
+        [zlib.crc32(b) & 0xFFFFFFFF for b in bufs]
 
 
 def _legacy_cluster(bver="0.10.0"):

@@ -29,9 +29,11 @@ def _fake_broker(budget_kb: int = 0) -> Broker:
     b.rk = SimpleNamespace(
         conf=SimpleNamespace(
             get=lambda k: {"queued.max.messages.kbytes": budget_kb}[k]),
+        fetch_pipeline_depth=2,
         log=lambda *a, **k: None)
     b.toppars = set()
     b._fetch_deferred = deque()
+    b._fetch_pending = deque()
     return b
 
 
@@ -55,21 +57,28 @@ def test_migrated_partition_released_despite_exhausted_budget():
 
 
 def test_owned_partition_processed_when_budget_allows():
+    from librdkafka_tpu.client.broker import _PendingFetch
+
     b = _fake_broker(budget_kb=1024)
     owned = _FakeTp("owned")
     migrated = _FakeTp("migrated")
     b.toppars = {owned}
-    processed = []
-    b._process_fetch_partition = lambda entry: processed.append(entry[0])
+    begun, finished = [], []
+    b._begin_fetch_partition = \
+        lambda entry: (begun.append(entry[0]), _PendingFetch(entry))[1]
+    b._finish_fetch_partition = \
+        lambda pend: finished.append(pend.entry[0])
     b._fetch_deferred.extend([
         (migrated, {}, None, 0, 0),
         (owned, {}, None, 0, 0),
     ])
     b._serve_deferred_fetch()
-    assert processed == [owned]
+    assert begun == [owned]
+    assert finished == [owned]
     assert owned.fetch_in_flight is False
     assert migrated.fetch_in_flight is False
     assert not b._fetch_deferred
+    assert not b._fetch_pending
 
 
 def test_close_leaves_stuck_broker_structures_alone():
