@@ -709,7 +709,9 @@ def fetch_pipeline_bench() -> dict:
         try:
             cj = _make_jobs(codec, 4, 4, size=16384)
         except Exception as e:
-            sweep[codec] = f"unavailable: {e.__class__.__name__}"
+            hint = (" — pip install '.[zstd]'" if codec == "zstd"
+                    else "")
+            sweep[codec] = f"unavailable: {e.__class__.__name__}{hint}"
             continue
         cw = _want(cj)
         fake2 = _FakeFetchProvider(0.0005)
@@ -721,7 +723,279 @@ def fetch_pipeline_bench() -> dict:
     return out
 
 
+def _cpu_crc_fb(bufs, poly):
+    from librdkafka_tpu.ops import cpu as _c
+    prov = _c.CpuCodecProvider()
+    return (prov.crc32c_many(bufs) if poly == "crc32c"
+            else prov.crc32_many(bufs))
+
+
+def governor_bench() -> dict:
+    """bench.py --governor (ISSUE 3 acceptance): the adaptive offload
+    governor measured leg by leg, every leg asserting bit-exactness vs
+    the native CPU provider.
+
+      cold_start — first-submission latency through the engine with
+        background warmup (the warmup gate serves from CPU instantly;
+        the compile happens off the hot path) vs without warmup (the
+        first launch stalls submit->result behind the inline XLA
+        compile).  Acceptance: warm first-launch <= 10% of the
+        no-warmup cold start on at least one bucket shape.  Also
+        reports the first DEVICE launch after the bucket warms.
+      fanin — adaptive vs static fan-in window at a low submission
+        rate (per-ticket latency: adaptive must shed the window tax)
+        and a high rate (burst wall-clock: adaptive must not be
+        slower).
+      fused — mixed crc32c + legacy-crc32 submissions merge into ONE
+        launch with per-row polynomial selection.
+
+    Env knobs: BENCH_GOV_BLOCKS (12, 64KB each), BENCH_GOV_FANIN_N
+    (24 tickets/leg).
+    """
+    import jax  # noqa: F401  (pay the import before any timed leg)
+
+    from librdkafka_tpu.ops import cpu as _c
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+    from librdkafka_tpu.utils.crc import crc32, crc32c
+
+    prov = _c.CpuCodecProvider()
+    rng = np.random.default_rng(0)
+    blk = 65536
+    nblk = int(os.environ.get("BENCH_GOV_BLOCKS", 12))
+    out = {}
+
+    # --- leg 1: cold start ----------------------------------------------
+    bufs = [rng.integers(0, 256, blk, dtype=np.uint8).tobytes()
+            for _ in range(nblk)]
+    want = prov.crc32c_many(bufs)
+    want32 = prov.crc32_many(bufs)
+
+    # no warmup: the first submission stalls behind the inline compile
+    # (crc32 poly so the warm leg's crc32c bucket stays cold for it)
+    cold_eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                                  warmup=False, cpu_fallback=None)
+    t0 = time.perf_counter()
+    got = cold_eng.submit(bufs, "crc32", window=False).result(600)
+    cold_s = time.perf_counter() - t0
+    assert got.tolist() == want32, "cold leg not bit-exact"
+    cold_eng.close()
+
+    # warmup: the same first-submission shape is served instantly from
+    # the CPU provider while the kernel compiles in the background
+    warm_eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=True,
+                                  warmup=True, cpu_fallback=_cpu_crc_fb)
+    t0 = time.perf_counter()
+    got = warm_eng.submit(bufs, "crc32c", window=False).result(600)
+    warm_first_s = time.perf_counter() - t0
+    assert got.tolist() == want, "warm leg not bit-exact"
+    # ... and once the bucket compiles, the device route opens
+    bucket = 64 if nblk <= 64 else (128 if nblk <= 128 else 256)
+    opened = warm_eng.warm_wait(bucket, "crc32c", 600)
+    dev_first_s = None
+    if opened:
+        launches = warm_eng.stats["launches"]
+        t0 = time.perf_counter()
+        got = warm_eng.submit(bufs, "crc32c", window=False).result(600)
+        dev_first_s = time.perf_counter() - t0
+        assert got.tolist() == want, "device leg not bit-exact"
+        assert warm_eng.stats["launches"] == launches + 1, \
+            "warmed bucket did not ride a device launch"
+    warm_stats = dict(warm_eng.stats)
+    warm_eng.close()
+    ratio = warm_first_s / max(cold_s, 1e-9)
+    out["cold_start"] = {
+        "blocks": nblk,
+        "no_warmup_first_launch_s": round(cold_s, 4),
+        "warmup_first_launch_s": round(warm_first_s, 4),
+        "warmup_over_cold_ratio": round(ratio, 4),
+        "within_10pct": ratio <= 0.10,
+        "first_device_launch_s": (round(dev_first_s, 4)
+                                  if dev_first_s is not None else None),
+        "engine_stats": warm_stats,
+    }
+
+    # --- leg 2: adaptive vs static fan-in ---------------------------------
+    n = int(os.environ.get("BENCH_GOV_FANIN_N", 24))
+    small = [rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+             for _ in range(2)]
+    want_small = prov.crc32c_many(small)
+
+    def _lat_leg(adaptive: bool, ia_s: float):
+        eng = AsyncOffloadEngine(depth=2, fanin_window_s=0.0005,
+                                 min_batches=8, governor=adaptive,
+                                 warmup=False, cpu_fallback=_cpu_crc_fb)
+        lats = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            t = eng.submit(small, "crc32c", window=True)
+            got = t.result(60)
+            lats.append(time.perf_counter() - t0)
+            assert got.tolist() == want_small, "fanin leg not bit-exact"
+            if ia_s:
+                time.sleep(ia_s)
+        st = dict(eng.stats)
+        eng.close()
+        lats = sorted(lats[4:])          # drop the model warm-in
+        return lats[len(lats) // 2], st
+
+    def _burst_leg(adaptive: bool):
+        eng = AsyncOffloadEngine(depth=2, fanin_window_s=0.0005,
+                                 min_batches=8, governor=adaptive,
+                                 warmup=False, cpu_fallback=_cpu_crc_fb)
+        t0 = time.perf_counter()
+        tickets = [eng.submit(small, "crc32c", window=True)
+                   for _ in range(n)]
+        for t in tickets:
+            assert t.result(60).tolist() == want_small, \
+                "burst leg not bit-exact"
+        wall = time.perf_counter() - t0
+        eng.close()
+        return wall
+
+    static_p50, static_st = _lat_leg(False, 0.004)
+    adapt_p50, adapt_st = _lat_leg(True, 0.004)
+    static_burst = _burst_leg(False)
+    adapt_burst = _burst_leg(True)
+    out["fanin"] = {
+        "tickets_per_leg": n,
+        "low_rate_4ms": {
+            "static_p50_us": round(static_p50 * 1e6, 1),
+            "adaptive_p50_us": round(adapt_p50 * 1e6, 1),
+            "latency_shed": round(static_p50 / max(adapt_p50, 1e-9), 2),
+            "adaptive_fanin_skips": adapt_st["fanin_skips"],
+            "static_fanin_waits": static_st["fanin_waits"],
+        },
+        "high_rate_burst": {
+            "static_wall_s": round(static_burst, 4),
+            "adaptive_wall_s": round(adapt_burst, 4),
+            "adaptive_not_slower":
+                adapt_burst <= static_burst * 1.25,
+        },
+    }
+
+    # --- leg 3: fused multi-poly launches ---------------------------------
+    eng = AsyncOffloadEngine(depth=2, fanin_window_s=0.05, min_batches=4,
+                             governor=True, warmup=False,
+                             cpu_fallback=_cpu_crc_fb)
+    m1 = [rng.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+          for _ in range(2)]
+    m2 = [rng.integers(0, 256, 8192, dtype=np.uint8).tobytes()
+          for _ in range(2)]
+    t1 = eng.submit(m1, "crc32c", window=True)
+    t2 = eng.submit(m2, "crc32", window=True)
+    assert t1.result(300).tolist() == [crc32c(b) for b in m1], \
+        "fused crc32c rows not bit-exact"
+    assert t2.result(300).tolist() == [crc32(b) for b in m2], \
+        "fused crc32 rows not bit-exact"
+    out["fused"] = {
+        "launches": eng.stats["launches"],
+        "fused_launches": eng.stats["fused_launches"],
+        "halved": eng.stats["fused_launches"] >= 1
+        and eng.stats["launches"] == 1,
+        "governor": eng.governor_snapshot(),
+    }
+    eng.close()
+    return out
+
+
+def smoke_bench() -> dict:
+    """bench.py --smoke (<60 s): one bit-exactness pass over every
+    engine leg — sync provider, pipelined engine, fetch pipeline,
+    governor (warmup-gate routing + fused multi-poly) — the pre-commit
+    gate next to scripts/tier1.sh."""
+    from librdkafka_tpu.ops import cpu as _c
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+    from librdkafka_tpu.ops.tpu import TpuCodecProvider
+    from librdkafka_tpu.utils.crc import crc32, crc32c
+
+    t_start = time.perf_counter()
+    prov = _c.CpuCodecProvider()
+    rng = np.random.default_rng(0)
+    bufs = [b"", b"123456789",
+            rng.integers(0, 256, 4096, dtype=np.uint8).tobytes(),
+            rng.integers(0, 256, 70000, dtype=np.uint8).tobytes()]
+    want_c = prov.crc32c_many(bufs)
+    want_l = prov.crc32_many(bufs)
+    legs = {}
+
+    # sync provider route
+    sp = TpuCodecProvider(min_batches=1, warmup=False,
+                          min_transport_mb_s=0, pipeline_depth=0)
+    assert sp.crc32c_many(bufs) == want_c, "sync leg not bit-exact"
+    legs["sync"] = "bit-identical"
+
+    # pipelined engine route (ticketed, both polynomials)
+    pp = TpuCodecProvider(min_batches=1, warmup=False,
+                          min_transport_mb_s=0, pipeline_depth=2,
+                          fanin_us=0)
+    assert pp.crc32c_submit(bufs).result(120).tolist() == want_c, \
+        "pipelined leg not bit-exact"
+    pp.close()
+    legs["pipelined"] = "bit-identical"
+
+    # consumer fetch pipeline (ticketed phases B+C, sync == pipelined)
+    jobs = []
+    for j in range(3):
+        batch = _payloads(4, 8192)
+        blobs = prov.compress_many("lz4", batch)
+        jobs.append((blobs, "lz4", blobs))
+    want_fetch = [([int(x) for x in prov.crc32c_many(r)],
+                   prov.decompress_many(c, b)) for r, c, b in jobs]
+    fake = _FakeFetchProvider(0.0005)
+    _, s_out = _drive_fetch_sync(fake, jobs)
+    _, p_out = _drive_fetch_pipelined(fake, jobs, 4)
+    assert [(list(c), d) for c, d in s_out] == want_fetch == p_out, \
+        "fetch pipeline leg not bit-exact"
+    legs["fetch_pipeline"] = "bit-identical"
+
+    # governor: warmup-gate routing (CPU-served pre-warm, device after)
+    eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=True,
+                             warmup=True, cpu_fallback=_cpu_crc_fb)
+    assert eng.submit(bufs, "crc32c",
+                      window=False).result(60).tolist() == want_c, \
+        "governor pre-warm leg not bit-exact"
+    opened = eng.warm_wait(64, "crc32c", 30)
+    if opened:
+        assert eng.submit(bufs, "crc32c",
+                          window=False).result(60).tolist() == want_c, \
+            "governor device leg not bit-exact"
+    legs["governor"] = ("bit-identical (device opened)" if opened
+                        else "bit-identical (CPU-routed; warmup still "
+                             "compiling)")
+    eng.close()
+
+    # fused multi-poly (inline compile — small shapes)
+    eng2 = AsyncOffloadEngine(depth=2, fanin_window_s=0.05, min_batches=4,
+                              governor=True, warmup=False,
+                              cpu_fallback=_cpu_crc_fb)
+    m = [rng.integers(0, 256, 2048, dtype=np.uint8).tobytes()
+         for _ in range(2)]
+    t1 = eng2.submit(m, "crc32c", window=True)
+    t2 = eng2.submit(m, "crc32", window=True)
+    assert t1.result(120).tolist() == [crc32c(b) for b in m]
+    assert t2.result(120).tolist() == [crc32(b) for b in m]
+    fused = eng2.stats["fused_launches"]
+    eng2.close()
+    legs["fused"] = f"bit-identical ({fused} fused launch)"
+
+    return {"elapsed_s": round(time.perf_counter() - t_start, 1),
+            "legs": legs}
+
+
 def main():
+    if "--governor" in sys.argv:
+        print(json.dumps({"metric": "adaptive offload governor: warmup "
+                                    "cold-start, adaptive fan-in, fused "
+                                    "multi-poly launches (bench.py "
+                                    "--governor)",
+                          **governor_bench()}))
+        return
+    if "--smoke" in sys.argv:
+        print(json.dumps({"metric": "pre-commit smoke: bit-exactness "
+                                    "over every engine leg (bench.py "
+                                    "--smoke)",
+                          **smoke_bench()}))
+        return
     if "--fetch-pipeline" in sys.argv:
         print(json.dumps({"metric": "pipelined vs synchronous consumer "
                                     "fetch codec phases (bench.py "

@@ -37,7 +37,10 @@ class Prop:
     vmax: Optional[float] = None
     enum: Optional[tuple] = None
     alias: Optional[str] = None          # alias target property name
-    validator: Optional[Callable[[Any], bool]] = None
+    # validator(coerced_value) -> error string, or None when valid;
+    # runs at set() time so a bad value fails HERE with a clear error,
+    # never at first use (ISSUE 3 satellite)
+    validator: Optional[Callable[[Any], Optional[str]]] = None
     deprecated: bool = False             # accepted no-op (reference
                                          # _RK_DEPRECATED rows)
     hidden: bool = False                 # excluded from generated docs
@@ -49,6 +52,24 @@ class Prop:
 
 def _p(*args, **kw) -> Prop:
     return Prop(*args, **kw)
+
+
+def _valid_cache_dir(v: Any) -> Optional[str]:
+    """tpu.compile.cache.dir: empty (disabled) or a usable directory —
+    one that exists, or whose parent exists so jax can create it."""
+    import os
+    s = str(v)
+    if not s:
+        return None
+    if os.path.isdir(s):
+        return None
+    if os.path.exists(s):
+        return f"{s!r} exists and is not a directory"
+    parent = os.path.dirname(os.path.abspath(s)) or "/"
+    if not os.path.isdir(parent):
+        return (f"parent directory {parent!r} does not exist "
+                "(the cache dir must be creatable)")
+    return None
 
 
 #: The declarative property table. Mirrors rdkafka_conf.c:224's table shape.
@@ -326,8 +347,40 @@ PROPERTIES: list[Prop] = [
        "batches to merge into one launch (cross-broker micro-batch "
        "aggregation), so tpu.launch.min.batches is met at high toppar "
        "counts instead of falling back to the CPU provider. 0 "
-       "dispatches immediately. No effect with compression.backend=cpu.",
+       "dispatches immediately. With tpu.governor=true this is the CAP "
+       "of the adaptive window (sized from the observed submission "
+       "inter-arrival EWMA — low-rate traffic skips the wait "
+       "entirely). No effect with compression.backend=cpu.",
        vmin=0, vmax=100_000),
+    _p("tpu.governor", GLOBAL, "bool", True,
+       "Adaptive offload governor (ops/engine.py): online cost-model "
+       "CPU/TPU routing of at-quorum CRC launch groups (EWMA of "
+       "per-bucket device launch time vs observed CPU-provider "
+       "ns/byte, with periodic exploration launches so the model "
+       "tracks host drift), adaptive fan-in window sizing, and fused "
+       "multi-polynomial launches (crc32c + legacy crc32 in one padded "
+       "launch with per-row Q selection). false restores the static "
+       "policy: always-device above tpu.launch.min.batches, fixed "
+       "fan-in window, per-polynomial launches. tpu.launch.min.batches "
+       "remains a hard floor either way; wire bytes are bit-identical "
+       "on every route. No effect with compression.backend=cpu."),
+    _p("tpu.warmup", GLOBAL, "bool", True,
+       "Background kernel warmup: a low-priority engine thread "
+       "pre-compiles every (batch-bucket, 64KB) CRC kernel shape for "
+       "both polynomials plus the fused variant at engine start; until "
+       "a bucket's kernel is ready its launches are served by the CPU "
+       "provider (bit-identical), so an XLA compile never stalls a "
+       "hot-path launch — and the legacy-crc32 device path opens "
+       "end-to-end. false: the dispatch thread compiles inline on "
+       "first use (pre-governor behavior). No effect with "
+       "compression.backend=cpu."),
+    _p("tpu.compile.cache.dir", GLOBAL, "str", "",
+       "Persistent JAX compilation-cache directory for the offload "
+       "kernels: warmed kernels compile once per machine instead of "
+       "once per process (jax_compilation_cache_dir). Empty disables. "
+       "The path must be an existing directory or creatable (existing "
+       "parent) — validated at set() time.",
+       validator=_valid_cache_dir),
     _p("tpu.fetch.pipeline.depth", GLOBAL, "int", 4,
        "Consumer fetch codec pipeline: max fetch partitions per broker "
        "whose CRC-verify/decompress offload tickets may be in flight "
@@ -474,6 +527,9 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "tpu.pipeline.depth"),
     (GLOBAL, "tpu.pipeline.fanin.us"),
     (GLOBAL, "tpu.fetch.pipeline.depth"),
+    (GLOBAL, "tpu.governor"),
+    (GLOBAL, "tpu.warmup"),
+    (GLOBAL, "tpu.compile.cache.dir"),
     (GLOBAL, "codec.pipeline.depth"),
     (GLOBAL, "allow.auto.create.topics"),       # KIP-361 (post-1.3.0)
     (GLOBAL, "consume.callback.max.messages"),  # global mirror of the
@@ -518,7 +574,14 @@ class _ConfBase:
                                  f"{name!r}: {prop.doc}")
         if prop.alias:
             return self.set(prop.alias, value)
-        self._values[prop.name] = self._coerce(prop, value)
+        val = self._coerce(prop, value)
+        if prop.validator is not None:
+            err = prop.validator(val)
+            if err is not None:
+                raise KafkaException(
+                    Err._INVALID_ARG,
+                    f"Configuration property {prop.name!r}: {err}")
+        self._values[prop.name] = val
         self._explicit.add(prop.name)
         # mutation counter + listeners: cached eligibility decisions
         # (e.g. the produce fast lane keyed on dr callbacks) revalidate

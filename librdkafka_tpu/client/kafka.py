@@ -236,7 +236,10 @@ class Kafka:
                 lz4_force=conf.get("tpu.lz4.force"),
                 min_transport_mb_s=conf.get("tpu.transport.min.mb.s"),
                 pipeline_depth=conf.get("tpu.pipeline.depth"),
-                fanin_us=conf.get("tpu.pipeline.fanin.us"))
+                fanin_us=conf.get("tpu.pipeline.fanin.us"),
+                governor=conf.get("tpu.governor"),
+                engine_warmup=conf.get("tpu.warmup"),
+                compile_cache_dir=conf.get("tpu.compile.cache.dir"))
         else:
             from ..ops.cpu import CpuCodecProvider
             self.codec_provider = CpuCodecProvider()

@@ -135,6 +135,13 @@ class StatsCollector:
             "brokers": brokers,
             "topics": topics,
         }
+        # adaptive offload governor decisions (ISSUE 3): launch /
+        # merge / fallback / warmup counters plus the cost-model gauges
+        # from the async engine, when the tpu backend has spun one up
+        eng = getattr(rk.codec_provider, "_engine", None)
+        if eng is not None:
+            blob["codec_engine"] = {**eng.stats,
+                                    "governor": eng.governor_snapshot()}
         if rk.cgrp is not None:
             blob["cgrp"] = {"state": rk.cgrp.join_state,
                             "rebalance_cnt": rk.cgrp.rebalance_cnt,

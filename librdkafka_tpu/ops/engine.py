@@ -32,6 +32,36 @@ affine term and the host-side combine are exactly ``_crc_many_mxu``
 caller-supplied CPU fallback — bit-identical either way.  jax is
 imported lazily on the dispatch thread so CPU-only installs importing
 this module never pay for it.
+
+The ADAPTIVE OFFLOAD GOVERNOR (ISSUE 3) replaces the engine's static
+policy layer:
+
+  * **Background warmup** (``warmup=True``): a low-priority thread
+    pre-compiles every (B, 64KB) pow2 bucket shape for BOTH
+    polynomials plus the fused variant (crc32c_jax.warm_kernel AOT
+    compiles, optionally backed by a persistent jax compilation cache,
+    ``compile_cache_dir``).  Until a bucket's kernel is ready the
+    dispatch thread routes that bucket to the CPU provider — a compile
+    stall never blocks a hot-path launch — and requests the missed
+    bucket so the warmup thread compiles genuinely-hot shapes first.
+  * **Cost-model routing** (``governor=True``): at-quorum groups go to
+    whichever side an online model predicts faster — EWMA of per-bucket
+    device launch time (measured at readback) vs observed CPU-provider
+    ns/byte — with a periodic exploration launch to the unpicked side
+    so the model tracks host/tunnel drift.  ``min_batches`` stays a
+    hard floor: below it jobs are CPU-served exactly as before.
+  * **Adaptive fan-in**: the below-quorum fan-in wait is sized from
+    the submission inter-arrival EWMA; ``fanin_window_s`` becomes the
+    CAP.  Low-rate traffic stops paying the latency tax (window 0 when
+    the next submission won't arrive within the cap), high-rate
+    traffic keeps merging into full-tile launches.
+  * **Fused multi-poly launches**: crc32c + legacy-crc32 jobs popped
+    together merge into ONE padded launch with per-row Q-matrix/term
+    selection (crc32c_jax._jit_mxu_fused), halving launch count on
+    mixed v2/legacy fetch responses.
+
+Every route is bit-identical by construction; the governor only moves
+WHERE a checksum is computed, never WHAT it is.
 """
 from __future__ import annotations
 
@@ -142,8 +172,8 @@ class _Staging:
 class _Launch:
     """One in-flight device launch awaiting readback."""
 
-    __slots__ = ("kind", "jobs", "spans", "outs", "chunk_lens", "combine",
-                 "ticket", "out_tree")
+    __slots__ = ("kind", "jobs", "spans", "outs", "chunk_lens",
+                 "ticket", "out_tree", "t0", "bucket")
 
     def __init__(self, kind):
         self.kind = kind
@@ -151,9 +181,106 @@ class _Launch:
         self.spans: list[tuple[int, int]] = []   # (first_block, nblocks)/buf
         self.outs: list = []                     # device arrays per chunk
         self.chunk_lens: list[int] = []          # live rows per chunk
-        self.combine = None
         self.ticket: Optional[Ticket] = None     # compute kind only
         self.out_tree = None
+        self.t0: Optional[float] = None          # launch wall-clock start
+        self.bucket: Optional[int] = None        # padded B of first chunk
+
+
+class _Governor:
+    """Online policy state for the adaptive offload governor (ISSUE 3).
+
+    Three tiny models, all O(1) EWMAs:
+
+      * ``interarrival_s`` — CRC submission inter-arrival time, updated
+        by submitter threads under the engine lock; sizes the fan-in
+        window.
+      * ``dev_launch_s[bucket]`` — per-bucket device launch latency
+        (dispatch → readback complete), updated on the dispatch thread.
+      * ``cpu_ns_per_byte`` — the CPU provider's observed checksum
+        rate, updated whenever the engine serves a group on CPU.
+
+    ``route`` compares the two cost predictions for an at-quorum group
+    and periodically explores the unpicked side so a stale estimate
+    cannot pin the router forever (host load and tunnel bandwidth both
+    drift)."""
+
+    EWMA_ALPHA = 0.25
+    EXPLORE_EVERY = 16
+
+    __slots__ = ("enabled", "fanin_cap_s", "interarrival_s",
+                 "_last_submit", "cpu_ns_per_byte", "dev_launch_s",
+                 "_since_explore")
+
+    def __init__(self, enabled: bool, fanin_cap_s: float):
+        self.enabled = bool(enabled)
+        self.fanin_cap_s = float(fanin_cap_s)
+        self.interarrival_s: Optional[float] = None
+        self._last_submit: Optional[float] = None
+        self.cpu_ns_per_byte: Optional[float] = None
+        self.dev_launch_s: dict[int, float] = {}
+        self._since_explore = 0
+
+    def _ewma(self, old: Optional[float], v: float) -> float:
+        return v if old is None else old + self.EWMA_ALPHA * (v - old)
+
+    # ---- submitter side (engine lock held) ----
+    def note_submit(self, now: float) -> None:
+        last, self._last_submit = self._last_submit, now
+        if last is not None:
+            self.interarrival_s = self._ewma(self.interarrival_s,
+                                             now - last)
+
+    # ---- dispatch-thread side ----
+    def fanin_window(self, need: int) -> float:
+        """Seconds a below-quorum group should wait for ``need`` more
+        buffers.  Static cap until the arrival model has data; zero
+        when the mean inter-arrival already exceeds the cap (nothing
+        will merge — dispatch now, don't tax latency)."""
+        cap = self.fanin_cap_s
+        if not self.enabled or self.interarrival_s is None:
+            return cap
+        ia = self.interarrival_s
+        if ia >= cap:
+            return 0.0
+        return min(cap, 2.0 * max(1, need) * ia)
+
+    def note_device(self, bucket: Optional[int], dt: float) -> None:
+        if bucket is not None:
+            self.dev_launch_s[bucket] = self._ewma(
+                self.dev_launch_s.get(bucket), dt)
+
+    def note_cpu(self, nbytes: int, dt: float) -> None:
+        if nbytes > 0:
+            self.cpu_ns_per_byte = self._ewma(self.cpu_ns_per_byte,
+                                              dt * 1e9 / nbytes)
+
+    def route(self, bucket: int, nbytes: int) -> tuple[str, bool]:
+        """('device'|'cpu', explored) for an at-quorum group.  Unknown
+        estimates prefer the device — exactly the static policy — so
+        configs without governor history behave identically."""
+        dev = self.dev_launch_s.get(bucket)
+        cpu = self.cpu_ns_per_byte
+        if dev is None or cpu is None:
+            return "device", False
+        pick = "device" if dev <= nbytes * cpu / 1e9 else "cpu"
+        self._since_explore += 1
+        if self._since_explore >= self.EXPLORE_EVERY:
+            self._since_explore = 0
+            return ("cpu" if pick == "device" else "device"), True
+        return pick, False
+
+    def snapshot(self) -> dict:
+        """JSON-ready gauges for the statistics blob."""
+        return {
+            "enabled": self.enabled,
+            "interarrival_us": (None if self.interarrival_s is None
+                                else round(self.interarrival_s * 1e6, 1)),
+            "cpu_ns_per_byte": (None if self.cpu_ns_per_byte is None
+                                else round(self.cpu_ns_per_byte, 3)),
+            "dev_launch_ms": {str(b): round(s * 1e3, 3)
+                              for b, s in sorted(self.dev_launch_s.items())},
+        }
 
 
 class AsyncOffloadEngine:
@@ -161,28 +288,58 @@ class AsyncOffloadEngine:
     kernels (and, generically, any jitted step fn via
     :meth:`submit_compute`)."""
 
+    #: every bucket shape a launch can produce: next_pow2 has a 64-row
+    #: floor (packing.py) and 64-block chunks pad to the 128-row MXU
+    #: tile, so B is always one of exactly these three
+    WARM_BUCKETS = (64, 128, 256)
+    WARM_KINDS = ("crc32c", "crc32", "fused")
+
     def __init__(self, *, depth: int = 2, fanin_window_s: float = 0.0005,
                  min_batches: int = 4,
                  cpu_fallback: Optional[Callable] = None,
-                 name: str = "tpu-engine"):
+                 name: str = "tpu-engine",
+                 governor: bool = True, warmup: bool = False,
+                 compile_cache_dir: Optional[str] = None):
         # depth: launches kept in flight before the oldest is read back
         self.depth = max(1, int(depth))
         self.fanin_window_s = max(0.0, float(fanin_window_s))
         self.min_batches = max(1, int(min_batches))
         # cpu_fallback(bufs, poly) -> list[int]; serves below-quorum jobs
         self.cpu_fallback = cpu_fallback
+        # the adaptive policy layer; fanin_window_s is its CAP
+        self.governor = _Governor(governor, self.fanin_window_s)
+        # warmup=True: kernels compile on the background thread and
+        # unwarmed buckets route to the CPU provider; warmup=False
+        # keeps the old behavior (dispatch thread compiles inline)
+        self.warmup_enabled = bool(warmup) and cpu_fallback is not None
+        self.compile_cache_dir = compile_cache_dir or None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: deque[_Job] = deque()
         self._closed = False
         self._staging = _Staging(copies=self.depth + 1)
-        # observability (PERF.md pipeline section)
+        # (B, kind) buckets the dispatch thread missed on — the warmup
+        # thread compiles these before continuing its sweep
+        self._warm_requests: deque[tuple[int, str]] = deque()
+        # observability (PERF.md pipeline section + governor counters)
         self.stats = {"launches": 0, "blocks": 0, "jobs": 0,
                       "aggregated": 0, "cpu_fallback_jobs": 0,
-                      "fanin_waits": 0, "host_jobs": 0}
+                      "fanin_waits": 0, "host_jobs": 0,
+                      # governor decisions (ISSUE 3)
+                      "fanin_skips": 0, "warmup_miss_jobs": 0,
+                      "warmup_compiled": 0, "routed_cpu_jobs": 0,
+                      "explore_routes": 0, "fused_launches": 0}
         self._thread = threading.Thread(target=self._main, daemon=True,
                                         name=name)
         self._thread.start()
+        self._warmup_thread = None
+        if self.warmup_enabled:
+            # name contains "engine" so the conftest thread-leak
+            # fixture covers it like the dispatch thread
+            self._warmup_thread = threading.Thread(
+                target=self._warmup_main, daemon=True,
+                name=name + "-warmup")
+            self._warmup_thread.start()
 
     # ------------------------------------------------------------ public --
     def submit(self, bufs: list, poly: str = "crc32c",
@@ -196,6 +353,7 @@ class AsyncOffloadEngine:
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine closed")
+            self.governor.note_submit(time.monotonic())
             self._queue.append(job)
             self._cond.notify()
         return t
@@ -232,6 +390,11 @@ class AsyncOffloadEngine:
             self._closed = True
             self._cond.notify()
         self._thread.join(timeout)
+        if self._warmup_thread is not None:
+            # the warmup thread checks _closed between kernels; an XLA
+            # compile in progress finishes (it cannot be cancelled) and
+            # the thread exits — deterministic drain, no leak
+            self._warmup_thread.join(timeout)
         if self._thread.is_alive():
             # join timed out: the dispatch thread is wedged (e.g. a hung
             # device launch).  Fail every job still visible so waiters
@@ -243,6 +406,85 @@ class AsyncOffloadEngine:
                                "did not exit in time)")
             for j in stranded:
                 j.ticket._fail(exc)
+
+    def warm_wait(self, B: int, poly: str = "crc32c",
+                  timeout: float = 120.0) -> bool:
+        """Block until the (B, 64KB, poly) kernel bucket is compiled
+        (test/bench hook); returns False on timeout."""
+        from .crc32c_jax import _MXU_BLOCK, kernel_ready
+        deadline = time.monotonic() + timeout
+        while not kernel_ready(B, _MXU_BLOCK, poly):
+            if time.monotonic() >= deadline or self._closed:
+                return kernel_ready(B, _MXU_BLOCK, poly)
+            time.sleep(0.02)
+        return True
+
+    def governor_snapshot(self) -> dict:
+        """Governor gauges for the statistics JSON (client/stats.py).
+        Never imports jax — safe to call from the stats emitter even
+        before the first launch."""
+        snap = self.governor.snapshot()
+        snap["warmup"] = self.warmup_enabled
+        return snap
+
+    # ----------------------------------------------------- warmup thread --
+    def _request_warm(self, B: int, kind: str) -> None:
+        """Dispatch-thread side: a launch missed this bucket — move it
+        to the front of the warmup queue."""
+        with self._lock:
+            if (B, kind) not in self._warm_requests:
+                self._warm_requests.append((B, kind))
+
+    def _warmup_main(self):
+        """Low-priority sweep compiling every (B, 64KB) bucket for both
+        polynomials + the fused variant, smallest first (short compiles
+        open routes early and keep close() joins snappy); buckets the
+        dispatch thread actually missed on jump the queue.  Exits when
+        the sweep is complete or the engine closes."""
+        try:
+            if self.compile_cache_dir:
+                # persistent compile cache: kernels compile once per
+                # machine instead of once per process
+                try:
+                    import jax
+                    jax.config.update("jax_compilation_cache_dir",
+                                      self.compile_cache_dir)
+                    for knob, v in (
+                            ("jax_persistent_cache_min_compile_time_secs",
+                             0),
+                            ("jax_persistent_cache_min_entry_size_bytes",
+                             0)):
+                        try:
+                            jax.config.update(knob, v)
+                        except Exception:
+                            pass
+                except Exception:
+                    pass
+            from .crc32c_jax import _MXU_BLOCK, kernel_ready, warm_kernel
+            sweep = [(B, kind) for B in self.WARM_BUCKETS
+                     for kind in self.WARM_KINDS]
+            i = 0
+            while not self._closed:
+                with self._lock:
+                    item = (self._warm_requests.popleft()
+                            if self._warm_requests else None)
+                if item is None:
+                    if i >= len(sweep):
+                        return
+                    item = sweep[i]
+                    i += 1
+                B, kind = item
+                if kernel_ready(B, _MXU_BLOCK, kind):
+                    continue
+                try:
+                    warm_kernel(B, _MXU_BLOCK, kind)
+                    self.stats["warmup_compiled"] += 1
+                except Exception:
+                    # a failing compile must never kill warmup; the
+                    # bucket simply stays CPU-routed
+                    pass
+        except Exception:
+            pass
 
     # ---------------------------------------------------- dispatch thread --
     def _main(self):
@@ -300,16 +542,25 @@ class AsyncOffloadEngine:
 
     def _fanin(self, jobs: list[_Job]) -> list[_Job]:
         """Bounded fan-in: when the windowed CRC jobs are below the
-        launch quorum, wait up to the window for more submitters (the
-        cross-broker micro-batch aggregation) before dispatching."""
+        launch quorum, wait for more submitters (the cross-broker
+        micro-batch aggregation) before dispatching.  The wait is sized
+        by the governor from the submission inter-arrival EWMA —
+        ``fanin_window_s`` is the cap; a zero adaptive window (mean
+        inter-arrival beyond the cap: nothing will merge) dispatches
+        immediately, so low-rate traffic stops paying the latency
+        tax."""
         if self.fanin_window_s <= 0:
             return jobs
         nbufs = sum(len(j.bufs) for j in jobs
                     if j.kind == "crc" and j.window)
         if nbufs == 0 or nbufs >= self.min_batches:
             return jobs
+        window = self.governor.fanin_window(self.min_batches - nbufs)
+        if window <= 0:
+            self.stats["fanin_skips"] += 1
+            return jobs
         self.stats["fanin_waits"] += 1
-        deadline = time.monotonic() + self.fanin_window_s
+        deadline = time.monotonic() + window
         with self._cond:
             while nbufs < self.min_batches:
                 left = deadline - time.monotonic()
@@ -324,7 +575,10 @@ class AsyncOffloadEngine:
 
     def _group(self, jobs: list[_Job]):
         """Launch groups: CRC jobs merge per polynomial (shared kernel
-        shape); compute/host jobs launch individually."""
+        shape) — or across BOTH polynomials into one fused launch when
+        the governor is on (per-row Q selection, _jit_mxu_fused), so a
+        mixed v2/legacy fetch response pays one launch instead of two.
+        Compute/host jobs launch individually."""
         by_poly: dict[str, list[_Job]] = {}
         order = []
         for j in jobs:
@@ -335,6 +589,21 @@ class AsyncOffloadEngine:
                     by_poly[j.poly] = []
                     order.append(by_poly[j.poly])
                 by_poly[j.poly].append(j)
+        if self.governor.enabled and len(by_poly) > 1:
+            # fuse: one merged group replaces the per-poly groups, at
+            # the position of the first CRC group (submission order of
+            # non-CRC jobs preserved)
+            merged = [j for j in jobs if j.kind == "crc"]
+            fused_order = []
+            placed = False
+            for g in order:
+                if g and g[0].kind == "crc":
+                    if not placed:
+                        fused_order.append(merged)
+                        placed = True
+                else:
+                    fused_order.append(g)
+            return fused_order
         return order
 
     # -------------------------------------------------------------- launch --
@@ -362,12 +631,41 @@ class AsyncOffloadEngine:
         rec.out_tree = job.fn(*job.args)     # async dispatch
         return rec
 
+    def _serve_cpu(self, group: list[_Job], counter: str) -> None:
+        """Serve a group on the CPU provider (bit-identical), timing it
+        into the governor's CPU cost estimate."""
+        self.stats[counter] += len(group)
+        t0 = time.perf_counter()
+        nbytes = 0
+        for j in group:
+            try:
+                vals = self.cpu_fallback(j.bufs, j.poly)
+                j.ticket._complete(np.asarray(vals, dtype=np.uint32))
+                nbytes += sum(len(b) for b in j.bufs)
+            except Exception as e:
+                j.ticket._fail(e)
+        self.governor.note_cpu(nbytes, time.perf_counter() - t0)
+
+    @staticmethod
+    def _bucket_shapes(nblocks: int) -> list[int]:
+        """The padded row-counts (B) the launch loop will use for
+        ``nblocks`` blocks — the kernel shapes the warmup gate checks."""
+        from .crc32c_jax import _MXU_MAX_B
+        from .packing import next_pow2
+        shapes = []
+        for start in range(0, nblocks, _MXU_MAX_B):
+            n = min(_MXU_MAX_B, nblocks - start)
+            B = next_pow2(n)
+            if n >= 64:
+                B = max(B, 128)     # MXU tile floor (crc32c_jax.py)
+            shapes.append(B)
+        return shapes
+
     def _launch_crc(self, group: list[_Job]) -> Optional[_Launch]:
-        from ..utils.crc import crc32_combine, crc32c_combine
-        from .crc32c_jax import _MXU_BLOCK, _MXU_MAX_B, _term_host
+        from .crc32c_jax import (_MXU_BLOCK, _MXU_MAX_B, _term_host,
+                                 kernel_ready, ready_kernel)
         from .packing import next_pow2
 
-        poly = group[0].poly
         self.stats["jobs"] += len(group)
         if len(group) > 1:
             self.stats["aggregated"] += len(group)
@@ -375,6 +673,7 @@ class AsyncOffloadEngine:
         blk = _MXU_BLOCK
         blocks: list[bytes] = []
         spans: list[tuple[int, int]] = []
+        row_poly: list[str] = []         # polynomial of each block row
         for j in group:
             for b in j.bufs:
                 first = len(blocks)
@@ -383,34 +682,56 @@ class AsyncOffloadEngine:
                     continue
                 for pos in range(0, len(b), blk):
                     blocks.append(b[pos:pos + blk])
+                    row_poly.append(j.poly)
                 spans.append((first, len(blocks) - first))
 
         if len(blocks) < self.min_batches and self.cpu_fallback is not None:
-            # below the launch quorum even after fan-in: the CPU
-            # provider serves these (bit-identical), still off the
-            # submitter's thread
-            self.stats["cpu_fallback_jobs"] += len(group)
-            for j in group:
-                try:
-                    vals = self.cpu_fallback(j.bufs, poly)
-                    j.ticket._complete(np.asarray(vals, dtype=np.uint32))
-                except Exception as e:
-                    j.ticket._fail(e)
+            # below the launch quorum even after fan-in (the governor's
+            # hard floor): the CPU provider serves these
+            # (bit-identical), still off the submitter's thread
+            self._serve_cpu(group, "cpu_fallback_jobs")
             return None
 
-        import jax
+        polys = set(row_poly) or {group[0].poly}
+        mixed = len(polys) > 1
+        shapes = self._bucket_shapes(len(blocks))
+        kinds = ("fused",) if mixed else tuple(polys)
+        if self.warmup_enabled:
+            # warmup gate: an unwarmed bucket must not stall this
+            # thread behind an XLA compile — CPU serves it and the
+            # missed shape jumps the warmup queue
+            missing = [(B, k) for B in set(shapes) for k in kinds
+                       if not kernel_ready(B, blk, k)]
+            if missing:
+                for B, k in missing:
+                    self._request_warm(B, k)
+                self._serve_cpu(group, "warmup_miss_jobs")
+                return None
+        if self.governor.enabled and self.cpu_fallback is not None:
+            nbytes = sum(len(b) for j in group for b in j.bufs)
+            route, explored = self.governor.route(shapes[0], nbytes)
+            if explored:
+                self.stats["explore_routes"] += 1
+            if route == "cpu":
+                self._serve_cpu(group, "routed_cpu_jobs")
+                return None
 
-        from .crc32c_jax import _jit_mxu
+        import jax
 
         rec = _Launch("crc")
         rec.jobs = group
         rec.spans = spans
-        rec.combine = crc32c_combine if poly == "crc32c" else crc32_combine
+        rec.bucket = shapes[0] if shapes else None
+        rec.t0 = time.perf_counter()
         self.stats["launches"] += 1
+        if mixed:
+            self.stats["fused_launches"] += 1
         self.stats["blocks"] += len(blocks)
+        full_terms = {p: _term_host(blk, p) for p in polys}
 
         for start in range(0, len(blocks), _MXU_MAX_B):
             chunk = blocks[start:start + _MXU_MAX_B]
+            cpoly = row_poly[start:start + _MXU_MAX_B]
             B = next_pow2(len(chunk))
             if len(chunk) >= 64:
                 B = max(B, 128)     # MXU tile floor (crc32c_jax.py)
@@ -419,17 +740,33 @@ class AsyncOffloadEngine:
             # a CRC no-op under a zero register)
             data = self._staging.take(B, blk)
             terms = np.zeros((B,), dtype=np.uint32)
-            full_term = _term_host(blk, poly)
             for i, b in enumerate(chunk):
                 n = len(b)
                 data[i, blk - n:] = np.frombuffer(b, dtype=np.uint8)
-                terms[i] = (full_term if n == blk
-                            else _term_host(n, poly))
+                terms[i] = (full_terms[cpoly[i]] if n == blk
+                            else _term_host(n, cpoly[i]))
             # async dispatch: device_put + kernel launch return
-            # immediately; the readback (np.asarray) is the only sync
+            # immediately; the readback (np.asarray) is the only sync.
+            # A warmed bucket rides its AOT-compiled executable.
             d = jax.device_put(data)
             t = jax.device_put(terms)
-            rec.outs.append(_jit_mxu(B, blk, poly)(d, t))
+            if mixed:
+                sel = np.zeros((B,), dtype=np.uint32)
+                for i, p in enumerate(cpoly):
+                    if p == "crc32":
+                        sel[i] = 1
+                fn = ready_kernel(B, blk, "fused")
+                if fn is None:
+                    from .crc32c_jax import _jit_mxu_fused
+                    fn = _jit_mxu_fused(B, blk)
+                rec.outs.append(fn(d, t, jax.device_put(sel)))
+            else:
+                poly = next(iter(polys))
+                fn = ready_kernel(B, blk, poly)
+                if fn is None:
+                    from .crc32c_jax import _jit_mxu
+                    fn = _jit_mxu(B, blk, poly)
+                rec.outs.append(fn(d, t))
             rec.chunk_lens.append(len(chunk))
         return rec
 
@@ -450,6 +787,7 @@ class AsyncOffloadEngine:
                     j.ticket._fail(e)
 
     def _readback_crc(self, rec: _Launch) -> None:
+        from ..utils.crc import crc32_combine, crc32c_combine
         from .crc32c_jax import _MXU_BLOCK
         blk = _MXU_BLOCK
         # ONE bulk host sync per chunk + vectorized uint32 view — no
@@ -457,10 +795,17 @@ class AsyncOffloadEngine:
         parts = [np.asarray(o).astype(np.uint32)[:n]
                  for o, n in zip(rec.outs, rec.chunk_lens)]
         crcs = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        # launch latency feeds the governor's per-bucket device model
+        if rec.t0 is not None:
+            self.governor.note_device(rec.bucket,
+                                      time.perf_counter() - rec.t0)
         # host-side combine of multi-block buffers (µs each), then slice
-        # results back out per job in submission order
+        # results back out per job in submission order; a fused launch
+        # combines each job with ITS polynomial's zero-shift matrices
         it = iter(rec.spans)
         for j in rec.jobs:
+            combine = (crc32c_combine if j.poly == "crc32c"
+                       else crc32_combine)
             out = np.zeros((len(j.bufs),), dtype=np.uint32)
             for i, b in enumerate(j.bufs):
                 first, nb = next(it)
@@ -469,8 +814,8 @@ class AsyncOffloadEngine:
                 acc = int(crcs[first])
                 off = blk
                 for k in range(1, nb):
-                    acc = rec.combine(acc, int(crcs[first + k]),
-                                      min(blk, len(b) - off))
+                    acc = combine(acc, int(crcs[first + k]),
+                                  min(blk, len(b) - off))
                     off += blk
                 out[i] = acc
             j.ticket._complete(out)

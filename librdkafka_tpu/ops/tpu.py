@@ -48,7 +48,10 @@ class TpuCodecProvider:
     def __init__(self, min_batches: int = 4, warmup: bool = True,
                  mesh_devices: int = 0, lz4_force: bool = False,
                  min_transport_mb_s: float = 100.0,
-                 pipeline_depth: int = 2, fanin_us: int = 500):
+                 pipeline_depth: int = 2, fanin_us: int = 500,
+                 governor: bool = True,
+                 engine_warmup: bool | None = None,
+                 compile_cache_dir: str = ""):
         # below this many independent buffers a launch isn't worth it;
         # fall back to the CPU provider (identical bytes either way).
         self.min_batches = max(1, int(min_batches))
@@ -73,6 +76,14 @@ class TpuCodecProvider:
         # disables it — every call dispatches synchronously like r5.
         self.pipeline_depth = int(pipeline_depth)
         self.fanin_us = int(fanin_us)
+        # tpu.governor / tpu.warmup / tpu.compile.cache.dir: the
+        # adaptive offload governor (ops/engine.py, ISSUE 3).
+        # engine_warmup=None inherits this provider's warmup flag so
+        # warmup=False test providers stay compile-free.
+        self.governor = bool(governor)
+        self.engine_warmup = (bool(warmup) if engine_warmup is None
+                              else bool(engine_warmup))
+        self.compile_cache_dir = compile_cache_dir or None
         self._engine = None
         self._engine_closed = False
         self._engine_lock = None    # created lazily with the engine
@@ -310,7 +321,10 @@ class TpuCodecProvider:
                         fanin_window_s=self.fanin_us / 1e6,
                         min_batches=self.min_batches,
                         cpu_fallback=self._cpu_crc_fallback,
-                        name="tpu-codec-engine")
+                        name="tpu-codec-engine",
+                        governor=self.governor,
+                        warmup=self.engine_warmup,
+                        compile_cache_dir=self.compile_cache_dir)
         return self._engine
 
     def _cpu_crc_fallback(self, bufs: list[bytes], poly: str) -> list[int]:
@@ -336,13 +350,17 @@ class TpuCodecProvider:
     def crc32_submit(self, bufs: list[bytes]):
         """Async pipelined legacy (zlib-poly) CRC — the crc32 mirror of
         :meth:`crc32c_submit`, feeding the consumer's MsgVer0/1 fetch
-        verify.  Returns None (caller computes synchronously on the CPU
-        path) until the background-compiled crc32 kernel is ready, so
-        the first legacy fetches never stall the broker thread behind
-        an XLA compile (see crc32_many)."""
+        verify.  With the engine warmup on (ISSUE 3) the device path is
+        open END TO END: submissions always ride ``_jit_mxu(poly=
+        "crc32")`` through the engine, whose warmup gate serves from
+        the CPU provider until the bucket's kernel is compiled — the
+        first legacy fetches never stall behind an XLA compile and
+        stop falling back to unconditional CPU service.  Without the
+        engine warmup the pre-governor background-compile gate applies
+        (see crc32_many)."""
         if not self._offload_pays():
             return None
-        if not self._crc32_ready:
+        if not self.engine_warmup and not self._crc32_ready:
             self._warm_crc32()
             return None
         eng = self._get_engine()

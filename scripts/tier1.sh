@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the ROADMAP.md command, verbatim.  Run from the repo
+# root (pytest.ini_options pins testpaths=tests).  Pair with the quick
+# pre-commit gate: `python bench.py --smoke` (<60 s, one bit-exactness
+# pass over every engine leg).
+cd "$(dirname "$0")/.."
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

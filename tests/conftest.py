@@ -29,10 +29,22 @@ import time  # noqa: E402
 import pytest  # noqa: E402
 
 
+def require_zstd():
+    """Skip the calling test, actionably, when the optional zstandard
+    module is absent (codec sweeps run their zstd legs wherever it is
+    installed — `pip install '.[zstd]'`)."""
+    try:
+        import zstandard  # noqa: F401
+    except ImportError:
+        pytest.skip("zstd support not available: pip install '.[zstd]'")
+
+
 @pytest.fixture(autouse=True)
 def _no_leaked_engine_threads():
-    """Every test must leave zero live offload-engine dispatch threads
-    (AsyncOffloadEngine close() joined): a leaked engine means some
+    """Every test must leave zero live offload-engine dispatch OR
+    warmup threads (AsyncOffloadEngine close() joins both — warmup
+    thread names carry the '-warmup' suffix on the engine name, so the
+    'engine' match below covers them): a leaked engine means some
     provider/client teardown path lost track of its pipeline, and such
     regressions should fail HERE as a thread leak instead of surfacing
     later as flaky cross-test timeouts or stuck teardowns."""

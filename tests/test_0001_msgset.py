@@ -25,6 +25,9 @@ def mkmsgs(n=20, headers=False):
 
 @pytest.mark.parametrize("codec", [None, "gzip", "snappy", "lz4", "zstd"])
 def test_v2_roundtrip(codec):
+    if codec == "zstd":
+        from conftest import require_zstd
+        require_zstd()
     msgs = mkmsgs(50, headers=True)
     w = MsgsetWriterV2(base_offset=100, codec=codec)
     compress = (lambda b: cpu.CODECS[codec][0](b)) if codec else None

@@ -120,6 +120,9 @@ def produce_fetch_roundtrip(cluster, codec):
 
 @pytest.mark.parametrize("codec", [None, "lz4", "snappy", "gzip", "zstd"])
 def test_produce_fetch_wire_oracle(cluster, codec):
+    if codec == "zstd":
+        from conftest import require_zstd
+        require_zstd()
     produce_fetch_roundtrip(cluster, codec)
 
 

@@ -147,3 +147,46 @@ def test_stats_schema_fields():
                   "app_offset", "stored_offset", "committed_offset",
                   "hi_offset", "ls_offset", "consumer_lag"):
         assert field in tp, field
+
+
+def test_stats_blob_codec_engine_governor_counters():
+    """ISSUE 3: with the tpu backend's async engine live, the stats
+    JSON carries a codec_engine section — launch/merge/fallback/warmup
+    counters plus the governor's cost-model gauges."""
+    import json as _json
+    import time as _time
+
+    from librdkafka_tpu import Producer
+
+    blobs = []
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.launch.min.batches": 1,
+                  "compression.codec": "lz4", "linger.ms": 2,
+                  "statistics.interval.ms": 100,
+                  "stats_cb": lambda js: blobs.append(_json.loads(js))})
+    try:
+        for i in range(50):
+            p.produce("gov-st", value=b"v%d" % i * 40)
+        assert p.flush(120.0) == 0
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            p.poll(0.1)
+            if any("codec_engine" in b for b in blobs):
+                break
+    finally:
+        p.close()
+    with_engine = [b for b in blobs if "codec_engine" in b]
+    assert with_engine, "no stats blob carried codec_engine"
+    ce = with_engine[-1]["codec_engine"]
+    for field in ("launches", "jobs", "aggregated", "cpu_fallback_jobs",
+                  "warmup_miss_jobs", "warmup_compiled",
+                  "routed_cpu_jobs", "explore_routes", "fused_launches",
+                  "fanin_skips", "fanin_waits", "governor"):
+        assert field in ce, field
+    assert ce["jobs"] >= 1, ce
+    gov = ce["governor"]
+    for field in ("enabled", "warmup", "interarrival_us",
+                  "cpu_ns_per_byte", "dev_launch_ms"):
+        assert field in gov, field

@@ -170,6 +170,72 @@ def test_message_copy_max_bytes_lane_routing(cluster):
     p.close()
 
 
+def test_tpu_knob_validation_at_set_time(tmp_path):
+    """ISSUE 3 satellite: every tpu.* knob fails at Conf.set() time
+    with a clear error — negative/zero depths, bad bools, an unusable
+    compile-cache path — never at first launch."""
+    from librdkafka_tpu.client.conf import Conf
+    from librdkafka_tpu.client.errors import KafkaException
+
+    bad = [
+        ("tpu.pipeline.depth", -1),          # negative depth
+        ("tpu.pipeline.depth", 99),          # above range
+        ("tpu.fetch.pipeline.depth", 0),     # zero is not a valid depth
+        ("tpu.fetch.pipeline.depth", -3),
+        ("tpu.pipeline.fanin.us", -1),       # negative window
+        ("tpu.pipeline.fanin.us", 10**9),    # absurd window
+        ("tpu.launch.min.batches", 0),       # quorum floor is >= 1
+        ("tpu.warmup", "definitely"),        # not a bool
+        ("tpu.governor", "perhaps"),
+        # compile cache: parent directory must exist
+        ("tpu.compile.cache.dir",
+         str(tmp_path / "no-such-parent" / "deeper" / "cache")),
+    ]
+    for name, value in bad:
+        with pytest.raises(KafkaException) as ei:
+            Conf().set(name, value)
+        assert name in str(ei.value) or "Expected" in str(ei.value), \
+            (name, str(ei.value))
+    # a file is not a usable cache directory
+    somefile = tmp_path / "a-file"
+    somefile.write_text("x")
+    with pytest.raises(KafkaException):
+        Conf().set("tpu.compile.cache.dir", str(somefile))
+
+    # valid values round-trip, including the documented 'disabled'
+    # zeros and a creatable (not-yet-existing) cache dir
+    c = Conf()
+    c.set("tpu.pipeline.depth", 0)           # 0 = engine disabled
+    c.set("tpu.pipeline.fanin.us", 0)        # 0 = dispatch immediately
+    c.set("tpu.warmup", False)
+    c.set("tpu.governor", "true")
+    c.set("tpu.compile.cache.dir", str(tmp_path / "cache"))
+    assert c.get("tpu.governor") is True
+    assert c.get("tpu.warmup") is False
+    assert c.get("tpu.compile.cache.dir").endswith("cache")
+    existing = tmp_path / "have"
+    existing.mkdir()
+    c.set("tpu.compile.cache.dir", str(existing))
+
+
+def test_tpu_governor_knobs_reach_provider():
+    """Conf plumbing: tpu.governor / tpu.warmup / tpu.compile.cache.dir
+    reach the TpuCodecProvider the client constructs."""
+    from librdkafka_tpu import Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.governor": False, "tpu.warmup": False})
+    try:
+        prov = p._rk.codec_provider
+        assert prov.governor is False
+        assert prov.engine_warmup is False
+        assert prov.compile_cache_dir is None
+    finally:
+        p.close()
+
+
 def test_group_protocol_type_on_wire(cluster):
     """group.protocol.type feeds JoinGroup's protocol_type field — the
     mock group records what the client sent."""

@@ -50,6 +50,36 @@ def test_provider_crc32_many_parity():
         [zlib.crc32(b) & 0xFFFFFFFF for b in bufs]
 
 
+def test_crc32_submit_rides_device_engine():
+    """ISSUE 3 satellite: with the engine warmup landed, crc32_submit
+    rides _jit_mxu(poly='crc32') end to end — submissions enter the
+    engine immediately (the warmup gate serves from the CPU provider,
+    bit-exact, until the bucket kernel compiles) instead of returning
+    None for unconditional CPU service; once the bucket is warm the
+    same shape is a device launch, bit-exact on zlib-poly CRCs."""
+    rng = np.random.default_rng(14)
+    bufs = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            for n in (5, 700, 4096, 70000)]
+    want = [zlib.crc32(b) & 0xFFFFFFFF for b in bufs]
+    prov = TpuCodecProvider(min_batches=1, min_transport_mb_s=0,
+                            warmup=False, engine_warmup=True)
+    try:
+        t = prov.crc32_submit(bufs)
+        assert t is not None, \
+            "crc32_submit fell back to CPU service with warmup on"
+        assert t.result(120).tolist() == want
+        eng = prov._engine
+        assert eng.warm_wait(64, "crc32", 180), \
+            "engine warmup never compiled the crc32 bucket"
+        before = eng.stats["launches"]
+        t2 = prov.crc32_submit(bufs)
+        assert t2.result(120).tolist() == want
+        assert eng.stats["launches"] == before + 1, \
+            "warmed crc32 bucket did not ride a device launch"
+    finally:
+        prov.close()
+
+
 def _legacy_cluster(bver="0.10.0"):
     return MockCluster(num_brokers=1, topics={"old": 1},
                        broker_version=bver)
