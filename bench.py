@@ -153,6 +153,99 @@ def host_pipeline(n_msgs: int, size: int, toppars: int,
     return rate
 
 
+def txn_pipeline(n_msgs: int, size: int, toppars: int,
+                 mode: str = "plain", txn_size: int = 20000) -> float:
+    """End-to-end producer msgs/s with the message stream chopped into
+    transactions of txn_size messages (mode=commit/abort), vs the same
+    produce+flush cadence on a plain idempotent producer (mode=plain).
+    The flush boundary is identical across modes so the comparison
+    isolates the txn machinery itself (begin, AddPartitionsToTxn,
+    EndTxn markers, and for abort the KIP-360 epoch bump)."""
+    from itertools import cycle, islice
+
+    from librdkafka_tpu import Producer
+
+    conf = {
+        "bootstrap.servers": _external_mock(toppars),
+        "compression.codec": "lz4",
+        "batch.num.messages": 10000,
+        "linger.ms": 50,
+        "queue.buffering.max.messages": 2_000_000,
+    }
+    if mode == "plain":
+        conf["enable.idempotence"] = True
+    else:
+        conf["transactional.id"] = f"bench-tx-{mode}"
+    p = Producer(conf)
+    if mode != "plain":
+        p.init_transactions(60)
+    vals = _payloads(min(n_msgs, 4096), size)
+    pairs = [(vals[i % len(vals)], i % toppars)
+             for i in range(len(vals) * toppars // _gcd(len(vals), toppars))]
+    produce = p.produce
+    if mode != "plain":
+        p.begin_transaction()
+    for v, part in islice(cycle(pairs), 2000):  # warm sockets + codecs
+        produce("txbench", value=v, partition=part)
+    if p.flush(120.0) != 0:
+        raise RuntimeError("warmup flush did not drain")
+    if mode == "commit":
+        p.commit_transaction(60)
+    elif mode == "abort":
+        p.abort_transaction(60)
+    t0 = time.perf_counter()
+    it = islice(cycle(pairs), n_msgs)
+    remaining = n_msgs
+    while remaining:
+        chunk = min(txn_size, remaining)
+        if mode != "plain":
+            p.begin_transaction()
+        for v, part in islice(it, chunk):
+            produce("txbench", value=v, partition=part)
+        # every message is delivered in every mode — abort purges only
+        # undelivered messages, so the flush precedes it
+        if p.flush(120.0) != 0:
+            raise RuntimeError("txn bench flush did not drain")
+        if mode == "commit":
+            p.commit_transaction(60)
+        elif mode == "abort":
+            p.abort_transaction(60)
+        remaining -= chunk
+    rate = n_msgs / (time.perf_counter() - t0)
+    p.close()
+    return rate
+
+
+def txn_bench() -> dict:
+    """bench.py --txn (ISSUE 4 acceptance): transactional produce
+    throughput — commit and abort legs vs the plain idempotent
+    producer at the same flush cadence, 1KB lz4. The txn machinery
+    (AddPartitionsToTxn registration, EndTxn markers, abort's epoch
+    bump) must cost < 15% end-to-end. Trials interleave plain/commit/
+    abort so host load drift hits all three legs equally."""
+    n_msgs = int(os.environ.get("BENCH_TXN_MSGS", 120000))
+    size = int(os.environ.get("BENCH_MSG_SIZE", 1024))
+    toppars = int(os.environ.get("BENCH_TOPPARS", 16))
+    rates: dict[str, list[float]] = {"plain": [], "commit": [], "abort": []}
+    for _trial in range(3):
+        for mode in ("plain", "commit", "abort"):
+            rates[mode].append(txn_pipeline(n_msgs, size, toppars, mode))
+    med = {m: sorted(r)[1] for m, r in rates.items()}
+    overhead = {m: 1.0 - med[m] / med["plain"] for m in ("commit", "abort")}
+    return {
+        "n_msgs": n_msgs, "msg_size": size, "toppars": toppars,
+        "plain_idempotent_msgs_s": round(med["plain"]),
+        "txn_commit_msgs_s": round(med["commit"]),
+        "txn_abort_msgs_s": round(med["abort"]),
+        "commit_overhead": round(overhead["commit"], 4),
+        "abort_overhead": round(overhead["abort"], 4),
+        "acceptance_overhead_lt": 0.15,
+        "pass": bool(overhead["commit"] < 0.15
+                     and overhead["abort"] < 0.15),
+        "trials": {m: [round(x) for x in r] for m, r in rates.items()},
+    }
+
+
 def consumer_pipeline(n_msgs: int, size: int, toppars: int,
                       codec: str = "lz4") -> float:
     """End-to-end consumer msgs/s with check.crcs (batched fetch-side
@@ -978,6 +1071,38 @@ def smoke_bench() -> dict:
     eng2.close()
     legs["fused"] = f"bit-identical ({fused} fused launch)"
 
+    # transactional producer round trip (ISSUE 4): commit then abort
+    # through the real Producer API against the in-process mock — the
+    # log must end data..COMMIT..data..ABORT with an aborted-txn index
+    # entry covering only the aborted range
+    from librdkafka_tpu import Producer
+    from librdkafka_tpu.protocol.msgset import read_batch_header
+    from librdkafka_tpu.utils.buf import Slice
+    tp_ = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                    "transactional.id": "smoke-tx",
+                    "compression.codec": "lz4", "linger.ms": 1})
+    try:
+        tp_.init_transactions(30)
+        tp_.begin_transaction()
+        for i in range(5):
+            tp_.produce("smoke-txn", value=b"c%d" % i, partition=0)
+        tp_.commit_transaction(30)
+        tp_.begin_transaction()
+        for i in range(5):
+            tp_.produce("smoke-txn", value=b"a%d" % i, partition=0)
+        tp_.flush(30)
+        tp_.abort_transaction(30)
+        part = tp_._rk.mock_cluster.partition("smoke-txn", 0)
+        infos = [read_batch_header(Slice(bytes(b))) for _o, b in part.log]
+        assert [i.is_control for i in infos] == [False, True, False, True], \
+            "txn leg: log is not data,COMMIT,data,ABORT"
+        assert all(i.is_transactional for i in infos), \
+            "txn leg: batch missing the transactional attr bit"
+        assert len(part.aborted) == 1, "txn leg: aborted-txn index wrong"
+        legs["txn"] = "commit+abort markers + aborted index correct"
+    finally:
+        tp_.close()
+
     return {"elapsed_s": round(time.perf_counter() - t_start, 1),
             "legs": legs}
 
@@ -989,6 +1114,12 @@ def main():
                                     "multi-poly launches (bench.py "
                                     "--governor)",
                           **governor_bench()}))
+        return
+    if "--txn" in sys.argv:
+        print(json.dumps({"metric": "transactional vs plain idempotent "
+                                    "produce throughput (bench.py "
+                                    "--txn)",
+                          **txn_bench()}))
         return
     if "--smoke" in sys.argv:
         print(json.dumps({"metric": "pre-commit smoke: bit-exactness "

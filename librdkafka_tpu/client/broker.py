@@ -33,7 +33,7 @@ from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..utils import sockbuf
 from ..protocol.msgset import MsgsetWriterV2
-from ..protocol.proto import ApiKey
+from ..protocol.proto import ApiKey, ATTR_TRANSACTIONAL
 from .errors import Err, KafkaError, KafkaException
 from .feature import (MSGVER1, MSGVER2, fallback_api_versions,
                       features_from_api_versions, pick_version)
@@ -83,15 +83,18 @@ class _FusedJob:
     intermediate Python bytes.  Idempotence fields are captured at
     batch-formation time exactly like _make_writer does."""
 
-    __slots__ = ("codec_id", "pid", "epoch", "base_seq", "now_ms")
+    __slots__ = ("codec_id", "pid", "epoch", "base_seq", "now_ms",
+                 "attrs")
 
     def __init__(self, codec_id: int, pid: int, epoch: int,
-                 base_seq: int, now_ms: int):
+                 base_seq: int, now_ms: int, attrs: int = 0):
         self.codec_id = codec_id
         self.pid = pid
         self.epoch = epoch
         self.base_seq = base_seq
         self.now_ms = now_ms
+        # extra v2 attribute bits (ATTR_TRANSACTIONAL for EOS batches)
+        self.attrs = attrs
 
 
 def _fused_builder():
@@ -196,7 +199,7 @@ def _begin_codec_phase(rk, ready: list):
                     raise RuntimeError("fused builder unavailable")
                 wire = build(msgs.base, msgs.klens, msgs.vlens,
                              msgs.count, w.now_ms, w.pid, w.epoch,
-                             w.base_seq, w.codec_id)
+                             w.base_seq, w.codec_id, w.attrs)
                 by_idx[i] = (tp, msgs, wire, None)
             except Exception as e:
                 by_idx[i] = (tp, msgs, None, e)
@@ -1138,6 +1141,18 @@ class Broker:
                             rk.conf.get("max.in.flight.requests.per.connection"))
             if rk.idemp and not rk.idemp.can_produce():
                 continue
+            # transactional gate: a partition's batches may only leave
+            # once it is registered with the txn coordinator
+            # (AddPartitionsToTxn; partition_ready queues unregistered
+            # ones for the main-thread serve pass — this loop never
+            # blocks on a coordinator round trip). Only toppars with
+            # actual work register: an idle partition must never draw a
+            # txn marker just for being led here.
+            if (rk.txnmgr is not None
+                    and (tp.retry_batches or tp.xmit_msgq
+                         or (tp.arena is not None and len(tp.arena)))
+                    and not rk.txnmgr.partition_ready(tp)):
+                continue
             # frozen retry batches resend first, membership intact, and
             # block new batch formation until drained (ordering); popped
             # batches are accounted in-flight IMMEDIATELY so the DRAIN
@@ -1343,17 +1358,26 @@ class Broker:
             pid, epoch = rk.idemp.pid, rk.idemp.epoch
             base_seq = (batch_head_msgid(msgs) - 1
                         - tp.epoch_base_msgid) & 0x7FFFFFFF
+        # transactional attr bit: every batch of a transactional
+        # producer carries it (produce() is gated to IN_TXN), flowing
+        # through the same writer on both CPU and TPU codec providers
+        transactional = rk.txnmgr is not None
         now_ms = int(time.time() * 1000)
         if isinstance(msgs, ArenaBatch):
             # fused fast lane: defer frame+compress+CRC to ONE native
             # call in the codec phase (no intermediate records_bytes)
-            # when the provider routes this codec to the CPU path
+            # when the provider routes this codec to the CPU path.
+            # Transactional batches ride it too — build_batch ORs the
+            # transactional bit into the attribute word
             cid = getattr(rk.codec_provider, "fused_codec_id",
                           lambda c: None)(codec)
             if cid is not None and _fused_builder() is not None:
-                return _FusedJob(cid, pid, epoch, base_seq, now_ms)
+                return _FusedJob(cid, pid, epoch, base_seq, now_ms,
+                                 ATTR_TRANSACTIONAL if transactional
+                                 else 0)
         w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
                            base_sequence=base_seq,
+                           transactional=transactional,
                            codec=None if codec == "none" else codec)
         if isinstance(msgs, ArenaBatch):
             # fast lane: ONE native call straight off the arena buffers
@@ -1410,7 +1434,9 @@ class Broker:
                 m.latency_us = int((now - m.enq_time) * 1e6)
         req = Request(
             ApiKey.Produce,
-            {"transactional_id": None, "acks": acks,
+            {"transactional_id": (rk.conf.get("transactional.id") or None
+                                  if rk.txnmgr is not None else None),
+             "acks": acks,
              "timeout": tconf.get("request.timeout.ms"),
              "topics": [{"topic": tp.topic, "partitions": [
                  {"partition": tp.partition, "records": wire}]}]},
@@ -1483,6 +1509,15 @@ class Broker:
             kerr = err
 
         # error path
+        if rk.txnmgr is not None and kerr.code in (
+                Err.PRODUCER_FENCED, Err.INVALID_PRODUCER_EPOCH,
+                Err.TRANSACTION_COORDINATOR_FENCED):
+            # zombie fencing: a newer instance of this transactional.id
+            # bumped the epoch — fatal, never retried (resending under
+            # a stale epoch is exactly what fencing exists to stop)
+            fatal = rk.txnmgr.fenced(f"{tp}: produce")
+            rk.dr_msgq(msgs, fatal, tp=tp)
+            return
         if kerr.code in (Err.DUPLICATE_SEQUENCE_NUMBER,):
             # benign: broker already has these (idempotent dedup)
             if not fast:

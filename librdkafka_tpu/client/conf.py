@@ -72,6 +72,20 @@ def _valid_cache_dir(v: Any) -> Optional[str]:
     return None
 
 
+def _valid_transactional_id(v) -> Optional[str]:
+    """transactional.id: empty (non-transactional) or a usable id —
+    printable, and within the broker's 249-char resource-name bound, so
+    a bad id fails at set() time instead of at init_transactions()."""
+    s = str(v)
+    if not s:
+        return None
+    if len(s) > 249:
+        return f"id is {len(s)} chars; the broker bound is 249"
+    if any(ord(c) < 0x20 or ord(c) == 0x7F for c in s):
+        return "id contains control characters"
+    return None
+
+
 #: The declarative property table. Mirrors rdkafka_conf.c:224's table shape.
 PROPERTIES: list[Prop] = [
     # ---- global: general ----
@@ -273,6 +287,21 @@ PROPERTIES: list[Prop] = [
     # ---- global: producer ----
     _p("enable.idempotence", GLOBAL, "bool", False,
        "Exactly-once-ish producer: no dupes, no reordering (EOS v1).", app=P),
+    _p("transactional.id", GLOBAL, "str", "",
+       "Enables the transactional producer: a stable id identifying the "
+       "same producer instance across restarts, used by the transaction "
+       "coordinator to fence zombie instances (a newer init_transactions "
+       "with the same id bumps the epoch; the older instance fails "
+       "fatally with PRODUCER_FENCED). Setting it implies "
+       "enable.idempotence; produce() is only allowed inside "
+       "begin_transaction()..commit/abort_transaction(). Validated at "
+       "set() time.", app=P, validator=_valid_transactional_id),
+    _p("transaction.timeout.ms", GLOBAL, "int", 60000,
+       "Maximum time the transaction coordinator waits for a transaction "
+       "status update from this producer before proactively aborting the "
+       "ongoing transaction. Sent in InitProducerId; also bounds the "
+       "default timeout of the blocking transaction APIs.",
+       app=P, vmin=1000, vmax=2147483647),
     _p("enable.gapless.guarantee", GLOBAL, "bool", False,
        "Fatal error if a message could create a sequence gap.", app=P),
     _p("queue.buffering.max.messages", GLOBAL, "int", 100000,
@@ -537,6 +566,10 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "fetch.num.inflight"),             # fetch pipelining depth
     (GLOBAL, "dr_batch_cb"),                    # batched DR callback
     (GLOBAL, "test.mock.default.partitions"),   # mock-cluster knob
+    # transactional producer (librdkafka grows these in 1.4; the
+    # 1.3.0 reference table stops at the idempotent producer)
+    (GLOBAL, "transactional.id"),
+    (GLOBAL, "transaction.timeout.ms"),
 })
 
 # Scope-keyed lookup: the reference's table has rows of the same name in

@@ -83,6 +83,16 @@ class TopicPartition:
         return hash((self.topic, self.partition))
 
 
+@dataclass
+class ConsumerGroupMetadata:
+    """Opaque consumer-group identity handed to
+    Producer.send_offsets_to_transaction
+    (rd_kafka_consumer_group_metadata_t)."""
+    group_id: str
+    generation: int = -1
+    member_id: str = ""
+
+
 class Consumer:
     def __init__(self, conf):
         if isinstance(conf, dict):
@@ -706,6 +716,19 @@ class Consumer:
         empty string before the first JoinGroup completes)."""
         cg = self._rk.cgrp
         return cg.member_id if cg is not None else ""
+
+    def consumer_group_metadata(self):
+        """Opaque group metadata for
+        Producer.send_offsets_to_transaction (the
+        rd_kafka_consumer_group_metadata analog: group id plus the
+        current generation/member identity)."""
+        from .errors import Err, KafkaException
+        cg = self._rk.cgrp
+        if cg is None:
+            raise KafkaException(Err._UNKNOWN_GROUP,
+                                 "consumer_group_metadata requires group.id")
+        return ConsumerGroupMetadata(cg.group_id, cg.generation,
+                                     cg.member_id)
 
     def poll_kafka(self, timeout: float = 0.0) -> int:
         return self._rk.poll(timeout)

@@ -154,6 +154,10 @@ class Err(enum.IntEnum):
     PREFERRED_LEADER_NOT_AVAILABLE = 80
     GROUP_MAX_SIZE_REACHED = 81
     FENCED_INSTANCE_ID = 82
+    # KIP-360 era: the broker's explicit zombie-fencing code for a
+    # producer whose (pid, epoch) was superseded by a newer instance of
+    # the same transactional.id
+    PRODUCER_FENCED = 90
 
     @property
     def is_local(self) -> bool:

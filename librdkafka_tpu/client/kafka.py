@@ -122,6 +122,11 @@ class IdempotenceManager:
         Used for recoverable gaps the broker never saw (e.g. messages
         timing out locally, rdkafka_broker.c:3291-3309) — NOT for
         head-of-line sequence desync, which is fatal."""
+        if self.rk.txnmgr is not None:
+            # transactional mode: the txn manager owns the epoch
+            # lifecycle (gaps surface as abortable errors; the
+            # post-abort InitProducerId bumps the epoch and rebases)
+            return
         with self._lock:
             if self.state in ("ASSIGNED", "WAIT_PID"):
                 self.rk.dbg("eos", f"drain+epoch bump: {reason}")
@@ -244,9 +249,21 @@ class Kafka:
             from ..ops.cpu import CpuCodecProvider
             self.codec_provider = CpuCodecProvider()
 
+        # transactional.id implies idempotence (the txn FSM layers over
+        # the pid/epoch machinery; reference: rd_kafka_conf finalize
+        # forces enable.idempotence for transactional producers)
+        txn_id = conf.get("transactional.id") if self.is_producer else ""
         self.idemp = (IdempotenceManager(self)
-                      if self.is_producer and conf.get("enable.idempotence")
+                      if self.is_producer
+                      and (conf.get("enable.idempotence") or txn_id)
                       else None)
+        self.txnmgr = None
+        if txn_id:
+            from .txnmgr import TransactionManager
+            self.txnmgr = TransactionManager(self)
+            # the lane was computed before txnmgr existed; re-gate it
+            # on the (UNINIT) txn state
+            self._txn_lane_sync()
 
         # codec pipeline thread (codec.pipeline.depth; SURVEY.md §5
         # axis 2 — overlap batch build/socket IO with codec launches)
@@ -388,8 +405,14 @@ class Kafka:
             if op is not None:
                 self._op_serve(op)
             self.timers.run()
-            if self.idemp:
+            if self.idemp and self.txnmgr is None:
+                # transactional pids are acquired ONLY through
+                # init_transactions (the txnmgr owns the epoch
+                # lifecycle); the idempotence FSM must not race it with
+                # a non-transactional InitProducerId
                 self.idemp.serve()
+            if self.txnmgr is not None:
+                self.txnmgr.serve()
             if self.cgrp:
                 self.cgrp.serve()
         if self.interceptors:
@@ -845,6 +868,13 @@ class Kafka:
             key = key.encode()
         if self.fatal_error:
             raise KafkaException(self.fatal_error)
+        if self.txnmgr is not None and self.txnmgr.state != "IN_TXN":
+            # transactional producers may only produce inside a
+            # transaction (reference: rd_kafka_produce ERR__STATE gate)
+            raise KafkaException(
+                Err._STATE,
+                f"produce() requires an ongoing transaction "
+                f"(state {self.txnmgr.state}; call begin_transaction)")
         sz = (len(value) if value else 0) + (len(key) if key else 0)
         # reference: rd_kafka_msg_new0 rejects oversize messages up
         # front with MSG_SIZE_TOO_LARGE (test 0003-msgmaxsize)
@@ -911,13 +941,28 @@ class Kafka:
         # produce() stays on the zero-alloc path — the reference's
         # headline throughput runs WITH dr_msg_cb set. Interceptors
         # still force the Message path: on_send must fire per message
-        # at produce() time.
+        # at produce() time.  Transactional producers ride the lane
+        # too, but only while produce() is legal — the C entry point
+        # cannot check the in-transaction state gate itself, so the
+        # txn FSM toggles lane.enabled at every transition
+        # (_txn_lane_sync); outside IN_TXN the tail-call into
+        # _produce_slow raises the reference's ERR__STATE.
         self._fast_lane = (self.is_producer and not self.interceptors)
         self._fast_lane_ver = getattr(conf, "version", 0)
         # the C entry consults this flag before touching an arena; a
         # conf.set that adds a DR consumer flips it via the listener
+        self._txn_lane_sync()
+
+    def _txn_lane_sync(self) -> None:
+        """Recompute the native lane's enable flag from the fast-lane
+        eligibility AND the txn FSM (transactional producers may only
+        fast-enqueue while IN_TXN)."""
+        txnmgr = getattr(self, "txnmgr", None)
         try:
-            self._lane.enabled = 1 if self._fast_lane else 0
+            self._lane.enabled = (
+                1 if self._fast_lane
+                and (txnmgr is None or txnmgr.state == "IN_TXN")
+                else 0)
         except AttributeError:
             pass                        # lane not constructed yet
 
@@ -1018,6 +1063,11 @@ class Kafka:
         Message objects HERE — at delivery-report time, off the
         produce() path — carrying ``tp``'s topic/partition and offsets
         from ``base_offset`` (successful batches)."""
+        if err is not None and self.txnmgr is not None:
+            # a failed message inside a transaction makes it abortable
+            # (reference: rd_kafka_txn_set_abortable_error from the DR
+            # path); purge DRs during abort are exempt inside msg_failed
+            self.txnmgr.msg_failed(err)
         batch_nbytes = None
         if isinstance(msgs, ArenaBatch):
             if self._dr_out_wanted():

@@ -100,6 +100,51 @@ class Producer:
                 m["error"] = KafkaError(Err._FAIL, repr(e))
         return n
 
+    # ------------------------------------------------------ transactions --
+    def _txnmgr(self):
+        from .errors import Err, KafkaException
+        t = self._rk.txnmgr
+        if t is None:
+            raise KafkaException(
+                Err._NOT_IMPLEMENTED,
+                "transactional API requires transactional.id to be "
+                "configured")
+        return t
+
+    def init_transactions(self, timeout: float = -1) -> None:
+        """Acquire the transactional (pid, epoch) from the transaction
+        coordinator; fences any previous instance of the same
+        transactional.id (rd_kafka_init_transactions analog). Must be
+        called once before the first begin_transaction()."""
+        self._txnmgr().init_transactions(timeout)
+
+    def begin_transaction(self) -> None:
+        """Start a transaction; all following produce() calls and
+        send_offsets_to_transaction() belong to it until
+        commit_transaction()/abort_transaction()."""
+        self._txnmgr().begin_transaction()
+
+    def send_offsets_to_transaction(self, offsets, group_metadata,
+                                    timeout: float = -1) -> None:
+        """Commit consumed offsets atomically with this transaction
+        (EOS consume-transform-produce). ``offsets`` is a list of
+        TopicPartition with .offset; ``group_metadata`` is a
+        Consumer.consumer_group_metadata() object or a group id str."""
+        self._txnmgr().send_offsets_to_transaction(offsets, group_metadata,
+                                                   timeout)
+
+    def commit_transaction(self, timeout: float = -1) -> None:
+        """Flush all in-flight messages, then commit the transaction
+        (the coordinator writes COMMIT markers into every registered
+        partition)."""
+        self._txnmgr().commit_transaction(timeout)
+
+    def abort_transaction(self, timeout: float = -1) -> None:
+        """Purge queued messages, drain in-flight ones, then abort the
+        transaction (ABORT markers make everything produced in it
+        invisible to read_committed consumers)."""
+        self._txnmgr().abort_transaction(timeout)
+
     def poll(self, timeout: float = 0.0) -> int:
         return self._rk.poll(timeout)
 

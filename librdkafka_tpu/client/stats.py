@@ -150,4 +150,14 @@ class StatsCollector:
             blob["eos"] = {"idemp_state": rk.idemp.state,
                            "producer_id": rk.idemp.pid,
                            "producer_epoch": rk.idemp.epoch}
+            if rk.txnmgr is not None:
+                # transactional FSM snapshot (STATISTICS.md eos blob)
+                blob["eos"].update({
+                    "txn_state": rk.txnmgr.state,
+                    "transactional_id": rk.txnmgr.transactional_id,
+                    "txn_registered_partitions":
+                        len(rk.txnmgr._registered),
+                    "txn_coordinator": (rk.txnmgr.coord_id
+                                        if rk.txnmgr.coord_id is not None
+                                        else -1)})
         return json.dumps(blob)

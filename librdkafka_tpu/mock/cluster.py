@@ -62,6 +62,20 @@ class MockPartition:
     # consumer fetches (None = leader serves); the reference mock's
     # rd_kafka_mock_partition_set_follower equivalent
     follower_id: Optional[int] = None
+    # aborted-transaction index: [{"producer_id", "first_offset",
+    # "last_offset"}] — reported to read_committed fetches whose range
+    # overlaps (real brokers: the .txnindex sidecar file)
+    aborted: list = field(default_factory=list)
+    # open (un-ended) transactions touching this partition:
+    # pid -> first data offset; bounds the last stable offset
+    open_txns: dict = field(default_factory=dict)
+
+    def lso(self) -> int:
+        """Last stable offset: first offset still inside an open
+        transaction, or the log end when none is open."""
+        if self.open_txns:
+            return min(self.open_txns.values())
+        return self.end_offset
 
     def append(self, blob: bytes) -> int:
         """Append a produced MessageSet verbatim; returns assigned base
@@ -95,12 +109,19 @@ class MockPartition:
                 self.start_offset = self.log[0][0]
         return base
 
-    def read_from(self, offset: int, max_bytes: int) -> bytes:
+    def read_from(self, offset: int, max_bytes: int,
+                  max_offset: Optional[int] = None) -> bytes:
+        """``max_offset`` caps the read below the LSO for
+        read_committed fetches: batches of a still-open transaction
+        must not reach isolation-level-1 consumers (real brokers stop
+        at the last stable offset)."""
         out = bytearray()
         for base, blob in self.log:
             # include any blob whose range covers/starts-after the offset
             if base + self._blob_count(blob) <= offset:
                 continue
+            if max_offset is not None and base >= max_offset:
+                break
             out += blob
             if len(out) >= max_bytes:
                 break
@@ -140,6 +161,24 @@ class MockGroup:
     offsets: dict[tuple[str, int], tuple[int, Optional[str]]] = field(default_factory=dict)
     rebalance_deadline: float = 0.0
     pending_syncs: list[tuple] = field(default_factory=list)  # (conn, corrid, member_id)
+
+
+@dataclass
+class MockTransaction:
+    """Transaction-coordinator state for one transactional.id
+    (reference: the 2.x broker's TransactionMetadata; the v1.3.0 mock
+    has no coordinator role at all)."""
+    tid: str
+    pid: int
+    epoch: int = -1
+    state: str = "Empty"   # Empty/Ongoing/CompleteCommit/CompleteAbort
+    # (topic, partition) -> first data offset of the CURRENT txn
+    # (None until the first transactional batch lands there)
+    partitions: dict = field(default_factory=dict)
+    groups: set = field(default_factory=set)
+    # group -> {(topic, partition): (offset, metadata)} staged by
+    # TxnOffsetCommit, applied to the group at EndTxn(commit)
+    pending_offsets: dict = field(default_factory=dict)
 
 
 class _Conn:
@@ -200,6 +239,10 @@ class MockCluster:
         self.cluster_id = "mockCluster"
         self.controller_id = 1
         self._next_pid = 1
+        # transaction-coordinator role: per-transactional.id state +
+        # the pid -> tid reverse map the Produce path fences through
+        self.transactions: dict[str, MockTransaction] = {}
+        self._pid_tid: dict[int, str] = {}
         self._lock = threading.RLock()
         # fault injection
         self._err_stacks: dict[int, deque] = defaultdict(deque)
@@ -601,6 +644,8 @@ class MockCluster:
 
     def _produce_to(self, part: MockPartition, blob: bytes) -> tuple[Err, int]:
         # idempotence checks for v2 batches (reference mock_handlers Produce)
+        txn = None
+        info = None
         if (len(blob) >= proto.V2_HEADER_SIZE
                 and blob[proto.V2_OF_Magic] == 2):
             try:
@@ -608,6 +653,22 @@ class MockCluster:
             except Exception:
                 return Err.INVALID_MSG, -1
             if info.producer_id >= 0:
+                # epoch fencing precedes everything: a zombie's stale
+                # epoch must never append (real broker ProducerStateManager)
+                tid = self._pid_tid.get(info.producer_id)
+                txn = self.transactions.get(tid) if tid else None
+                if txn is not None and info.producer_epoch != txn.epoch:
+                    return (Err.PRODUCER_FENCED
+                            if info.producer_epoch < txn.epoch
+                            else Err.INVALID_PRODUCER_EPOCH), -1
+                if info.is_transactional:
+                    if txn is None:
+                        return Err.INVALID_PRODUCER_ID_MAPPING, -1
+                    if (part.topic, part.id) not in txn.partitions:
+                        # transactional data requires AddPartitionsToTxn
+                        # first — the coordinator can't write a marker
+                        # for a partition it never heard of
+                        return Err.INVALID_TXN_STATE, -1
                 key = (info.producer_id, info.producer_epoch)
                 expected = part.pid_seqs.get(key, 0)
                 if info.base_sequence != expected:
@@ -616,6 +677,13 @@ class MockCluster:
                     return Err.OUT_OF_ORDER_SEQUENCE_NUMBER, -1
                 part.pid_seqs[key] = info.base_sequence + info.record_count
         base = part.append(blob)
+        if info is not None and info.is_transactional and txn is not None:
+            # first data offset of this txn in this partition: feeds
+            # the aborted-txn index entry and pins the LSO
+            tkey = (part.topic, part.id)
+            if txn.partitions.get(tkey) is None:
+                txn.partitions[tkey] = base
+            part.open_txns.setdefault(info.producer_id, base)
         return Err.NO_ERROR, base
 
     def set_follower(self, topic: str, partition: int,
@@ -672,12 +740,20 @@ class MockCluster:
                             hwm = lso = part.end_offset
                             preferred = part.follower_id
                         else:
-                            hwm = lso = part.end_offset
+                            hwm = part.end_offset
+                            lso = part.lso()
                             off = p["fetch_offset"]
+                            # read_committed fetches stop at the LSO:
+                            # data of a still-open transaction is not
+                            # stable yet (real broker behavior)
+                            cap = (lso if body.get("isolation_level", 0)
+                                   == 1 else part.end_offset)
                             if off < part.start_offset or off > part.end_offset:
                                 err = Err.OFFSET_OUT_OF_RANGE
-                            elif off < part.end_offset:
-                                records = part.read_from(off, p["max_bytes"])
+                            elif off < cap:
+                                records = part.read_from(
+                                    off, p["max_bytes"],
+                                    max_offset=cap)
                     if err != Err.NO_ERROR:
                         any_err = True
                     if records:
@@ -689,10 +765,11 @@ class MockCluster:
                         # ABORT marker precedes the fetch offset must
                         # not be re-reported or the client would filter
                         # later committed data from the same pid
-                        # (txn index test-seeded via part.aborted;
-                        # optional "last_offset" = abort marker offset)
+                        # (txn index maintained by EndTxn, also
+                        # test-seedable via part.aborted;
+                        # "last_offset" = abort marker offset)
                         aborted = [
-                            a for a in getattr(part, "aborted", []) or []
+                            a for a in part.aborted or []
                             if a.get("last_offset", 1 << 62)
                             >= p["fetch_offset"]]
                     if preferred != -1:
@@ -978,15 +1055,174 @@ class MockCluster:
         return {"topics": out}
 
     # ----------------------------------------------------------- producer --
+    #: broker-side transaction.max.timeout.ms (real default)
+    MAX_TXN_TIMEOUT_MS = 900000
+
     def _h_InitProducerId(self, conn, corrid, hdr, body, inject):
         if inject:
             return {"throttle_time_ms": 0, "error_code": inject.wire,
                     "producer_id": -1, "producer_epoch": -1}
+        tid = body.get("transactional_id")
+        if not tid:
+            # plain idempotent producer: fresh pid, epoch 0
+            with self._lock:
+                pid = self._next_pid
+                self._next_pid += 1
+            return {"throttle_time_ms": 0, "error_code": 0,
+                    "producer_id": pid, "producer_epoch": 0}
+        # transactional: the id is pinned to its coordinator, keeps its
+        # pid across re-inits, and every re-init BUMPS THE EPOCH —
+        # fencing any older instance (zombie) still holding the old one
+        fail = {"throttle_time_ms": 0, "producer_id": -1,
+                "producer_epoch": -1}
+        tmo = body.get("transaction_timeout_ms", 60000)
+        if tmo <= 0 or tmo > self.MAX_TXN_TIMEOUT_MS:
+            return {**fail,
+                    "error_code": Err.INVALID_TRANSACTION_TIMEOUT.wire}
         with self._lock:
-            pid = self._next_pid
-            self._next_pid += 1
-        return {"throttle_time_ms": 0, "error_code": 0,
-                "producer_id": pid, "producer_epoch": 0}
+            if conn.broker_id != self.coordinator_for(tid):
+                return {**fail, "error_code": Err.NOT_COORDINATOR.wire}
+            t = self.transactions.get(tid)
+            if t is None:
+                t = MockTransaction(tid=tid, pid=self._next_pid)
+                self._next_pid += 1
+                self.transactions[tid] = t
+                self._pid_tid[t.pid] = tid
+            elif t.state == "Ongoing":
+                # previous instance died mid-transaction: abort it
+                # before handing out the new epoch (real coordinator
+                # behavior on InitProducerId with an ongoing txn)
+                self._end_txn_locked(t, committed=False)
+            t.epoch += 1
+            t.state = "Empty"
+            return {"throttle_time_ms": 0, "error_code": 0,
+                    "producer_id": t.pid, "producer_epoch": t.epoch}
+
+    def _txn_lookup_locked(self, conn, tid: str, pid: int, epoch: int,
+                           *, check_coord: bool = True) -> Optional[Err]:
+        """Validate a transactional request's identity; None = OK."""
+        if check_coord and conn.broker_id != self.coordinator_for(tid):
+            return Err.NOT_COORDINATOR
+        t = self.transactions.get(tid)
+        if t is None or t.pid != pid:
+            return Err.INVALID_PRODUCER_ID_MAPPING
+        if epoch < t.epoch:
+            return Err.PRODUCER_FENCED     # zombie instance
+        if epoch > t.epoch:
+            return Err.INVALID_PRODUCER_EPOCH
+        return None
+
+    def _h_AddPartitionsToTxn(self, conn, corrid, hdr, body, inject):
+        tid = body["transactional_id"]
+        out = []
+        with self._lock:
+            base_err = inject or self._txn_lookup_locked(
+                conn, tid, body["producer_id"], body["producer_epoch"])
+            t = self.transactions.get(tid)
+            for tr in body["topics"]:
+                parts = []
+                for p in tr["partitions"]:
+                    err = base_err or Err.NO_ERROR
+                    if err == Err.NO_ERROR:
+                        if tr["topic"] not in self.topics or \
+                                p >= len(self.topics[tr["topic"]]):
+                            err = Err.UNKNOWN_TOPIC_OR_PART
+                        else:
+                            t.partitions.setdefault((tr["topic"], p), None)
+                            t.state = "Ongoing"
+                    parts.append({"partition": p, "error_code": err.wire})
+                out.append({"topic": tr["topic"], "partitions": parts})
+        return {"throttle_time_ms": 0, "results": out}
+
+    def _h_AddOffsetsToTxn(self, conn, corrid, hdr, body, inject):
+        with self._lock:
+            err = inject or self._txn_lookup_locked(
+                conn, body["transactional_id"], body["producer_id"],
+                body["producer_epoch"])
+            if err is None:
+                t = self.transactions[body["transactional_id"]]
+                t.groups.add(body["group_id"])
+                t.state = "Ongoing"
+        return {"throttle_time_ms": 0,
+                "error_code": err.wire if err else 0}
+
+    def _h_TxnOffsetCommit(self, conn, corrid, hdr, body, inject):
+        # arrives at the GROUP coordinator (real protocol), so the
+        # txn-coordinator pinning check is skipped; offsets stage in
+        # the txn and only land in the group at EndTxn(commit)
+        out = []
+        with self._lock:
+            err = inject or self._txn_lookup_locked(
+                conn, body["transactional_id"], body["producer_id"],
+                body["producer_epoch"], check_coord=False)
+            t = self.transactions.get(body["transactional_id"])
+            staged = (t.pending_offsets.setdefault(body["group_id"], {})
+                      if err is None else None)
+            for tr in body["topics"]:
+                parts = []
+                for p in tr["partitions"]:
+                    if err is None:
+                        staged[(tr["topic"], p["partition"])] = (
+                            p["offset"], p["metadata"])
+                    parts.append({"partition": p["partition"],
+                                  "error_code": err.wire if err else 0})
+                out.append({"topic": tr["topic"], "partitions": parts})
+        return {"throttle_time_ms": 0, "topics": out}
+
+    def _h_EndTxn(self, conn, corrid, hdr, body, inject):
+        with self._lock:
+            err = inject or self._txn_lookup_locked(
+                conn, body["transactional_id"], body["producer_id"],
+                body["producer_epoch"])
+            if err is None:
+                t = self.transactions[body["transactional_id"]]
+                if t.state != "Ongoing":
+                    err = Err.INVALID_TXN_STATE
+                else:
+                    self._end_txn_locked(t, body["committed"])
+        return {"throttle_time_ms": 0,
+                "error_code": err.wire if err else 0}
+
+    def _end_txn_locked(self, t: MockTransaction, committed: bool) -> None:
+        """Write a COMMIT/ABORT control record into every partition the
+        transaction touched, maintain the aborted-transaction index,
+        release the LSO, and (on commit) land the staged group offsets
+        (real coordinator: WriteTxnMarkers to the partition leaders)."""
+        for (topic, pnum), first in t.partitions.items():
+            parts = self.topics.get(topic)
+            if parts is None or pnum >= len(parts):
+                continue                    # topic deleted mid-txn
+            part = parts[pnum]
+            marker = self._control_batch(t.pid, t.epoch, committed)
+            base = part.append(marker)
+            part.open_txns.pop(t.pid, None)
+            if not committed and first is not None:
+                part.aborted.append({"producer_id": t.pid,
+                                     "first_offset": first,
+                                     "last_offset": base})
+        if committed:
+            for gid, offs in t.pending_offsets.items():
+                self._group(gid).offsets.update(offs)
+        t.partitions = {}
+        t.pending_offsets = {}
+        t.groups = set()
+        t.state = "CompleteCommit" if committed else "CompleteAbort"
+
+    @staticmethod
+    def _control_batch(pid: int, epoch: int, committed: bool) -> bytes:
+        """A v2 control RecordBatch exactly as a broker writes it: one
+        record, key = [version i16, type i16], value = [version i16,
+        coordinator_epoch i32], transactional+control attr bits set."""
+        from ..protocol.msgset import MsgsetWriterV2, Record
+        now_ms = int(time.time() * 1000)
+        w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
+                           base_sequence=-1, transactional=True,
+                           control=True)
+        key = struct.pack(">hh", 0, proto.CTRL_COMMIT if committed
+                          else proto.CTRL_ABORT)
+        rec = Record(key=key, value=struct.pack(">hi", 0, 0),
+                     timestamp=now_ms)
+        return w.write_batch([rec], now_ms)
 
     # --------------------------------------------------------------- admin --
     def _h_CreateTopics(self, conn, corrid, hdr, body, inject):

@@ -886,17 +886,20 @@ static inline void be64(uint8_t *p, uint64_t v) {
 }
 
 // build_batch(base, klens, vlens, count, now_ms, pid, epoch, base_seq,
-//             codec_id) -> bytes
+//             codec_id[, attr_flags]) -> bytes
 // codec_id: 0 none, 2 snappy, 3 lz4 (the wire attribute values).
+// attr_flags: extra v2 attribute bits OR'd into the attribute word
+// (the transactional bit 0x10 for EOS batches; codec bits still come
+// from the compression outcome).
 // All records carry timestamp now_ms (fast-lane contract: timestamp=0 =
 // batch build time), so first=max=now_ms and every delta is 0 — exactly
 // what MsgsetWriterV2.build_arena emits.
 static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
                                  PyObject *const *args, Py_ssize_t nargs) {
-    if (nargs != 9) {
+    if (nargs != 9 && nargs != 10) {
         PyErr_SetString(PyExc_TypeError,
                         "build_batch(base, klens, vlens, count, now_ms, "
-                        "pid, epoch, base_seq, codec_id)");
+                        "pid, epoch, base_seq, codec_id[, attr_flags])");
         return NULL;
     }
     Py_buffer base, kb, vb;
@@ -913,6 +916,7 @@ static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
     int64_t epoch = PyLong_AsLongLong(args[6]);
     int64_t base_seq = PyLong_AsLongLong(args[7]);
     int64_t codec = PyLong_AsLongLong(args[8]);
+    int64_t attr_flags = nargs == 10 ? PyLong_AsLongLong(args[9]) : 0;
     PyObject *out = NULL;
     if (PyErr_Occurred()) goto done;
     if (count <= 0 || (int64_t)kb.len < count * 4
@@ -981,7 +985,7 @@ static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
             be32(o + 12, 0);
             o[16] = 2;                                // Magic
             be32(o + V2_OF_CRC, 0);                   // CRC placeholder
-            be16(o + V2_OF_ATTR, (uint16_t)attr_codec);
+            be16(o + V2_OF_ATTR, (uint16_t)(attr_codec | attr_flags));
             be32(o + 23, (uint32_t)(count - 1));      // LastOffsetDelta
             be64(o + 27, (uint64_t)now_ms);           // FirstTimestamp
             be64(o + 35, (uint64_t)now_ms);           // MaxTimestamp
@@ -1863,7 +1867,7 @@ static PyMethodDef module_methods[] = {
     {"build_batch", (PyCFunction)(void (*)(void))mod_build_batch,
      METH_FASTCALL,
      "build_batch(base, klens, vlens, count, now_ms, pid, epoch, "
-     "base_seq, codec_id) -> wire RecordBatch bytes"},
+     "base_seq, codec_id[, attr_flags]) -> wire RecordBatch bytes"},
     {"materialize_arena",
      (PyCFunction)(void (*)(void))mod_materialize_arena, METH_FASTCALL,
      "materialize_arena(...) -> list[Message] (arena layout)"},

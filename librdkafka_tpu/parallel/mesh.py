@@ -16,6 +16,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# version-compat shard_map: newer jax exposes it top-level (with the
+# check_vma kwarg); older releases only ship
+# jax.experimental.shard_map.shard_map (check_rep kwarg). Resolve once
+# so sharded_codec_step works on both.
+if hasattr(jax, "shard_map"):
+    def _shard_map(f, *, mesh, in_specs, out_specs):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 from ..ops.crc32c_jax import _crc_kernel, _pick_kl, _shift_tables
 from ..ops.lz4_jax import _lz4_block_one
 
@@ -67,11 +82,10 @@ def sharded_codec_step(mesh: Mesh, N: int, with_crc: bool = True):
 
     out_specs = ((P("batch", None), P("batch"), P("batch"), P())
                  if with_crc else (P("batch", None), P("batch")))
-    shard = jax.shard_map(
+    shard = _shard_map(
         local, mesh=mesh,
         in_specs=(P("batch", None), P("batch"), P("batch")),
-        out_specs=out_specs,
-        check_vma=False)
+        out_specs=out_specs)
     fn = jax.jit(shard)
     _STEP_CACHE[key] = fn
     return fn

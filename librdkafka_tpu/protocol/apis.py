@@ -314,6 +314,52 @@ INITPRODUCERID_V1_RESP = Schema(
     ("throttle_time_ms", Int32), ("error_code", Int16),
     ("producer_id", Int64), ("producer_epoch", Int16))
 
+# ----------------------------------------------------- AddPartitionsToTxn --
+# (KIP-98 transactional producer; reference: the rd_kafka_txn_* request
+# builders land in librdkafka 1.4 — this client implements the same
+# v0 wire schemas the 2.x brokers of its era negotiate)
+ADDPARTITIONSTOTXN_V0_REQ = Schema(
+    ("transactional_id", String), ("producer_id", Int64),
+    ("producer_epoch", Int16),
+    ("topics", Array(Schema(
+        ("topic", String), ("partitions", Array(Int32))))))
+ADDPARTITIONSTOTXN_V0_RESP = Schema(
+    ("throttle_time_ms", Int32),
+    ("results", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16))))))))
+
+# ------------------------------------------------------- AddOffsetsToTxn --
+ADDOFFSETSTOTXN_V0_REQ = Schema(
+    ("transactional_id", String), ("producer_id", Int64),
+    ("producer_epoch", Int16), ("group_id", String))
+ADDOFFSETSTOTXN_V0_RESP = Schema(
+    ("throttle_time_ms", Int32), ("error_code", Int16))
+
+# ---------------------------------------------------------------- EndTxn --
+ENDTXN_V1_REQ = Schema(
+    ("transactional_id", String), ("producer_id", Int64),
+    ("producer_epoch", Int16), ("committed", Boolean))
+ENDTXN_V1_RESP = Schema(
+    ("throttle_time_ms", Int32), ("error_code", Int16))
+
+# ------------------------------------------------------- TxnOffsetCommit --
+TXNOFFSETCOMMIT_V0_REQ = Schema(
+    ("transactional_id", String), ("group_id", String),
+    ("producer_id", Int64), ("producer_epoch", Int16),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("offset", Int64),
+            ("metadata", NullableString))))))))
+TXNOFFSETCOMMIT_V0_RESP = Schema(
+    ("throttle_time_ms", Int32),
+    ("topics", Array(Schema(
+        ("topic", String),
+        ("partitions", Array(Schema(
+            ("partition", Int32), ("error_code", Int16))))))))
+
 # ----------------------------------------------------------- CreateTopics --
 CREATETOPICS_V2_REQ = Schema(
     ("topics", Array(Schema(
@@ -423,6 +469,13 @@ APIS: dict[ApiKey, tuple[int, Schema, Schema]] = {
     ApiKey.SaslHandshake: (1, SASLHANDSHAKE_V1_REQ, SASLHANDSHAKE_V1_RESP),
     ApiKey.SaslAuthenticate: (0, SASLAUTHENTICATE_V0_REQ, SASLAUTHENTICATE_V0_RESP),
     ApiKey.InitProducerId: (1, INITPRODUCERID_V1_REQ, INITPRODUCERID_V1_RESP),
+    ApiKey.AddPartitionsToTxn: (0, ADDPARTITIONSTOTXN_V0_REQ,
+                                ADDPARTITIONSTOTXN_V0_RESP),
+    ApiKey.AddOffsetsToTxn: (0, ADDOFFSETSTOTXN_V0_REQ,
+                             ADDOFFSETSTOTXN_V0_RESP),
+    ApiKey.EndTxn: (1, ENDTXN_V1_REQ, ENDTXN_V1_RESP),
+    ApiKey.TxnOffsetCommit: (0, TXNOFFSETCOMMIT_V0_REQ,
+                             TXNOFFSETCOMMIT_V0_RESP),
     ApiKey.CreateTopics: (2, CREATETOPICS_V2_REQ, CREATETOPICS_V2_RESP),
     ApiKey.DeleteTopics: (1, DELETETOPICS_V1_REQ, DELETETOPICS_V1_RESP),
     ApiKey.CreatePartitions: (1, CREATEPARTITIONS_V1_REQ, CREATEPARTITIONS_V1_RESP),

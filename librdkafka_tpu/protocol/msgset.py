@@ -79,13 +79,17 @@ class MsgsetWriterV2:
 
     def __init__(self, *, base_offset: int = 0, producer_id: int = -1,
                  producer_epoch: int = -1, base_sequence: int = -1,
-                 transactional: bool = False, codec: Optional[str] = None,
+                 transactional: bool = False, control: bool = False,
+                 codec: Optional[str] = None,
                  timestamp_type: int = proto.TSTYPE_CREATE_TIME):
         self.base_offset = base_offset
         self.producer_id = producer_id
         self.producer_epoch = producer_epoch
         self.base_sequence = base_sequence
         self.transactional = transactional
+        # control batches (COMMIT/ABORT transaction markers) are broker-
+        # written; the mock cluster's txn coordinator builds them here
+        self.control = control
         self.codec = None if codec in (None, "none") else codec
         self.timestamp_type = timestamp_type
         self.records_bytes: bytes = b""
@@ -242,6 +246,8 @@ class MsgsetWriterV2:
             attrs |= proto.ATTR_TIMESTAMP_TYPE
         if self.transactional:
             attrs |= ATTR_TRANSACTIONAL
+        if self.control:
+            attrs |= ATTR_CONTROL
         payload = compressed if compressed is not None else self.records_bytes
         wire = bytearray(self._HDR.pack(
             self.base_offset,

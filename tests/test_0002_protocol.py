@@ -118,6 +118,28 @@ SAMPLES = {
                              "transaction_timeout_ms": 60000},
                             {"throttle_time_ms": 0, "error_code": 0,
                              "producer_id": 7, "producer_epoch": 0}),
+    ApiKey.AddPartitionsToTxn: ({"transactional_id": "tx1",
+                                 "producer_id": 7, "producer_epoch": 0,
+                                 "topics": [{"topic": "t",
+                                             "partitions": [0, 2]}]},
+                                {"throttle_time_ms": 0,
+                                 "results": [{"topic": "t", "partitions": [
+                                     {"partition": 0, "error_code": 0},
+                                     {"partition": 2, "error_code": 0}]}]}),
+    ApiKey.AddOffsetsToTxn: ({"transactional_id": "tx1", "producer_id": 7,
+                              "producer_epoch": 0, "group_id": "g"},
+                             {"throttle_time_ms": 0, "error_code": 0}),
+    ApiKey.EndTxn: ({"transactional_id": "tx1", "producer_id": 7,
+                     "producer_epoch": 0, "committed": True},
+                    {"throttle_time_ms": 0, "error_code": 0}),
+    ApiKey.TxnOffsetCommit: ({"transactional_id": "tx1", "group_id": "g",
+                              "producer_id": 7, "producer_epoch": 0,
+                              "topics": [{"topic": "t", "partitions": [
+                                  {"partition": 0, "offset": 5,
+                                   "metadata": None}]}]},
+                             {"throttle_time_ms": 0,
+                              "topics": [{"topic": "t", "partitions": [
+                                  {"partition": 0, "error_code": 0}]}]}),
     ApiKey.CreateTopics: ({"topics": [{"topic": "nt", "num_partitions": 3,
                                        "replication_factor": 1,
                                        "replica_assignment": [],

@@ -149,6 +149,46 @@ def test_stats_schema_fields():
         assert field in tp, field
 
 
+def test_stats_blob_eos_txn_state():
+    """ISSUE 4: a transactional producer's stats JSON eos blob carries
+    the txn FSM snapshot — state, transactional.id, pid/epoch (shared
+    with the idempotence layer), registered-partition count, and the
+    resolved coordinator."""
+    import json as _json
+    import time as _time
+
+    from librdkafka_tpu import Producer
+
+    blobs = []
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "transactional.id": "tx-stats", "linger.ms": 2,
+                  "statistics.interval.ms": 100,
+                  "stats_cb": lambda js: blobs.append(_json.loads(js))})
+    try:
+        p.init_transactions(30)
+        p.begin_transaction()
+        p.produce("tx-st", value=b"in-txn", partition=0)
+        p.commit_transaction(30)
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            p.poll(0.1)
+            if any("eos" in b for b in blobs):
+                break
+    finally:
+        p.close()
+    with_eos = [b for b in blobs if "eos" in b]
+    assert with_eos, "no stats blob carried eos"
+    eos = with_eos[-1]["eos"]
+    for field in ("idemp_state", "producer_id", "producer_epoch",
+                  "txn_state", "transactional_id",
+                  "txn_registered_partitions", "txn_coordinator"):
+        assert field in eos, field
+    assert eos["transactional_id"] == "tx-stats"
+    assert eos["txn_state"] in ("READY", "IN_TXN", "COMMITTING")
+    assert eos["producer_id"] >= 0 and eos["producer_epoch"] >= 0
+    assert eos["txn_coordinator"] >= 0
+
+
 def test_stats_blob_codec_engine_governor_counters():
     """ISSUE 3: with the tpu backend's async engine live, the stats
     JSON carries a codec_engine section — launch/merge/fallback/warmup

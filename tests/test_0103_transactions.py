@@ -1,0 +1,335 @@
+"""Transactional producer (EOS) tests (reference: 0103-transactions.c,
+which librdkafka grows in 1.4 — this tree builds the subsystem the
+v1.3.0 reference stops short of): the txn FSM end-to-end through the
+real Producer API against the mock cluster's transaction-coordinator
+role. Produced-and-aborted transactions must be invisible to
+read_committed consumers and fully visible (control records suppressed)
+to read_uncommitted ones; committed transactions deliver exactly their
+records; a second producer instance with the same transactional.id
+bumps the epoch and fences the first (PRODUCER_FENCED, fatal);
+send_offsets_to_transaction lands group offsets atomically with the
+commit and discards them on abort."""
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.consumer import TopicPartition
+from librdkafka_tpu.client.errors import Err, KafkaException
+from librdkafka_tpu.mock.cluster import MockCluster
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=3, topics={"txn": 2, "src": 1})
+    yield c
+    c.stop()
+
+
+def _txn_producer(cluster, tid, **extra):
+    conf = {"bootstrap.servers": cluster.bootstrap_servers(),
+            "transactional.id": tid, "linger.ms": 2}
+    conf.update(extra)
+    return Producer(conf)
+
+
+def _consume_all(cluster, isolation, topic="txn", idle_limit=8):
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": f"g-{isolation}-{time.monotonic_ns()}",
+                  "auto.offset.reset": "earliest",
+                  "isolation.level": isolation})
+    c.subscribe([topic])
+    got = []
+    deadline = time.monotonic() + 20
+    idle = 0
+    while time.monotonic() < deadline and idle < idle_limit:
+        m = c.poll(0.25)
+        if m is not None and m.error is None:
+            got.append(m.value)
+            idle = 0
+        else:
+            idle += 1
+    c.close()
+    return got
+
+
+def test_commit_delivers_exactly_committed_records(cluster):
+    p = _txn_producer(cluster, "tx-commit")
+    p.init_transactions(30)
+    p.begin_transaction()
+    p.produce("txn", b"c-0", partition=0)
+    p.produce("txn", b"c-1", partition=0)
+    p.commit_transaction(30)
+    p.close()
+    assert _consume_all(cluster, "read_committed") == [b"c-0", b"c-1"]
+    # control records are never delivered under either isolation level
+    assert _consume_all(cluster, "read_uncommitted") == [b"c-0", b"c-1"]
+
+
+def test_abort_invisible_to_read_committed(cluster):
+    """The acceptance-criteria path: produce-in-txn -> flush -> abort.
+    read_committed sees nothing; read_uncommitted sees the data (and
+    has the ABORT control record suppressed)."""
+    p = _txn_producer(cluster, "tx-abort")
+    p.init_transactions(30)
+    p.begin_transaction()
+    for i in range(3):
+        p.produce("txn", b"a-%d" % i, partition=0)
+    assert p.flush(15) == 0      # data reaches the log BEFORE the abort
+    p.abort_transaction(30)
+
+    # a follow-up committed txn from the same producer: the epoch bump
+    # restarted sequencing and the aborted range must not shadow it
+    p.begin_transaction()
+    p.produce("txn", b"after", partition=0)
+    p.commit_transaction(30)
+    p.close()
+
+    assert _consume_all(cluster, "read_committed") == [b"after"]
+    assert _consume_all(cluster, "read_uncommitted") == \
+        [b"a-0", b"a-1", b"a-2", b"after"]
+
+
+def test_open_txn_invisible_until_commit(cluster):
+    """LSO semantics: data of a still-open transaction must not reach a
+    read_committed consumer even before any marker exists."""
+    p = _txn_producer(cluster, "tx-open")
+    p.init_transactions(30)
+    p.begin_transaction()
+    p.produce("txn", b"open-0", partition=0)
+    assert p.flush(15) == 0
+    assert _consume_all(cluster, "read_committed", idle_limit=6) == []
+    p.commit_transaction(30)
+    p.close()
+    assert _consume_all(cluster, "read_committed") == [b"open-0"]
+
+
+def test_zombie_fencing(cluster):
+    """Second producer with the same transactional.id bumps the epoch;
+    the first becomes a zombie and fails fatally with PRODUCER_FENCED."""
+    p1 = _txn_producer(cluster, "tx-zombie")
+    p1.init_transactions(30)
+    e1 = p1.rk.txnmgr.epoch
+    p2 = _txn_producer(cluster, "tx-zombie")
+    p2.init_transactions(30)
+    assert p2.rk.txnmgr.pid == p1.rk.txnmgr.pid
+    assert p2.rk.txnmgr.epoch == e1 + 1
+
+    p1.begin_transaction()
+    p1.produce("txn", b"zombie", partition=0)
+    with pytest.raises(KafkaException) as ei:
+        p1.commit_transaction(15)
+    assert p1.rk.fatal_error is not None
+    assert p1.rk.fatal_error.code == Err.PRODUCER_FENCED
+    assert ei.value.error.fatal or ei.value.error.code == Err.PRODUCER_FENCED
+    # a fenced producer rejects further produce with the fatal error
+    with pytest.raises(KafkaException):
+        p1.produce("txn", b"more", partition=0)
+    p1.close(2)
+
+    # the new instance is unaffected
+    p2.begin_transaction()
+    p2.produce("txn", b"fresh", partition=0)
+    p2.commit_transaction(30)
+    p2.close()
+    assert _consume_all(cluster, "read_committed") == [b"fresh"]
+
+
+def test_send_offsets_to_transaction(cluster):
+    """AddOffsetsToTxn + TxnOffsetCommit: offsets land in the group
+    atomically with the commit, and abort discards staged ones."""
+    p = _txn_producer(cluster, "tx-offsets")
+    p.init_transactions(30)
+    p.begin_transaction()
+    p.produce("txn", b"v", partition=0)
+    p.send_offsets_to_transaction(
+        [TopicPartition("src", 0, 42, metadata="m1")], "grp-eos", 30)
+    # staged only: not visible in the group before EndTxn(commit)
+    g = cluster.groups.get("grp-eos")
+    assert g is None or g.offsets.get(("src", 0)) is None
+    p.commit_transaction(30)
+    assert cluster.groups["grp-eos"].offsets[("src", 0)] == (42, "m1")
+
+    p.begin_transaction()
+    p.produce("txn", b"v2", partition=0)
+    p.send_offsets_to_transaction(
+        [TopicPartition("src", 0, 99)], "grp-eos", 30)
+    p.abort_transaction(30)
+    assert cluster.groups["grp-eos"].offsets[("src", 0)] == (42, "m1")
+    p.close()
+
+
+def test_consumer_group_metadata_object(cluster):
+    """send_offsets accepts the consumer_group_metadata() handle."""
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "grp-md",
+                  "auto.offset.reset": "earliest"})
+    c.subscribe(["src"])
+    md = c.consumer_group_metadata()
+    assert md.group_id == "grp-md"
+    p = _txn_producer(cluster, "tx-md")
+    p.init_transactions(30)
+    p.begin_transaction()
+    p.produce("txn", b"v", partition=0)
+    p.send_offsets_to_transaction([TopicPartition("src", 0, 7)], md, 30)
+    p.commit_transaction(30)
+    p.close()
+    c.close()
+    assert cluster.groups["grp-md"].offsets[("src", 0)][0] == 7
+
+
+def test_state_machine_guards(cluster):
+    p = _txn_producer(cluster, "tx-fsm")
+    # begin before init
+    with pytest.raises(KafkaException) as ei:
+        p.begin_transaction()
+    assert ei.value.error.code == Err._STATE
+    p.init_transactions(30)
+    # produce outside a transaction
+    with pytest.raises(KafkaException) as ei:
+        p.produce("txn", b"x", partition=0)
+    assert ei.value.error.code == Err._STATE
+    # commit without begin
+    with pytest.raises(KafkaException) as ei:
+        p.commit_transaction(5)
+    assert ei.value.error.code == Err._STATE
+    # double begin
+    p.begin_transaction()
+    with pytest.raises(KafkaException):
+        p.begin_transaction()
+    # empty transaction commits without touching the coordinator log
+    p.commit_transaction(30)
+    assert cluster.partition("txn", 0).log == []
+    p.close()
+
+
+def test_txn_api_requires_transactional_id(cluster):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers()})
+    with pytest.raises(KafkaException) as ei:
+        p.init_transactions(1)
+    assert ei.value.error.code == Err._NOT_IMPLEMENTED
+    p.close()
+
+
+def test_conf_validated_at_set_time():
+    from librdkafka_tpu.client.conf import Conf
+    c = Conf()
+    c.set("transactional.id", "ok-id")          # valid
+    with pytest.raises(KafkaException):
+        c.set("transactional.id", "x" * 250)    # over the broker bound
+    with pytest.raises(KafkaException):
+        c.set("transactional.id", "bad\x00id")  # control character
+    with pytest.raises(KafkaException):
+        c.set("transaction.timeout.ms", 10)     # below vmin
+    c.set("transaction.timeout.ms", 60000)
+    # implied idempotence: the pid/epoch machinery exists without
+    # enable.idempotence being set explicitly
+    cluster = MockCluster(num_brokers=1, topics={"txn": 1})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "transactional.id": "tx-implied"})
+        assert p.rk.idemp is not None
+        assert p.rk.txnmgr is not None
+        p.close()
+    finally:
+        cluster.stop()
+
+
+def test_broker_rejects_oversize_txn_timeout(cluster):
+    """transaction.timeout.ms above the broker's transaction.max.
+    timeout.ms fails init_transactions fatally
+    (INVALID_TRANSACTION_TIMEOUT)."""
+    p = _txn_producer(cluster, "tx-tmo",
+                      **{"transaction.timeout.ms": 1000000})
+    with pytest.raises(KafkaException) as ei:
+        p.init_transactions(15)
+    assert ei.value.error.code == Err.INVALID_TRANSACTION_TIMEOUT
+    p.close(2)
+
+
+def test_failed_message_makes_txn_abortable(cluster):
+    """A message failing inside the txn (injected non-retriable produce
+    error) parks the FSM in ABORTABLE_ERROR: commit refuses, abort
+    recovers, and the next transaction works."""
+    p = _txn_producer(cluster, "tx-abortable",
+                      **{"message.send.max.retries": 0})
+    p.init_transactions(30)
+    p.begin_transaction()
+    cluster.push_request_errors(
+        __import__("librdkafka_tpu.protocol.proto",
+                   fromlist=["ApiKey"]).ApiKey.Produce,
+        [Err.INVALID_MSG])
+    p.produce("txn", b"doomed", partition=0)
+    assert p.flush(15) == 0
+    with pytest.raises(KafkaException) as ei:
+        p.commit_transaction(15)
+    assert ei.value.error.code == Err._STATE
+    assert p.rk.txnmgr.state == "ABORTABLE_ERROR"
+    p.abort_transaction(30)
+    assert p.rk.txnmgr.state == "READY"
+    p.begin_transaction()
+    p.produce("txn", b"recovered", partition=0)
+    p.commit_transaction(30)
+    p.close()
+    assert _consume_all(cluster, "read_committed") == [b"recovered"]
+
+
+def test_unflushed_abort_purges_queued_messages(cluster):
+    """abort without flush: queued messages are purged (never reach the
+    log) and their DRs carry _PURGE_QUEUE."""
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "transactional.id": "tx-purge", "linger.ms": 5000,
+                  "dr_msg_cb": lambda e, m: drs.append(e)})
+    p.init_transactions(30)
+    p.begin_transaction()
+    p.produce("txn", b"never-sent", partition=0)
+    p.abort_transaction(30)
+    p.poll(1.0)
+    assert drs and drs[0] is not None and drs[0].code == Err._PURGE_QUEUE
+    assert cluster.partition("txn", 0).log == []   # no data, no marker
+    p.close()
+
+
+def test_interrupted_producer_txn_aborted_on_reinit(cluster):
+    """A producer dying mid-transaction: the next init_transactions of
+    the same id makes the coordinator abort the dangling txn, so its
+    records never surface under read_committed."""
+    p1 = _txn_producer(cluster, "tx-crash")
+    p1.init_transactions(30)
+    p1.begin_transaction()
+    p1.produce("txn", b"dangling", partition=0)
+    assert p1.flush(15) == 0
+    # p1 "crashes" (no abort); a new instance takes over the id
+    p2 = _txn_producer(cluster, "tx-crash")
+    p2.init_transactions(30)
+    p2.begin_transaction()
+    p2.produce("txn", b"takeover", partition=0)
+    p2.commit_transaction(30)
+    p2.close()
+    p1.close(2)
+    assert _consume_all(cluster, "read_committed") == [b"takeover"]
+
+
+def test_stats_blob_carries_txn_state(cluster):
+    import json
+    blobs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "transactional.id": "tx-stats", "linger.ms": 2,
+                  "statistics.interval.ms": 100,
+                  "stats_cb": lambda js: blobs.append(json.loads(js))})
+    p.init_transactions(30)
+    p.begin_transaction()
+    p.produce("txn", b"s", partition=0)
+    p.commit_transaction(30)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not blobs:
+        p.poll(0.1)
+    p.close()
+    assert blobs
+    eos = blobs[-1]["eos"]
+    assert eos["txn_state"] in ("READY", "IN_TXN", "COMMITTING")
+    assert eos["transactional_id"] == "tx-stats"
+    assert eos["producer_id"] >= 0 and eos["producer_epoch"] >= 0
+    assert "txn_registered_partitions" in eos
+    assert "txn_coordinator" in eos
