@@ -36,6 +36,28 @@ import time
 import numpy as np
 
 
+def _json_path():
+    """--json <path>: also write the leg's JSON summary to a file, so
+    the BENCH_r*.json trajectory is a machine-written artifact instead
+    of hand-assembled terminal scrapes."""
+    if "--json" in sys.argv:
+        i = sys.argv.index("--json")
+        if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+            raise SystemExit("--json requires a file path")
+        return sys.argv[i + 1]
+    return None
+
+
+def _emit(obj: dict) -> None:
+    """Print the leg summary AND write it to the --json artifact."""
+    line = json.dumps(obj)
+    print(line)
+    path = _json_path()
+    if path:
+        with open(path, "w") as f:
+            f.write(line + "\n")
+
+
 def _gcd(a: int, b: int) -> int:
     while b:
         a, b = b, a % b
@@ -596,6 +618,10 @@ def pipeline_bench() -> dict:
             "pipelined_s": round(pipe_s, 4),
             "overlap_speedup": round(sync_s / max(pipe_s, 1e-9), 2),
             "engine_stats": dict(pipe_prov._engine.stats),
+            # per-stage percentiles (ISSUE 5): submit->launch wait,
+            # launch->readback, reap — the decomposition the stats
+            # JSON emits as codec_engine.stage_latency
+            "stage_latency": pipe_prov._engine.stage_latency_snapshot(),
         }
         pipe_prov.close()
     except Exception as e:
@@ -791,6 +817,10 @@ def fetch_pipeline_bench() -> dict:
             "pipelined_s": round(pipe_s, 4),
             "overlap_speedup": round(sync_s / max(pipe_s, 1e-9), 2),
             "engine_stats": dict(pipe_prov._engine.stats),
+            # per-stage percentiles (ISSUE 5): submit->launch wait,
+            # launch->readback, reap — the decomposition the stats
+            # JSON emits as codec_engine.stage_latency
+            "stage_latency": pipe_prov._engine.stage_latency_snapshot(),
         }
         pipe_prov.close()
     except Exception as e:
@@ -1103,41 +1133,164 @@ def smoke_bench() -> dict:
     finally:
         tp_.close()
 
+    # traced e2e leg (ISSUE 5): a produce+consume round trip with
+    # trace.enable=true must decompose into the pipeline stages in a
+    # dump that scripts/traceview.py can summarize
+    import tempfile
+
+    from librdkafka_tpu import Consumer
+    from librdkafka_tpu.obs import trace as _tr
+
+    tp2 = Producer({"bootstrap.servers": "",
+                    "test.mock.num.brokers": 1, "trace.enable": True,
+                    "compression.backend": "tpu",
+                    "tpu.transport.min.mb.s": 0,
+                    "tpu.launch.min.batches": 2, "tpu.governor": False,
+                    "tpu.warmup": False, "compression.codec": "lz4",
+                    "linger.ms": 10})
+    tc2 = None
+    trace_path = os.path.join(tempfile.gettempdir(),
+                              f"tk_smoke_trace_{os.getpid()}.json")
+    try:
+        bs2 = tp2._rk.mock_cluster.bootstrap_servers()
+        tp2.produce("smoke-trace", value=b"solo", partition=0)
+        assert tp2.flush(120.0) == 0
+        for i in range(200):
+            tp2.produce("smoke-trace", value=b"v%d" % i * 20,
+                        partition=i % 4)
+        assert tp2.flush(120.0) == 0
+        tc2 = Consumer({"bootstrap.servers": bs2, "group.id": "smoke-tr",
+                        "auto.offset.reset": "earliest",
+                        "check.crcs": True, "trace.enable": True})
+        tc2.subscribe(["smoke-trace"])
+        got = 0
+        deadline = time.monotonic() + 60
+        while got < 201 and time.monotonic() < deadline:
+            m = tc2.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 201, f"traced consume incomplete: {got}/201"
+        n_events = tp2.trace_dump(trace_path)
+        summary = _traceview().summarize(
+            _traceview().load_events(trace_path))
+        stages = {s["name"] for s in summary["stages"]}
+        need = {"compress", "crc_ticket", "fanin_wait", "device_launch",
+                "readback", "crc_verify", "decompress", "deliver",
+                "produce_tx", "ack", "batch_assembly"}
+        missing = need - stages
+        assert not missing, f"traced leg missing stages: {missing}"
+        legs["trace"] = (f"{n_events} events, "
+                         f"{len(stages)} stages, all expected present")
+    finally:
+        tp2.close()
+        if tc2 is not None:
+            tc2.close()
+        try:
+            os.unlink(trace_path)
+        except OSError:
+            pass
+
     return {"elapsed_s": round(time.perf_counter() - t_start, 1),
-            "legs": legs}
+            "legs": legs,
+            "trace_overhead": _trace_overhead_gate()}
+
+
+def _traceview():
+    """scripts/traceview.py as a module (scripts/ is not a package)."""
+    import importlib.util
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "scripts", "traceview.py")
+    spec = importlib.util.spec_from_file_location("tk_traceview", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trace_overhead_gate() -> dict:
+    """Disabled-tracing overhead gate (ISSUE 5 satellite): the ONLY
+    cost a hooks-absent build removes is the per-site ``if
+    trace.enabled:`` attribute check, so the gate measures that guard
+    directly and scales it by a conservative hook count per message,
+    against the measured per-message cost of a real produce leg.
+    trace-disabled must be within 2% of hooks-absent."""
+    import timeit
+
+    from librdkafka_tpu import Producer
+    from librdkafka_tpu.obs import trace as _tr
+
+    assert not _tr.enabled
+    n = 1_000_000
+    # the guard alone: timeit of the attribute load minus the empty
+    # loop (the loop machinery is shared by both builds, so only the
+    # delta is a cost a hooks-absent build would shed)
+    loaded = timeit.timeit("t.enabled", globals={"t": _tr}, number=n)
+    empty = timeit.timeit("pass", number=n)
+    guard_ns = max(0.0, (loaded - empty) / n * 1e9)
+    # per-message budget: a quick produce leg over the in-process mock
+    # (GIL-shared, so this UNDERSTATES the budget — conservative)
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "linger.ms": 5, "compression.codec": "lz4",
+                  "queue.buffering.max.messages": 500_000})
+    try:
+        val = b"x" * 100
+        for i in range(2000):           # warm sockets + codecs
+            p.produce("ovh", value=val, partition=i % 4)
+        assert p.flush(60.0) == 0
+        n_msgs = 30_000
+        t0 = time.perf_counter()
+        for i in range(n_msgs):
+            p.produce("ovh", value=val, partition=i % 4)
+        assert p.flush(60.0) == 0
+        msg_ns = (time.perf_counter() - t0) / n_msgs * 1e9
+    finally:
+        p.close()
+    # the per-MESSAGE hook count is exactly 1 (the produce-enqueue
+    # site; fast-lane records run zero Python hooks); the ~10
+    # per-BATCH span sites (assembly, compress, crc, tx, ack, engine
+    # fanin/launch/readback) amortize below 0.1/message at this leg's
+    # batch sizes (hundreds of messages per linger window) — bound the
+    # amortized share at 0.25, a >2x margin
+    hooks_per_msg = 1.25
+    overhead_pct = guard_ns * hooks_per_msg / msg_ns * 100.0
+    return {"guard_ns": round(guard_ns, 2),
+            "produce_ns_per_msg": round(msg_ns, 1),
+            "hooks_per_msg_bound": hooks_per_msg,
+            "overhead_pct": round(overhead_pct, 4),
+            "acceptance_pct_lt": 2.0,
+            "pass": bool(overhead_pct < 2.0)}
 
 
 def main():
     if "--governor" in sys.argv:
-        print(json.dumps({"metric": "adaptive offload governor: warmup "
+        _emit({"metric": "adaptive offload governor: warmup "
                                     "cold-start, adaptive fan-in, fused "
                                     "multi-poly launches (bench.py "
                                     "--governor)",
-                          **governor_bench()}))
+                          **governor_bench()})
         return
     if "--txn" in sys.argv:
-        print(json.dumps({"metric": "transactional vs plain idempotent "
+        _emit({"metric": "transactional vs plain idempotent "
                                     "produce throughput (bench.py "
                                     "--txn)",
-                          **txn_bench()}))
+                          **txn_bench()})
         return
     if "--smoke" in sys.argv:
-        print(json.dumps({"metric": "pre-commit smoke: bit-exactness "
+        _emit({"metric": "pre-commit smoke: bit-exactness "
                                     "over every engine leg (bench.py "
                                     "--smoke)",
-                          **smoke_bench()}))
+                          **smoke_bench()})
         return
     if "--fetch-pipeline" in sys.argv:
-        print(json.dumps({"metric": "pipelined vs synchronous consumer "
+        _emit({"metric": "pipelined vs synchronous consumer "
                                     "fetch codec phases (bench.py "
                                     "--fetch-pipeline)",
-                          **fetch_pipeline_bench()}))
+                          **fetch_pipeline_bench()})
         return
     if "--pipeline" in sys.argv:
-        print(json.dumps({"metric": "pipelined vs synchronous codec "
+        _emit({"metric": "pipelined vs synchronous codec "
                                     "offload dispatch (bench.py "
                                     "--pipeline)",
-                          **pipeline_bench()}))
+                          **pipeline_bench()})
         return
     # ~1s of steady state per trial: short runs understate the rate by
     # folding the constant linger+flush tail into it (measured 119k
@@ -1244,7 +1397,7 @@ def main():
         finally:
             _reset_mock()
     off = codec_offload()
-    print(json.dumps({
+    _emit({
         "metric": "batched CRC32C codec offload, 128x64KB partition "
                   "batches (64 toppars x 2 blocks): TPU plane-split MXU "
                   "kernel device rate, bit-exact vs the native CPU "
@@ -1272,7 +1425,7 @@ def main():
             round(dr_batch_rate, 1) if dr_batch_rate is not None else None,
         "codec_size_sweep": sweep,
         "detail": off,
-    }))
+    })
 
 
 if __name__ == "__main__":

@@ -29,6 +29,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional, TYPE_CHECKING
 
+from ..obs import trace as _trace
 from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..utils import sockbuf
@@ -120,7 +121,7 @@ class _PendingCodec:
     worker's poll loop pipelines both stages without blocking."""
 
     __slots__ = ("rk", "by_idx", "n", "writer_items", "assembled",
-                 "ticket", "comp_tickets")
+                 "ticket", "comp_tickets", "t_compress_ns", "t_crc_ns")
 
     def __init__(self, rk, by_idx: dict, n: int, writer_items: list):
         self.rk = rk
@@ -130,6 +131,8 @@ class _PendingCodec:
         self.comp_tickets = None            # [(idxs, ticket)] stage 1
         self.assembled = []                 # [(idx, (tp, msgs, writer))]
         self.ticket = None                  # CRC ticket, stage 2
+        self.t_compress_ns = 0              # compress submit (trace)
+        self.t_crc_ns = 0                   # CRC submit (trace)
 
     def done(self) -> bool:
         if self.comp_tickets is not None:
@@ -151,6 +154,13 @@ class _PendingCodec:
             for i, (tp, msgs, _w) in self.writer_items:
                 self.by_idx[i] = (tp, msgs, None, e)
             return
+        if self.t_compress_ns:
+            # compress-ticket span: submit -> all groups resolved
+            _trace.complete("produce", "compress", self.t_compress_ns,
+                            {"groups": len(tickets),
+                             "batches": len(self.writer_items)})
+        if _trace.enabled:
+            self.t_crc_ns = _trace.now()
         self.assembled, self.ticket = _assemble_and_submit_crc(
             self.rk, self.writer_items, self.by_idx, blobs)
 
@@ -167,6 +177,11 @@ class _PendingCodec:
                 for (i, (tp, msgs, w)), crc in zip(self.assembled, crcs):
                     self.by_idx[i] = (tp, msgs, w.patch_crc(int(crc)),
                                       None)
+            if self.t_crc_ns:
+                # CRC-ticket span: submit -> checksums patched (covers
+                # the engine's fan-in wait + launch + readback)
+                _trace.complete("produce", "crc_ticket", self.t_crc_ns,
+                                {"batches": len(self.assembled)})
         return [self.by_idx[i] for i in range(self.n)]
 
 
@@ -197,9 +212,16 @@ def _begin_codec_phase(rk, ready: list):
             try:
                 if build is None:       # extension vanished mid-flight
                     raise RuntimeError("fused builder unavailable")
+                t0 = _trace.now() if _trace.enabled else 0
                 wire = build(msgs.base, msgs.klens, msgs.vlens,
                              msgs.count, w.now_ms, w.pid, w.epoch,
                              w.base_seq, w.codec_id, w.attrs)
+                if t0:
+                    # the one-call frame+compress+CRC fast lane
+                    _trace.complete("produce", "fused_build", t0,
+                                    {"topic": tp.topic,
+                                     "partition": tp.partition,
+                                     "msgs": msgs.count})
                 by_idx[i] = (tp, msgs, wire, None)
             except Exception as e:
                 by_idx[i] = (tp, msgs, None, e)
@@ -237,6 +259,7 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
 
     csub = getattr(provider, "compress_submit", None)
     if csub is not None and by_key:
+        t_comp = _trace.now() if _trace.enabled else 0
         comp_tickets = []
         for (cdc, lvl), idxs in by_key.items():
             try:
@@ -251,20 +274,27 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
         if comp_tickets is not None:
             pend = _PendingCodec(rk, by_idx, n, writer_items)
             pend.comp_tickets = comp_tickets
+            pend.t_compress_ns = t_comp
             return pend
 
     try:
+        t_comp = _trace.now() if _trace.enabled else 0
         blobs = {}
         for (cdc, lvl), idxs in by_key.items():
             out = provider.compress_many(
                 cdc, [items[i][2].records_bytes for i in idxs], lvl)
             for i, blob in zip(idxs, out):
                 blobs[i] = blob
+        if t_comp and by_key:
+            _trace.complete("produce", "compress", t_comp,
+                            {"groups": len(by_key),
+                             "batches": len(writer_items)})
     except Exception as e:
         for i, (tp, msgs, _w) in writer_items:
             by_idx[i] = (tp, msgs, None, e)
         return None
 
+    t_crc = _trace.now() if _trace.enabled else 0
     assembled, ticket = _assemble_and_submit_crc(rk, writer_items,
                                                  by_idx, blobs)
     if ticket is None:
@@ -272,6 +302,7 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
     pend = _PendingCodec(rk, by_idx, n, writer_items)
     pend.assembled = assembled
     pend.ticket = ticket
+    pend.t_crc_ns = t_crc
     return pend
 
 
@@ -324,7 +355,8 @@ class _PendingFetch:
     per-partition delivery order is preserved exactly."""
 
     __slots__ = ("entry", "crc_ticket", "crc_infos",
-                 "legacy_ticket", "legacy_owners", "dec_tickets")
+                 "legacy_ticket", "legacy_owners", "dec_tickets",
+                 "t_submit_ns")
 
     def __init__(self, entry):
         self.entry = entry          # (tp, pres, batches, fo, ver)
@@ -333,6 +365,7 @@ class _PendingFetch:
         self.legacy_ticket = None   # MsgVer0/1 zlib-poly CRC ticket
         self.legacy_owners = ()     # (offset, wanted_crc) per region
         self.dec_tickets = ()       # [(codec, items, ticket)]
+        self.t_submit_ns = 0        # ticket submit (fetch_latency/trace)
 
     def done(self) -> bool:
         for t in (self.crc_ticket, self.legacy_ticket):
@@ -506,6 +539,10 @@ class Broker:
         self.rtt_avg = Avg()            # request sent -> response (µs)
         self.outbuf_avg = Avg()         # enqueue -> wire write (µs)
         self.throttle_avg = Avg(1, 5 * 60 * 1000, 3)  # broker throttle (ms)
+        # consumer fetch-pipeline window (ISSUE 5): codec-ticket submit
+        # (_begin_fetch_partition) -> reap (_reap_fetch_pending), the
+        # per-broker mirror of the producer's codec_latency
+        self.fetch_latency_avg = Avg()
         self.thread = threading.Thread(target=self._thread_main,
                                        name=f"rdk:broker/{self.name}",
                                        daemon=True)
@@ -1091,6 +1128,13 @@ class Broker:
             req = self.waitresp.pop(c)
             self.c_req_timeouts += 1
             self._req_timeouts_pending += 1
+            if _trace.enabled:
+                # flight-recorder trigger: the trace explaining WHY the
+                # request stalled is exactly what times out with it
+                _trace.instant("broker", "request_timeout",
+                               {"broker": self.name, "api": req.api.name,
+                                "corrid": req.corrid})
+                _trace.flight_record(f"request_timeout_{req.api.name}")
             self._req_fail(req, KafkaError(Err._TIMED_OUT,
                                            f"{req.api.name} timed out"))
         # socket.max.fails consecutive timeouts with no response in
@@ -1130,6 +1174,7 @@ class Broker:
         if len(self._unsent_req_ends) >= rk.conf.get(
                 "queue.buffering.backpressure.threshold"):
             return
+        t_assembly = _trace.now() if _trace.enabled else 0
         ready: list[tuple] = []   # (toppar, msgs, writer|None-when-legacy)
 
         for tp in list(self.toppars):
@@ -1255,6 +1300,11 @@ class Broker:
 
         if not ready:
             return
+        if t_assembly:
+            # spans only when batches actually formed: the idle serve
+            # pass must not flood the ring
+            _trace.complete("produce", "batch_assembly", t_assembly,
+                            {"batches": len(ready)})
 
         # int_latency: produce() -> MessageSet write (reference rkb_avg
         # int_latency fed per message at rdkafka_msgset_writer.c; here the
@@ -1432,6 +1482,7 @@ class Broker:
             for m in msgs:
                 m.status = MsgStatus.POSSIBLY_PERSISTED
                 m.latency_us = int((now - m.enq_time) * 1e6)
+        t_tx = _trace.now() if _trace.enabled else 0
         req = Request(
             ApiKey.Produce,
             {"transactional_id": (rk.conf.get("transactional.id") or None
@@ -1442,9 +1493,15 @@ class Broker:
                  {"partition": tp.partition, "records": wire}]}]},
             expect_response=(acks != 0),
             version=version,
-            cb=lambda err, resp, tp=tp, msgs=msgs: self._handle_produce(
-                tp, msgs, err, resp))
+            cb=lambda err, resp, tp=tp, msgs=msgs, t_tx=t_tx:
+            self._handle_produce(tp, msgs, err, resp, t_tx))
         self._xmit(req)
+        if t_tx:
+            # framing + write-queue submit of the ProduceRequest
+            _trace.complete("produce", "produce_tx", t_tx,
+                            {"topic": tp.topic,
+                             "partition": tp.partition,
+                             "bytes": len(wire)})
         if acks == 0:
             tp.release_inflight(msgs)
             if not isinstance(msgs, ArenaBatch):
@@ -1452,13 +1509,20 @@ class Broker:
                     m.offset = -1
             rk.dr_msgq(msgs, None, tp=tp)
 
-    def _handle_produce(self, tp, msgs: list[Message], err, resp):
+    def _handle_produce(self, tp, msgs: list[Message], err, resp,
+                        t_tx_ns: int = 0):
         """Produce response → DR / retry / idempotence reconciliation
         (reference: rd_kafka_handle_Produce, rdkafka_request.c:2887,
         error path :2415).  The in-flight accounting is released only
         AFTER the requeue-or-DR decision so the main thread's DRAIN
         rebase can never observe inflight==0 while this batch is still
         unresolved."""
+        if t_tx_ns and _trace.enabled:
+            # tx -> ack/DR span (the wire round trip of this batch)
+            _trace.complete("produce", "ack", t_tx_ns,
+                            {"topic": tp.topic, "partition": tp.partition,
+                             "err": (err.code.name if err is not None
+                                     else None)})
         try:
             self._handle_produce0(tp, msgs, err, resp)
         finally:
@@ -1826,6 +1890,9 @@ class Broker:
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
         if not ok:
             return None
+        if _trace.enabled:
+            _trace.instant("fetch", "fetch_rx",
+                           {"broker": self.name, "partitions": len(ok)})
         # phases B-D run PER PARTITION with decompressed-ahead flow
         # control (r5). Two measured pathologies of whole-response
         # batching: (a) a 1MB-wire partition can decompress to tens of
@@ -1934,6 +2001,11 @@ class Broker:
             except Exception as e:
                 self.rk.log("ERROR",
                             f"{self.name}: fetch partition process: {e!r}")
+            if pend.t_submit_ns:
+                # fetch pipeline window: ticket submit -> reap (stats
+                # brokers.fetch_latency, STATISTICS.md)
+                self.fetch_latency_avg.add(
+                    (time.monotonic_ns() - pend.t_submit_ns) / 1e3)
             delta += max(0, tp.fetchq_bytes - before)
         return delta
 
@@ -1988,6 +2060,7 @@ class Broker:
         from ..protocol.msgset import iter_legacy_crc_regions
         tp, pres, batches, fo, ver = entry
         pend = _PendingFetch(entry)
+        pend.t_submit_ns = time.monotonic_ns()
         # phase B: batched CRC verify for this partition
         if rk.conf.get("check.crcs"):
             if batches:
@@ -2046,8 +2119,20 @@ class Broker:
         tp, pres, batches, fo, ver = pend.entry
         if pend.crc_ticket is not None:
             crcs = pend.crc_ticket.result(60.0)
+            if _trace.enabled:
+                # submit -> resolve: the verify's share of the pipeline
+                _trace.complete("fetch", "crc_verify", pend.t_submit_ns,
+                                {"topic": tp.topic,
+                                 "partition": tp.partition,
+                                 "batches": len(pend.crc_infos)})
             for info, crc in zip(pend.crc_infos, crcs):
                 if int(crc) != info.crc:
+                    if _trace.enabled:
+                        _trace.instant("fetch", "crc_mismatch",
+                                       {"topic": tp.topic,
+                                        "partition": tp.partition,
+                                        "offset": info.base_offset})
+                        _trace.flight_record("crc_mismatch")
                     rk.op_err(KafkaError(
                         Err._BAD_MSG,
                         f"{tp}: CRC mismatch at offset "
@@ -2056,14 +2141,27 @@ class Broker:
                     return
         if pend.legacy_ticket is not None:
             crcs = pend.legacy_ticket.result(60.0)
+            if _trace.enabled:
+                _trace.complete("fetch", "crc_verify", pend.t_submit_ns,
+                                {"topic": tp.topic,
+                                 "partition": tp.partition,
+                                 "legacy": True,
+                                 "batches": len(pend.legacy_owners)})
             for (off, want), got in zip(pend.legacy_owners, crcs):
                 if int(got) != want:
+                    if _trace.enabled:
+                        _trace.instant("fetch", "crc_mismatch",
+                                       {"topic": tp.topic,
+                                        "partition": tp.partition,
+                                        "offset": off, "legacy": True})
+                        _trace.flight_record("crc_mismatch")
                     rk.op_err(KafkaError(
                         Err._BAD_MSG,
                         f"{tp}: legacy message CRC mismatch "
                         f"at offset {off}"))
                     tp.fetch_backoff_until = time.monotonic() + 0.5
                     return
+        t_dec = _trace.now() if _trace.enabled else 0
         for codec, items, ticket in pend.dec_tickets:
             blobs = None
             try:
@@ -2079,10 +2177,20 @@ class Broker:
                         codec, [b[1]])[0]
                 except Exception:
                     b[1] = None
+        if t_dec and pend.dec_tickets:
+            _trace.complete("fetch", "decompress", t_dec,
+                            {"topic": tp.topic, "partition": tp.partition,
+                             "codecs": [c for c, _i, _t in
+                                        pend.dec_tickets]})
         # phase D: record parsing + delivery op for this partition
+        t_del = _trace.now() if _trace.enabled else 0
         rk.fetch_reply_handle(
             tp, pres, self,
             batches=None if batches is None else
             [(info, payload, last)
              for info, payload, last, _full in batches],
             fo=fo, ver=ver)
+        if t_del:
+            _trace.complete("fetch", "deliver", t_del,
+                            {"topic": tp.topic,
+                             "partition": tp.partition})

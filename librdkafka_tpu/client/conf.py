@@ -72,6 +72,21 @@ def _valid_cache_dir(v: Any) -> Optional[str]:
     return None
 
 
+def _valid_ring_events(v) -> Optional[str]:
+    """trace.ring.events: a power of two (the ring index wraps with a
+    mask) within 64..4194304 — validated HERE so a bad capacity fails
+    at set() time, not at the first recorded event."""
+    try:
+        n = int(str(v).strip())
+    except ValueError:
+        return f"expected an integer, got {v!r}"
+    if n < 64 or n > (1 << 22):
+        return f"{n} outside allowed range 64..{1 << 22}"
+    if n & (n - 1):
+        return f"{n} is not a power of two"
+    return None
+
+
 def _valid_transactional_id(v) -> Optional[str]:
     """transactional.id: empty (non-transactional) or a usable id —
     printable, and within the broker's 249-char resource-name bound, so
@@ -427,6 +442,29 @@ PROPERTIES: list[Prop] = [
        "vector units). Default off: backend=tpu runs lz4 on CPU and only "
        "CRC32C on the MXU, so the TPU backend is never slower than cpu.",
        app=P),
+    # ---- flight-recorder tracing (obs/trace.py; TRACING.md) ----
+    _p("trace.enable", GLOBAL, "bool", False,
+       "Flight-recorder event tracing (obs/trace.py): per-thread ring "
+       "buffers record spans across the whole offload pipeline — "
+       "produce() enqueue, batch assembly, compress/CRC tickets, the "
+       "engine's fan-in/launch/readback, ProduceRequest tx and ack, and "
+       "the consumer fetch mirror (CRC verify, decompress, deliver) — "
+       "with governor route decisions attached as span args. Export "
+       "with Kafka.trace_dump(path) as Chrome trace-event JSON "
+       "(Perfetto / chrome://tracing / scripts/traceview.py). Disabled, "
+       "every hook costs one attribute check (bench.py --smoke gates "
+       "the overhead at < 2% of the produce budget)."),
+    _p("trace.ring.events", GLOBAL, "int", 8192,
+       "Per-thread trace ring capacity in events; a power of two "
+       "(validated at set() time). Each ring keeps the LAST this-many "
+       "events of its thread — sizing bounds both memory and how far "
+       "back a flight-recorder dump can see.",
+       vmin=64, vmax=4194304, validator=_valid_ring_events),
+    _p("trace.dump.on.fatal", GLOBAL, "bool", True,
+       "Flight-recorder mode: with tracing enabled, auto-dump the last "
+       "trace.ring.events events per thread to a JSON file on fatal "
+       "error, CRC mismatch, or request timeout (bounded dumps per "
+       "process; see TRACING.md for the dump location and format)."),
     # ---- callbacks / opaque ----
     _p("error_cb", GLOBAL, "ptr", None, "Error callback."),
     _p("throttle_cb", GLOBAL, "ptr", None, "Throttle callback."),
@@ -570,6 +608,11 @@ TPU_ADDITIONS = frozenset({
     # 1.3.0 reference table stops at the idempotent producer)
     (GLOBAL, "transactional.id"),
     (GLOBAL, "transaction.timeout.ms"),
+    # flight-recorder tracing (ISSUE 5; no reference analog — the
+    # reference's nearest is the debug-context log stream, rdlog.c)
+    (GLOBAL, "trace.enable"),
+    (GLOBAL, "trace.ring.events"),
+    (GLOBAL, "trace.dump.on.fatal"),
 })
 
 # Scope-keyed lookup: the reference's table has rows of the same name in

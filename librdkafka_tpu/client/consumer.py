@@ -742,6 +742,11 @@ class Consumer:
         self.apply_assignment({})
         self._rk.close()
 
+    def trace_dump(self, path: str) -> int:
+        """Export the flight-recorder trace rings as Chrome trace-event
+        JSON (trace.enable=true; see TRACING.md)."""
+        return self._rk.trace_dump(path)
+
     @property
     def rk(self) -> Kafka:
         return self._rk

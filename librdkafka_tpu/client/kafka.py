@@ -17,6 +17,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..obs import trace as _trace
 from ..protocol import apis, proto
 from ..protocol.msgset import (iter_batches, parse_fetch_messages_v2,
                                parse_msgset_v01, parse_records_v2,
@@ -192,6 +193,14 @@ class Kafka:
         self.interceptors = conf.get("interceptors") or None
         self.mock_cluster = None
         self.stats = None                      # StatsCollector, set below
+        # flight-recorder tracing (obs/trace.py, TRACING.md): the
+        # module-level tracer is refcounted — this client holds one
+        # reference while trace.enable is set, released at close()
+        self._trace_ref = False
+        if conf.get("trace.enable"):
+            _trace.enable(ring=conf.get("trace.ring.events"),
+                          on_fatal=conf.get("trace.dump.on.fatal"))
+            self._trace_ref = True
         self.debug_contexts = set(conf.get("debug"))
         # debug contexts force DEBUG visibility (the reference raises
         # log_level to 7 whenever debug is set, rd_kafka_conf_finalize)
@@ -357,8 +366,14 @@ class Kafka:
                             lambda: self.metadata_refresh("periodic"))
         self.timers.add(1.0, self._scan_msg_timeouts)
         stats_ival = conf.get("statistics.interval.ms")
+        self._stats_timer = None
         if stats_ival > 0:
-            self.timers.add(stats_ival / 1000.0, self._emit_stats)
+            self._stats_timer = self.timers.add(stats_ival / 1000.0,
+                                                self._emit_stats)
+            # process-wide registry: the conftest leak fixture fails any
+            # test whose client left its stats emitter registered
+            from .stats import _ACTIVE_STATS_TIMERS
+            _ACTIVE_STATS_TIMERS.add(id(self._stats_timer))
 
         self._main = threading.Thread(target=self._thread_main,
                                       name="rdk:main", daemon=True)
@@ -862,6 +877,12 @@ class Kafka:
         in one C call and tail-calls here for the rest."""
         # positional order matches the confluent-style public API
         # (topic, value, key, partition, on_delivery, timestamp, headers)
+        if _trace.enabled:
+            # the produce()-enqueue anchor of the producer span chain
+            # (fast-lane records never enter a Python frame; their
+            # first-sight setup passes through here)
+            _trace.instant("produce", "enqueue",
+                           {"topic": topic, "partition": partition})
         if isinstance(value, str):
             value = value.encode()
         if isinstance(key, str):
@@ -1206,6 +1227,13 @@ class Kafka:
         if self.fatal_error is None:
             self.fatal_error = err
             self._lane.fatal = 1        # C produce must reject now
+            if _trace.enabled:
+                # flight-recorder trigger: dump the rings that explain
+                # how the client got here (TRACING.md)
+                _trace.instant("client", "fatal_error",
+                               {"code": err.code.name,
+                                "reason": err.reason})
+                _trace.flight_record(f"fatal_{err.code.name}")
             self.op_err(err)
 
     # -------------------------------------------------------------- flush --
@@ -1430,6 +1458,15 @@ class Kafka:
         blob = self.stats.emit_json()
         self.rep.push(Op(OpType.STATS, payload=blob))
 
+    # -------------------------------------------------------------- trace --
+    def trace_dump(self, path: str) -> int:
+        """Export the flight-recorder rings as Chrome trace-event JSON
+        loadable in Perfetto (obs/trace.py; workflow in TRACING.md).
+        Returns the number of events written.  The tracer is module-
+        wide, so a dump taken through any client carries every
+        instrumented thread — producer, consumer, engine, brokers."""
+        return _trace.dump(path)
+
     # ------------------------------------------------- consumer fetch path --
     def fetch_reply_handle(self, tp: Toppar, pres: dict, broker: Broker,
                            batches: Optional[list] = None,
@@ -1515,6 +1552,13 @@ class Kafka:
                     last = info.base_offset + info.last_offset_delta
                     if last >= fo:
                         if check_crcs and not verify_crc_v2(info, full):
+                            if _trace.enabled:
+                                _trace.instant(
+                                    "fetch", "crc_mismatch",
+                                    {"topic": tp.topic,
+                                     "partition": tp.partition,
+                                     "offset": info.base_offset})
+                                _trace.flight_record("crc_mismatch")
                             self.op_err(KafkaError(
                                 Err._BAD_MSG,
                                 f"{tp}: CRC mismatch at offset "
@@ -1629,6 +1673,16 @@ class Kafka:
         if self.is_producer:
             self.flush(timeout)
         self.terminating = True
+        if self._stats_timer is not None:
+            self.timers.stop(self._stats_timer)
+            from .stats import _ACTIVE_STATS_TIMERS
+            _ACTIVE_STATS_TIMERS.discard(id(self._stats_timer))
+            self._stats_timer = None
+        if self._trace_ref:
+            # release this client's tracer reference (the last release
+            # disables recording and frees every ring)
+            self._trace_ref = False
+            _trace.disable()
         with self._brokers_lock:
             brokers = list(self.brokers.values())
         for b in brokers:

@@ -164,6 +164,11 @@ class Producer:
     def close(self, timeout: float = 5.0):
         self._rk.close(timeout)
 
+    def trace_dump(self, path: str) -> int:
+        """Export the flight-recorder trace rings as Chrome trace-event
+        JSON (trace.enable=true; see TRACING.md)."""
+        return self._rk.trace_dump(path)
+
     # escape hatch for tests / advanced use
     @property
     def rk(self) -> Kafka:

@@ -16,6 +16,12 @@ from ..utils.hdrhistogram import HdrHistogram
 if TYPE_CHECKING:
     from .kafka import Kafka
 
+#: live stats-emit timers by id() (registered by Kafka.__init__ when
+#: statistics.interval.ms > 0, removed at close); the conftest autouse
+#: leak fixture fails any test whose client left one behind — a leaked
+#: emitter means close() never ran or lost the timer handle
+_ACTIVE_STATS_TIMERS: set[int] = set()
+
 
 class Avg:
     """Windowed HdrHistogram with rollover (reference: rd_avg_t,
@@ -81,6 +87,9 @@ class StatsCollector:
                 "rtt": b.rtt_avg.rollover(),
                 "outbuf_latency": b.outbuf_avg.rollover(),
                 "throttle": b.throttle_avg.rollover(),
+                # consumer fetch pipeline: codec-ticket submit -> reap
+                # (the _PendingFetch window PR 2 added; ISSUE 5)
+                "fetch_latency": b.fetch_latency_avg.rollover(),
                 "toppars": {f"{tp.topic}-{tp.partition}":
                             {"topic": tp.topic, "partition": tp.partition}
                             for tp in list(b.toppars)},
@@ -140,8 +149,13 @@ class StatsCollector:
         # from the async engine, when the tpu backend has spun one up
         eng = getattr(rk.codec_provider, "_engine", None)
         if eng is not None:
-            blob["codec_engine"] = {**eng.stats,
-                                    "governor": eng.governor_snapshot()}
+            blob["codec_engine"] = {
+                **eng.stats,
+                "governor": eng.governor_snapshot(),
+                # per-stage latency decomposition + pipeline-occupancy
+                # gauges (ISSUE 5; STATISTICS.md codec_engine section)
+                "stage_latency": eng.stage_latency_snapshot(),
+                "gauges": eng.gauges_snapshot()}
         if rk.cgrp is not None:
             blob["cgrp"] = {"state": rk.cgrp.join_state,
                             "rebalance_cnt": rk.cgrp.rebalance_cnt,

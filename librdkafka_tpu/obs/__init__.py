@@ -1,0 +1,7 @@
+"""librdkafka_tpu.obs — observability: event tracing (trace.py).
+
+The statistics half of observability lives in client/stats.py (the
+rd_avg_t windowed-histogram JSON of STATISTICS.md); this package holds
+the EVENT half — the flight-recorder trace rings and the Chrome
+trace-event exporter (TRACING.md).
+"""

@@ -72,6 +72,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import trace as _trace
+
 
 class Ticket:
     """Handle for one submitted job; resolves to a uint32 ndarray of
@@ -130,7 +132,8 @@ class SyncTicket:
 
 
 class _Job:
-    __slots__ = ("kind", "bufs", "poly", "ticket", "window", "fn", "args")
+    __slots__ = ("kind", "bufs", "poly", "ticket", "window", "fn", "args",
+                 "t_submit")
 
     def __init__(self, kind, bufs, poly, ticket, window, fn=None, args=()):
         self.kind = kind            # "crc" | "compute" | "host"
@@ -140,6 +143,7 @@ class _Job:
         self.window = window        # may wait the fan-in window
         self.fn = fn
         self.args = args
+        self.t_submit = 0.0         # submit() time (stage_latency)
 
 
 class _Staging:
@@ -329,6 +333,22 @@ class AsyncOffloadEngine:
                       "fanin_skips": 0, "warmup_miss_jobs": 0,
                       "warmup_compiled": 0, "routed_cpu_jobs": 0,
                       "explore_routes": 0, "fused_launches": 0}
+        # per-stage latency decomposition (ISSUE 5): windowed
+        # HdrHistogram Avgs feeding codec_engine.stage_latency in the
+        # stats JSON — submit->launch wait, launch->readback (device),
+        # and the host-side reap (combine + slice).  Lazy import: the
+        # client package only reaches utils from stats.py, so there is
+        # no cycle, but keeping it out of module scope lets
+        # `import librdkafka_tpu.ops.engine` stay light.
+        from ..client.stats import Avg
+        self.stage_submit_wait = Avg()
+        self.stage_launch = Avg()
+        self.stage_reap = Avg()
+        # instantaneous gauges (codec_engine.gauges): in-flight launch
+        # depth and the last fan-in occupancy (buffers present when a
+        # below-quorum group stopped waiting)
+        self._inflight_cnt = 0
+        self._fanin_last = 0
         self._thread = threading.Thread(target=self._main, daemon=True,
                                         name=name)
         self._thread.start()
@@ -350,6 +370,7 @@ class AsyncOffloadEngine:
         queued at dispatch time still merges in)."""
         t = Ticket()
         job = _Job("crc", [bytes(b) for b in bufs], poly, t, window)
+        job.t_submit = time.perf_counter()
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine closed")
@@ -373,6 +394,7 @@ class AsyncOffloadEngine:
         t = Ticket()
         job = _Job("host" if host else "compute", None, None, t, False,
                    fn=fn, args=args)
+        job.t_submit = time.perf_counter()
         with self._cond:
             if self._closed:
                 raise RuntimeError("engine closed")
@@ -426,6 +448,24 @@ class AsyncOffloadEngine:
         snap = self.governor.snapshot()
         snap["warmup"] = self.warmup_enabled
         return snap
+
+    def stage_latency_snapshot(self) -> dict:
+        """Per-stage windowed latency decomposition for the stats JSON
+        (codec_engine.stage_latency, STATISTICS.md): submit->launch
+        wait, launch->readback (device round trip) and the host-side
+        reap.  Rolls the windows over, like every rd_avg_t emit."""
+        return {"submit_wait": self.stage_submit_wait.rollover(),
+                "launch": self.stage_launch.rollover(),
+                "reap": self.stage_reap.rollover()}
+
+    def gauges_snapshot(self) -> dict:
+        """Instantaneous pipeline-occupancy gauges (codec_engine.gauges):
+        queued jobs not yet popped by the dispatch thread, launches in
+        flight awaiting readback, and the buffer count the last fan-in
+        window closed with."""
+        return {"queue_depth": len(self._queue),
+                "inflight_launches": self._inflight_cnt,
+                "fanin_occupancy": self._fanin_last}
 
     # ----------------------------------------------------- warmup thread --
     def _request_warm(self, B: int, kind: str) -> None:
@@ -528,12 +568,15 @@ class AsyncOffloadEngine:
                     # pipeline full: sync the oldest — the newer
                     # launches keep executing on the device meanwhile
                     while len(inflight) > self.depth:
+                        self._inflight_cnt = len(inflight)
                         self._readback(inflight.popleft())
+                self._inflight_cnt = len(inflight)
                 continue            # re-check the queue before syncing
             if inflight:
                 # nothing new queued: drain completed work rather than
                 # hold results hostage waiting for more submissions
                 self._readback(inflight.popleft())
+                self._inflight_cnt = len(inflight)
 
     def _pop_jobs_locked(self) -> list[_Job]:
         jobs = list(self._queue)
@@ -558,8 +601,13 @@ class AsyncOffloadEngine:
         window = self.governor.fanin_window(self.min_batches - nbufs)
         if window <= 0:
             self.stats["fanin_skips"] += 1
+            self._fanin_last = nbufs
+            if _trace.enabled:
+                _trace.instant("engine", "fanin_skip",
+                               {"bufs": nbufs, "need": self.min_batches})
             return jobs
         self.stats["fanin_waits"] += 1
+        t0 = _trace.now() if _trace.enabled else 0
         deadline = time.monotonic() + window
         with self._cond:
             while nbufs < self.min_batches:
@@ -571,6 +619,11 @@ class AsyncOffloadEngine:
                 jobs.extend(more)
                 nbufs += sum(len(j.bufs) for j in more
                              if j.kind == "crc" and j.window)
+        self._fanin_last = nbufs
+        if t0:
+            _trace.complete("engine", "fanin_wait", t0,
+                            {"bufs": nbufs, "need": self.min_batches,
+                             "window_us": round(window * 1e6, 1)})
         return jobs
 
     def _group(self, jobs: list[_Job]):
@@ -615,7 +668,12 @@ class AsyncOffloadEngine:
                 # are already in flight
                 job = group[0]
                 self.stats["host_jobs"] += 1
+                t0 = _trace.now() if _trace.enabled else 0
                 job.ticket._complete(job.fn(*job.args))
+                if t0:
+                    _trace.complete(
+                        "engine", "host_job", t0,
+                        {"fn": getattr(job.fn, "__name__", "host")})
                 return None
             if group[0].kind == "compute":
                 return self._launch_compute(group[0])
@@ -636,6 +694,7 @@ class AsyncOffloadEngine:
         into the governor's CPU cost estimate."""
         self.stats[counter] += len(group)
         t0 = time.perf_counter()
+        tr0 = _trace.now() if _trace.enabled else 0
         nbytes = 0
         for j in group:
             try:
@@ -645,6 +704,12 @@ class AsyncOffloadEngine:
             except Exception as e:
                 j.ticket._fail(e)
         self.governor.note_cpu(nbytes, time.perf_counter() - t0)
+        if tr0:
+            # route decision attached as span args (the governor's
+            # reason is exactly the stats counter it bumped)
+            _trace.complete("engine", "cpu_serve", tr0,
+                            {"route": "cpu", "reason": counter,
+                             "jobs": len(group), "bytes": nbytes})
 
     @staticmethod
     def _bucket_shapes(nblocks: int) -> list[int]:
@@ -707,6 +772,7 @@ class AsyncOffloadEngine:
                     self._request_warm(B, k)
                 self._serve_cpu(group, "warmup_miss_jobs")
                 return None
+        explored = False
         if self.governor.enabled and self.cpu_fallback is not None:
             nbytes = sum(len(b) for j in group for b in j.bufs)
             route, explored = self.governor.route(shapes[0], nbytes)
@@ -722,7 +788,14 @@ class AsyncOffloadEngine:
         rec.jobs = group
         rec.spans = spans
         rec.bucket = shapes[0] if shapes else None
-        rec.t0 = time.perf_counter()
+        # submit->launch wait: the queue + fan-in share of each job's
+        # pipeline latency (codec_engine.stage_latency.submit_wait)
+        t_launch = time.perf_counter()
+        for j in group:
+            if j.t_submit:
+                self.stage_submit_wait.add((t_launch - j.t_submit) * 1e6)
+        rec.t0 = t_launch
+        tr0 = _trace.now() if _trace.enabled else 0
         self.stats["launches"] += 1
         if mixed:
             self.stats["fused_launches"] += 1
@@ -768,6 +841,12 @@ class AsyncOffloadEngine:
                     fn = _jit_mxu(B, blk, poly)
                 rec.outs.append(fn(d, t))
             rec.chunk_lens.append(len(chunk))
+        if tr0:
+            # the async dispatch span; governor decision rides the args
+            _trace.complete("engine", "device_launch", tr0,
+                            {"route": "device", "explored": explored,
+                             "fused": mixed, "bucket": rec.bucket,
+                             "blocks": len(blocks), "jobs": len(group)})
         return rec
 
     # ------------------------------------------------------------ readback --
@@ -775,8 +854,12 @@ class AsyncOffloadEngine:
         try:
             if rec.kind == "compute":
                 import jax
+                t0 = _trace.now() if _trace.enabled else 0
                 rec.ticket._complete(
                     jax.tree_util.tree_map(np.asarray, rec.out_tree))
+                if t0:
+                    _trace.complete("engine", "readback", t0,
+                                    {"kind": "compute"})
                 return
             self._readback_crc(rec)
         except Exception as e:
@@ -790,15 +873,23 @@ class AsyncOffloadEngine:
         from ..utils.crc import crc32_combine, crc32c_combine
         from .crc32c_jax import _MXU_BLOCK
         blk = _MXU_BLOCK
+        tr0 = _trace.now() if _trace.enabled else 0
         # ONE bulk host sync per chunk + vectorized uint32 view — no
         # per-item int(x) loop
         parts = [np.asarray(o).astype(np.uint32)[:n]
                  for o, n in zip(rec.outs, rec.chunk_lens)]
         crcs = parts[0] if len(parts) == 1 else np.concatenate(parts)
         # launch latency feeds the governor's per-bucket device model
+        # AND the stage_latency.launch window (dispatch -> bulk sync)
         if rec.t0 is not None:
-            self.governor.note_device(rec.bucket,
-                                      time.perf_counter() - rec.t0)
+            dt = time.perf_counter() - rec.t0
+            self.governor.note_device(rec.bucket, dt)
+            self.stage_launch.add(dt * 1e6)
+        t_reap = time.perf_counter()
+        if tr0:
+            _trace.complete("engine", "readback", tr0,
+                            {"kind": "crc", "bucket": rec.bucket,
+                             "jobs": len(rec.jobs)})
         # host-side combine of multi-block buffers (µs each), then slice
         # results back out per job in submission order; a fused launch
         # combines each job with ITS polynomial's zero-shift matrices
@@ -819,3 +910,5 @@ class AsyncOffloadEngine:
                     off += blk
                 out[i] = acc
             j.ticket._complete(out)
+        # reap: host-side combine + per-job slice/complete
+        self.stage_reap.add((time.perf_counter() - t_reap) * 1e6)

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""traceview — offline summarizer for flight-recorder trace dumps.
+
+Reads a Chrome trace-event JSON file (``Kafka.trace_dump(path)`` or a
+flight-recorder auto-dump, obs/trace.py) and prints, without needing
+Perfetto:
+
+  * per-stage latency: count, p50, p90, p99, max for every span name
+    (ph == "X" complete events), sorted by total time descending;
+  * the top-10 widest individual spans (the "where did THIS ticket
+    spend its 800 us" table), with their args.
+
+Used by humans (``python scripts/traceview.py dump.json``) and by the
+``bench.py --smoke`` trace leg, which loads :func:`summarize` to assert
+a traced e2e run decomposes into the expected pipeline stages.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    """Chrome trace JSON → event list. Accepts both the object form
+    ({"traceEvents": [...]}) and the bare JSON-array form."""
+    with open(path) as f:
+        data = json.load(f)
+    events = data["traceEvents"] if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome trace-event file")
+    return events
+
+
+def _pct(sorted_vals: list[float], p: float) -> float:
+    """Nearest-rank percentile over a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(p / 100.0 * len(sorted_vals) + 0.5)) - 1))
+    return sorted_vals[k]
+
+
+def summarize(events: list[dict], top: int = 10) -> dict:
+    """{"stages": [{name, cat, cnt, total_us, p50_us, p90_us, p99_us,
+    max_us}...] (total-time desc), "widest": [top-N span dicts],
+    "instants": {name: count}}."""
+    by_name: dict[tuple, list[float]] = {}
+    spans: list[dict] = []
+    instants: dict[str, int] = {}
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            dur = float(e.get("dur", 0.0))
+            by_name.setdefault((e.get("cat", ""), e["name"]),
+                               []).append(dur)
+            spans.append(e)
+        elif ph == "i":
+            instants[e["name"]] = instants.get(e["name"], 0) + 1
+    stages = []
+    for (cat, name), durs in by_name.items():
+        durs.sort()
+        stages.append({
+            "name": name, "cat": cat, "cnt": len(durs),
+            "total_us": round(sum(durs), 1),
+            "p50_us": round(_pct(durs, 50), 1),
+            "p90_us": round(_pct(durs, 90), 1),
+            "p99_us": round(_pct(durs, 99), 1),
+            "max_us": round(durs[-1], 1),
+        })
+    stages.sort(key=lambda s: -s["total_us"])
+    spans.sort(key=lambda e: -float(e.get("dur", 0.0)))
+    widest = [{"name": e["name"], "cat": e.get("cat", ""),
+               "dur_us": round(float(e.get("dur", 0.0)), 1),
+               "ts_us": round(float(e.get("ts", 0.0)), 1),
+               "tid": e.get("tid"), "args": e.get("args")}
+              for e in spans[:top]]
+    return {"stages": stages, "widest": widest, "instants": instants}
+
+
+def render(summary: dict) -> str:
+    out = []
+    out.append("per-stage latency (X spans, total-time desc)")
+    out.append(f"{'stage':<22}{'cat':<10}{'cnt':>6}{'p50us':>10}"
+               f"{'p90us':>10}{'p99us':>10}{'maxus':>10}{'totalus':>12}")
+    for s in summary["stages"]:
+        out.append(f"{s['name']:<22}{s['cat']:<10}{s['cnt']:>6}"
+                   f"{s['p50_us']:>10}{s['p90_us']:>10}{s['p99_us']:>10}"
+                   f"{s['max_us']:>10}{s['total_us']:>12}")
+    out.append("")
+    out.append("top widest spans")
+    out.append(f"{'#':<3}{'stage':<22}{'durus':>10}  args")
+    for i, w in enumerate(summary["widest"], 1):
+        out.append(f"{i:<3}{w['name']:<22}{w['dur_us']:>10}  "
+                   f"{w['args'] if w['args'] else ''}")
+    if summary["instants"]:
+        out.append("")
+        out.append("instant events: " + ", ".join(
+            f"{n}x{c}" for n, c in sorted(summary["instants"].items())))
+    return "\n".join(out)
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+        print(__doc__.strip(), file=sys.stderr)
+        print("\nusage: traceview.py <trace.json>", file=sys.stderr)
+        return 2
+    print(render(summarize(load_events(argv[1]))))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -47,7 +47,13 @@ def _no_leaked_engine_threads():
     'engine' match below covers them): a leaked engine means some
     provider/client teardown path lost track of its pipeline, and such
     regressions should fail HERE as a thread leak instead of surfacing
-    later as flaky cross-test timeouts or stuck teardowns."""
+    later as flaky cross-test timeouts or stuck teardowns.
+
+    ISSUE 5 extends the same contract to the observability subsystem:
+    a test may not leave the flight-recorder tracer enabled (trace
+    rings held — Kafka.close releases this client's refcount) nor a
+    stats-emit timer registered (Kafka.close stops and deregisters
+    it); both would silently tax or confuse every later test."""
     yield
     deadline = time.monotonic() + 2.0   # grace for in-progress close()
 
@@ -59,6 +65,17 @@ def _no_leaked_engine_threads():
         time.sleep(0.05)
     assert not leaked(), \
         f"leaked offload-engine dispatch threads: {leaked()}"
+
+    from librdkafka_tpu.client.stats import _ACTIVE_STATS_TIMERS
+    from librdkafka_tpu.obs import trace as _trace
+    assert not _trace.enabled and _trace.active_ring_count() == 0, (
+        f"leaked trace rings: tracer still enabled={_trace.enabled}, "
+        f"{_trace.active_ring_count()} ring(s) registered — a client "
+        f"with trace.enable was not closed (or disable() was skipped)")
+    assert not _ACTIVE_STATS_TIMERS, (
+        f"leaked stats-emit timer(s): {len(_ACTIVE_STATS_TIMERS)} "
+        f"still registered — a client with statistics.interval.ms "
+        f"was not closed")
 
 
 # The interop tier's reference build lives in test_0200_interop.py as a

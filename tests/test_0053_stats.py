@@ -189,6 +189,164 @@ def test_stats_blob_eos_txn_state():
     assert eos["txn_coordinator"] >= 0
 
 
+#: the window-object keys every `{}`-marked field carries
+#: (STATISTICS.md preamble; Avg.rollover / rd_avg_t render)
+WINDOW_KEYS = {"min", "max", "avg", "sum", "cnt", "stddev", "hdrsize",
+               "outofrange", "p50", "p75", "p90", "p95", "p99", "p99_99"}
+
+
+def _doc_sections() -> dict:
+    """Parse STATISTICS.md into {section: set(field names)}: a section
+    is a `## ` heading (keyed by its backticked path, or its lowercased
+    title when plain); fields are the backticked tokens in the FIRST
+    column of its table rows, `{}` suffix stripped."""
+    import os
+    import re
+
+    md_path = os.path.join(os.path.dirname(__file__), "..",
+                           "STATISTICS.md")
+    sections: dict = {}
+    cur = None
+    with open(md_path) as f:
+        for line in f:
+            if line.startswith("## "):
+                m = re.search(r"`([^`]+)`", line)
+                cur = m.group(1) if m else line[3:].strip().lower()
+                sections[cur] = set()
+            elif cur is not None and line.startswith("|"):
+                first = line.split("|")[1]
+                for tok in re.findall(r"`([^`]+)`", first):
+                    sections[cur].add(tok.strip().rstrip("{}"))
+    return sections
+
+
+def _producer_blob():
+    """A transactional tpu-backend producer blob: carries eos AND
+    codec_engine (plus brokers/topics with real traffic)."""
+    from librdkafka_tpu import Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "transactional.id": "schema-tx",
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.launch.min.batches": 1,
+                  "compression.codec": "lz4", "linger.ms": 2})
+    try:
+        p.init_transactions(30)
+        p.begin_transaction()
+        for i in range(30):
+            p.produce("schema-t", value=b"v%d" % i * 20)
+        p.commit_transaction(30)
+        return json.loads(p._rk.stats.emit_json())
+    finally:
+        p.close()
+
+
+def _consumer_blob():
+    """A grouped consumer blob: carries cgrp."""
+    from librdkafka_tpu import Consumer
+
+    c = Consumer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "group.id": "schema-g",
+                  "auto.offset.reset": "earliest"})
+    try:
+        c.subscribe(["schema-t"])
+        c.poll(0.3)
+        return json.loads(c._rk.stats.emit_json())
+    finally:
+        c.close()
+
+
+def test_stats_schema_matches_statistics_md():
+    """ISSUE 5 satellite: every field documented in STATISTICS.md
+    appears in StatsCollector.emit_json() output AND vice versa — the
+    doc is executable; an undocumented key or a stale row fails."""
+    doc = _doc_sections()
+    pb = _producer_blob()
+    cb = _consumer_blob()
+
+    # top level: the union of producer (eos, codec_engine) and grouped
+    # consumer (cgrp) blobs covers every emittable key
+    union = set(pb) | set(cb)
+    assert union == doc["top level"], (
+        f"undocumented: {sorted(union - doc['top level'])}; "
+        f"stale doc rows: {sorted(doc['top level'] - union)}")
+
+    b = next(iter(pb["brokers"].values()))
+    assert set(b) == doc["brokers.{name}"], (
+        set(b) ^ doc["brokers.{name}"])
+
+    tp = next(iter(pb["topics"].values()))["partitions"]
+    part = next(iter(tp.values()))
+    want = doc["topics.{topic}.partitions.{partition}"]
+    assert set(part) == want, set(part) ^ want
+
+    assert set(cb["cgrp"]) == doc["cgrp"], set(cb["cgrp"]) ^ doc["cgrp"]
+    assert set(pb["eos"]) == doc["eos"], set(pb["eos"]) ^ doc["eos"]
+
+    ce = pb["codec_engine"]
+    assert set(ce) == doc["codec_engine"], set(ce) ^ doc["codec_engine"]
+    assert set(ce["governor"]) == doc["codec_engine.governor"], \
+        set(ce["governor"]) ^ doc["codec_engine.governor"]
+    assert set(ce["stage_latency"]) == doc["codec_engine.stage_latency"]
+    assert set(ce["gauges"]) == doc["codec_engine.gauges"]
+
+    # every `{}`-marked window renders the full rd_avg_t field set
+    for w in (pb["int_latency"], pb["codec_latency"], b["rtt"],
+              b["outbuf_latency"], b["throttle"], b["fetch_latency"],
+              *ce["stage_latency"].values()):
+        assert set(w) == WINDOW_KEYS, set(w) ^ WINDOW_KEYS
+
+
+def test_stats_emit_safe_during_broker_churn():
+    """ISSUE 5 satellite: emit_json() must be safe while the broker set
+    mutates concurrently (metadata discovery adds brokers, close reaps
+    them) — the emitter snapshots under list(); a 'dict changed size
+    during iteration' here would kill the main thread's stats timer."""
+    import threading as _th
+
+    from librdkafka_tpu import Producer
+    from librdkafka_tpu.client.broker import Broker
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 2,
+                  "linger.ms": 2})
+    rk = p._rk
+    try:
+        for i in range(50):
+            p.produce("churn-t", value=b"x%d" % i, partition=i % 4)
+        errors: list = []
+        stop = _th.Event()
+
+        def emitter():
+            try:
+                while not stop.is_set():
+                    blob = json.loads(rk.stats.emit_json())
+                    assert "brokers" in blob
+            except Exception as e:          # pragma: no cover
+                errors.append(e)
+
+        th = _th.Thread(target=emitter)
+        th.start()
+        try:
+            # churn: register/unregister unstarted Broker objects under
+            # the same lock metadata discovery uses
+            for i in range(150):
+                b = Broker(rk, 1000 + i, "127.0.0.1", 1)
+                with rk._brokers_lock:
+                    rk.brokers[b.nodeid] = b
+                with rk._brokers_lock:
+                    del rk.brokers[b.nodeid]
+                b._wakeup_r.close()
+                b._wakeup_w.close()
+        finally:
+            stop.set()
+            th.join(10)
+        assert not errors, errors
+        assert p.flush(15.0) == 0
+    finally:
+        p.close()
+
+
 def test_stats_blob_codec_engine_governor_counters():
     """ISSUE 3: with the tpu backend's async engine live, the stats
     JSON carries a codec_engine section — launch/merge/fallback/warmup

@@ -1,0 +1,331 @@
+"""ISSUE 5: flight-recorder tracing + per-stage latency decomposition.
+
+Covers the obs/trace.py tentpole end to end — the e2e acceptance run
+(every pipeline stage spanned, governor decision in the span args, the
+dump loads as Chrome trace-event JSON), ring semantics (fixed size,
+last-N retention, per-thread, refcounted teardown), the three
+flight-recorder triggers (fatal error, CRC mismatch path unit,
+request timeout), conf-knob set()-time validation, and the offline
+summarizer scripts/traceview.py the --smoke overhead gate rides."""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.conf import Conf
+from librdkafka_tpu.client.errors import Err, KafkaError, KafkaException
+from librdkafka_tpu.obs import trace
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_traceview():
+    spec = importlib.util.spec_from_file_location(
+        "tk_traceview_test",
+        os.path.join(HERE, "..", "scripts", "traceview.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------------ e2e --
+def test_trace_e2e_produce_consume_all_stages(tmp_path):
+    """Acceptance: one produce+consume run with trace.enable=true must
+    dump spans for every pipeline stage — compress ticket, fan-in
+    wait, device launch, readback, fetch CRC verify, decompress,
+    deliver — with the governor's route decision visible as span
+    args, in a file Perfetto can load (Chrome trace-event JSON)."""
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "trace.enable": True, "trace.ring.events": 16384,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.launch.min.batches": 2, "tpu.governor": False,
+                  "tpu.warmup": False, "compression.codec": "lz4",
+                  "linger.ms": 10})
+    c = None
+    try:
+        bs = p._rk.mock_cluster.bootstrap_servers()
+        # phase 1: a single below-quorum batch -> the engine's fan-in
+        # wait (static window, governor off)
+        p.produce("tr", value=b"solo", partition=0)
+        assert p.flush(120.0) == 0
+        # phase 2: four partitions ready in one serve pass -> one
+        # at-quorum submission -> device launch + readback
+        for i in range(200):
+            p.produce("tr", value=b"v%d" % i * 20, partition=i % 4)
+        assert p.flush(120.0) == 0
+        # consumer mirror: CRC verify + decompress + deliver
+        c = Consumer({"bootstrap.servers": bs, "group.id": "g-trace",
+                      "auto.offset.reset": "earliest",
+                      "check.crcs": True, "trace.enable": True})
+        c.subscribe(["tr"])
+        got = 0
+        deadline = time.monotonic() + 60
+        while got < 201 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 201, f"consumed {got}/201"
+
+        path = str(tmp_path / "trace.json")
+        n = c.trace_dump(path)          # module-wide: any client dumps
+        assert n > 0
+        with open(path) as f:
+            data = json.load(f)
+        # the Perfetto-loadable shape: traceEvents array, ph/ts/pid/tid
+        # on every record, X spans carrying dur
+        assert isinstance(data["traceEvents"], list)
+        evs = data["traceEvents"]
+        for e in evs:
+            assert {"name", "ph", "pid", "tid"} <= set(e)
+            if e["ph"] == "X":
+                assert "dur" in e and "ts" in e
+        names = {e["name"] for e in evs}
+        required = {"enqueue", "batch_assembly", "compress",
+                    "crc_ticket", "fanin_wait", "device_launch",
+                    "readback", "produce_tx", "ack",
+                    "fetch_rx", "crc_verify", "decompress", "deliver"}
+        assert required <= names, f"missing spans: {required - names}"
+        # governor route decisions ride the launch/serve span args
+        launch = next(e for e in evs if e["name"] == "device_launch")
+        assert launch["args"]["route"] == "device"
+        assert {"explored", "fused", "bucket", "blocks"} \
+            <= set(launch["args"])
+        # thread metadata present (Perfetto track names)
+        assert any(e["ph"] == "M" and e["name"] == "thread_name"
+                   for e in evs)
+        # timestamps are sorted (exporter contract)
+        ts = [e["ts"] for e in evs if "ts" in e]
+        assert ts == sorted(ts)
+    finally:
+        p.close()
+        if c is not None:
+            c.close()
+    assert not trace.enabled and trace.active_ring_count() == 0
+
+
+def test_trace_stats_share_instrumentation():
+    """The same run feeds the stats decomposition: stage_latency
+    windows record real samples and the gauges/fetch_latency fields
+    render (the stats half of the ISSUE 5 instrumentation points)."""
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.launch.min.batches": 2, "tpu.governor": False,
+                  "tpu.warmup": False, "compression.codec": "lz4",
+                  "linger.ms": 10})
+    try:
+        for i in range(200):
+            p.produce("sl", value=b"v%d" % i * 20, partition=i % 4)
+        assert p.flush(120.0) == 0
+        blob = json.loads(p._rk.stats.emit_json())
+        ce = blob["codec_engine"]
+        sl = ce["stage_latency"]
+        assert sl["launch"]["cnt"] >= 1, sl
+        assert sl["submit_wait"]["cnt"] >= 1
+        assert sl["reap"]["cnt"] >= 1
+        assert set(ce["gauges"]) == {"queue_depth", "inflight_launches",
+                                     "fanin_occupancy"}
+        b = next(iter(blob["brokers"].values()))
+        assert "fetch_latency" in b          # consumer mirror window
+    finally:
+        p.close()
+
+
+# ----------------------------------------------------------- ring model --
+def test_ring_keeps_last_n_events():
+    trace.enable(ring=64)
+    try:
+        for i in range(200):
+            trace.instant("t", f"e{i}")
+        ring = trace._local.ring
+        evs = ring.snapshot()
+        assert len(evs) == 64
+        # the LAST 64 survive, oldest first
+        assert evs[0][2] == "e136" and evs[-1][2] == "e199"
+    finally:
+        trace.disable()
+    assert not trace.enabled and trace.active_ring_count() == 0
+
+
+def test_rings_are_per_thread_and_refcounted(tmp_path):
+    trace.enable(ring=256)
+    trace.enable(ring=256)              # second client's reference
+    try:
+        trace.instant("t", "main-ev")
+        done = threading.Event()
+
+        def worker():
+            trace.instant("t", "worker-ev")
+            done.set()
+
+        th = threading.Thread(target=worker, name="trace-worker")
+        th.start()
+        th.join(5)
+        assert done.is_set()
+        assert trace.active_ring_count() == 2
+        path = str(tmp_path / "two.json")
+        trace.dump(path)
+        evs = json.load(open(path))["traceEvents"]
+        tids = {e["tid"] for e in evs if e["ph"] == "i"}
+        assert len(tids) == 2
+        tnames = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert "trace-worker" in tnames
+        trace.disable()                 # first release: still enabled
+        assert trace.enabled
+    finally:
+        trace.disable()                 # last release: off, rings freed
+    assert not trace.enabled and trace.active_ring_count() == 0
+
+
+def test_disabled_recording_is_a_noop():
+    assert not trace.enabled
+    trace.instant("t", "dropped")
+    trace.complete("t", "dropped", trace.now())
+    assert trace.active_ring_count() == 0
+
+
+# ------------------------------------------------------- conf validation --
+def test_trace_conf_knobs_validate_at_set_time():
+    conf = Conf()
+    conf.set("trace.enable", "true")
+    assert conf.get("trace.enable") is True
+    conf.set("trace.ring.events", 4096)
+    with pytest.raises(KafkaException, match="power of two"):
+        conf.set("trace.ring.events", 1000)
+    with pytest.raises(KafkaException, match="outside allowed range"):
+        conf.set("trace.ring.events", 32)
+    with pytest.raises(KafkaException):
+        conf.set("trace.ring.events", 1 << 23)
+    conf.set("trace.dump.on.fatal", "false")
+    assert conf.get("trace.dump.on.fatal") is False
+    # module-level guard mirrors the validator (direct API use)
+    with pytest.raises(ValueError):
+        trace.enable(ring=100)
+    assert not trace.enabled
+
+
+# -------------------------------------------------------- flight recorder --
+def test_flight_record_on_fatal_error(tmp_path):
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "trace.enable": True, "linger.ms": 2})
+    old_dir = trace.flight_dir
+    trace.flight_dir = str(tmp_path)
+    try:
+        p.produce("fl", value=b"x", partition=0)
+        assert p.flush(30.0) == 0
+        p._rk.set_fatal_error(KafkaError(Err._FATAL, "synthetic fatal"))
+        path = trace.last_flight_path
+        assert path and path.startswith(str(tmp_path))
+        assert "fatal" in os.path.basename(path)
+        evs = json.load(open(path))["traceEvents"]
+        fr = [e for e in evs if e["name"] == "flight_record"]
+        assert fr and "fatal" in fr[0]["args"]["reason"]
+        assert any(e["name"] == "fatal_error" for e in evs)
+    finally:
+        trace.flight_dir = old_dir
+        p.close()
+
+
+def test_flight_record_on_request_timeout(tmp_path):
+    from librdkafka_tpu.client.broker import Broker, Request
+    from librdkafka_tpu.protocol.proto import ApiKey
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "trace.enable": True, "socket.max.fails": 0})
+    old_dir, before = trace.flight_dir, trace.last_flight_path
+    trace.flight_dir = str(tmp_path)
+    try:
+        b = Broker(p._rk, 999, "127.0.0.1", 1)     # never started
+        try:
+            b.waitresp[7] = Request(ApiKey.Metadata, {}, corrid=7,
+                                    abs_timeout=time.monotonic() - 1.0)
+            b._scan_timeouts(time.monotonic())
+            assert b.c_req_timeouts == 1
+            path = trace.last_flight_path
+            assert path and path != before \
+                and path.startswith(str(tmp_path))
+            assert "request_timeout" in os.path.basename(path)
+            evs = json.load(open(path))["traceEvents"]
+            assert any(e["name"] == "request_timeout" for e in evs)
+        finally:
+            b._wakeup_r.close()
+            b._wakeup_w.close()
+    finally:
+        trace.flight_dir = old_dir
+        p.close()
+
+
+def test_flight_record_bounded_and_gateable(tmp_path):
+    # dump.on.fatal=false suppresses entirely
+    trace.enable(ring=256, on_fatal=False, dump_dir=str(tmp_path))
+    try:
+        assert trace.flight_record("nope") is None
+    finally:
+        trace.disable()
+    # bounded per process: FLIGHT_MAX_DUMPS then None
+    trace.enable(ring=256, on_fatal=True, dump_dir=str(tmp_path))
+    try:
+        trace.instant("t", "seed")
+        paths = [trace.flight_record(f"r{i}")
+                 for i in range(trace.FLIGHT_MAX_DUMPS + 3)]
+        made = [x for x in paths if x]
+        assert len(made) == trace.FLIGHT_MAX_DUMPS
+        assert all(os.path.exists(x) for x in made)
+        assert paths[-1] is None
+    finally:
+        trace.disable()
+
+
+# -------------------------------------------------------------- tooling --
+def test_traceview_summarize_and_render(tmp_path):
+    trace.enable(ring=1024)
+    try:
+        for i in range(20):
+            t0 = trace.now()
+            time.sleep(0.001 if i != 7 else 0.02)   # one wide outlier
+            trace.complete("stage", "work", t0, {"i": i})
+        trace.instant("stage", "blip")
+        path = str(tmp_path / "tv.json")
+        trace.dump(path)
+    finally:
+        trace.disable()
+    tv = _load_traceview()
+    summary = tv.summarize(tv.load_events(path))
+    st = next(s for s in summary["stages"] if s["name"] == "work")
+    assert st["cnt"] == 20
+    assert st["p50_us"] <= st["p99_us"] <= st["max_us"]
+    assert st["max_us"] >= 15_000                   # the outlier
+    assert summary["widest"][0]["name"] == "work"
+    assert summary["widest"][0]["args"]["i"] == 7
+    assert summary["instants"].get("blip") == 1
+    out = tv.render(summary)
+    assert "work" in out and "top widest spans" in out
+    # the bare-array form loads too (hand-built dumps)
+    alt = str(tmp_path / "arr.json")
+    with open(alt, "w") as f:
+        json.dump(json.load(open(path))["traceEvents"], f)
+    assert tv.summarize(tv.load_events(alt))["stages"]
+
+
+def test_bench_json_artifact(tmp_path, monkeypatch):
+    """bench.py --json <path>: every leg's summary is also written as
+    a machine-readable artifact (the BENCH_r*.json trajectory)."""
+    spec = importlib.util.spec_from_file_location(
+        "tk_bench_test", os.path.join(HERE, "..", "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = str(tmp_path / "leg.json")
+    monkeypatch.setattr("sys.argv",
+                        ["bench.py", "--smoke", "--json", out])
+    bench._emit({"metric": "unit", "value": 1})
+    with open(out) as f:
+        assert json.load(f) == {"metric": "unit", "value": 1}
+    monkeypatch.setattr("sys.argv", ["bench.py", "--smoke"])
+    bench._emit({"metric": "unit2"})    # no --json: print only
+    with open(out) as f:
+        assert json.load(f)["metric"] == "unit"
