@@ -614,6 +614,7 @@ def pipeline_bench() -> dict:
         import jax
         out["engine"] = {
             "backend": jax.devices()[0].platform,
+            "n_devices": len(jax.devices()),
             "sync_s": round(sync_s, 4),
             "pipelined_s": round(pipe_s, 4),
             "overlap_speedup": round(sync_s / max(pipe_s, 1e-9), 2),
@@ -626,6 +627,10 @@ def pipeline_bench() -> dict:
         pipe_prov.close()
     except Exception as e:
         out["engine"] = {"error": repr(e)}
+    if "--mesh" in sys.argv:
+        # ISSUE 6 acceptance leg: device CRC throughput scaling across
+        # per-device dispatch lanes, same artifact
+        out["mesh"] = mesh_bench()
     return out
 
 
@@ -853,6 +858,152 @@ def _cpu_crc_fb(bufs, poly):
             else prov.crc32_many(bufs))
 
 
+def _ensure_virtual_devices() -> int:
+    """Mesh legs need >1 device.  Real multi-chip hosts (the
+    MULTICHIP_r*.json environment) just report their count; CPU-only
+    hosts get the tests' 8-virtual-device driver contract via XLA_FLAGS
+    (a no-op for TPU/GPU platforms) — which only takes effect before
+    jax initializes, so call this FIRST in any leg that wants a mesh.
+    Returns the resulting visible device count."""
+    import sys as _s
+    if ("jax" not in _s.modules
+            and "xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    return len(jax.devices())
+
+
+def mesh_bench() -> dict:
+    """bench.py --mesh (also the mesh leg of --pipeline --mesh and the
+    ``mesh`` blob of the default run): per-device dispatch-lane scaling
+    of the engine's CRC path (ISSUE 6).
+
+    For each device count (1, 2, 4, ... up to every visible chip) the
+    same workload — BENCH_MESH_SUBS submissions of BENCH_MESH_ROWS
+    64KB blocks — runs through a fresh engine, asserting bit-exactness
+    vs the native CPU provider, and reports device CRC throughput plus
+    the per-device launch/block split (the codec_engine.devices[] view).
+    ``scaling_x`` is the full-mesh rate over the single-lane rate —
+    meaningful only when the host has real parallel silicon
+    (``host_cores`` is reported so a flat curve on a 1-core CI host is
+    diagnosable, not alarming).  A writer-level msgset build cross-checks
+    that full-mesh wire bytes equal the CPU provider's."""
+    from librdkafka_tpu.ops import cpu as _c
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    ndev = _ensure_virtual_devices()
+    rows = int(os.environ.get("BENCH_MESH_ROWS", 64))
+    subs = int(os.environ.get("BENCH_MESH_SUBS", 6))
+    blk = 65536
+    rng = np.random.default_rng(6)
+    bufs = [rng.integers(0, 256, blk, dtype=np.uint8).tobytes()
+            for _ in range(rows)]
+    prov = _c.CpuCodecProvider()
+    want = [int(x) for x in prov.crc32c_many(bufs)]
+
+    counts = [n for n in (1, 2, 4, 8) if n < ndev] + [ndev]
+    legs, rates = {}, {}
+    for nd in counts:
+        eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                                 warmup=False, mesh_devices=nd,
+                                 cpu_fallback=_cpu_crc_fb)
+        try:
+            # compile + warm outside the timed window
+            assert eng.submit(bufs, "crc32c",
+                              window=False).result(600).tolist() == want
+            before = {r["id"]: r["blocks"]
+                      for r in eng.devices_snapshot()}
+            t0 = time.perf_counter()
+            ts = [eng.submit(bufs, "crc32c", window=False)
+                  for _ in range(subs)]
+            for t in ts:
+                assert t.result(600).tolist() == want, \
+                    "mesh leg not bit-exact"
+            dt = time.perf_counter() - t0
+            rates[nd] = rows * blk * subs / dt / 1e6
+            devrows = eng.devices_snapshot()
+            # the acceptance gauge: every mesh device launched
+            assert all(r["launches"] > 0 for r in devrows), devrows
+            legs[str(nd)] = {
+                "mb_s": round(rates[nd], 1),
+                "launches": eng.stats["launches"],
+                "sharded_launches": eng.stats["sharded_launches"],
+                "per_device": [
+                    {"id": r["id"], "launches": r["launches"],
+                     "mb_s": round((r["blocks"] - before.get(r["id"], 0))
+                                   * blk / dt / 1e6, 1)}
+                    for r in devrows],
+            }
+        finally:
+            eng.close()
+
+    # wire bytes: a full-mesh provider build equals the CPU provider's
+    from librdkafka_tpu.ops.tpu import TpuCodecProvider
+    from librdkafka_tpu.protocol.msgset import MsgsetWriterV2, Record
+
+    def build(provider, ticketed):
+        w = MsgsetWriterV2(codec=None)
+        w.build([Record(key=b"k%d" % i,
+                        value=bufs[i % rows][:8192],
+                        timestamp=1_700_000_000_000) for i in range(64)],
+                1_700_000_000_000)
+        region = w.assemble(None)
+        crc = (int(provider.crc32c_submit([region]).result(300)[0])
+               if ticketed else int(provider.crc32c_many([region])[0]))
+        return w.patch_crc(crc)
+
+    mp = TpuCodecProvider(min_batches=1, warmup=False,
+                          min_transport_mb_s=0, mesh_devices=0)
+    try:
+        wire_ok = build(mp, True) == build(_c.CpuCodecProvider(), False)
+    finally:
+        mp.close()
+    assert wire_ok, "full-mesh wire bytes diverged from CPU provider"
+
+    # acceptance gauge through the REAL produce path: the stats JSON's
+    # codec_engine.devices[] must show launches > 0 on every mesh
+    # device (whole-to-one-lane groups spread cold lanes first)
+    import json as _json
+
+    from librdkafka_tpu import Producer
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "compression.codec": "none",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.launch.min.batches": 1, "tpu.governor": False,
+                  "tpu.warmup": False, "tpu.mesh.devices": 0,
+                  "linger.ms": 1})
+    try:
+        for _round in range(2 * ndev):
+            for part in range(4):
+                p.produce("mesh-bench", value=bufs[0][:4096],
+                          partition=part)
+            assert p.flush(300) == 0
+        blob = _json.loads(p._rk.stats.emit_json())
+        stats_devices = [{"id": d["id"], "launches": d["launches"]}
+                         for d in blob["codec_engine"]["devices"]]
+        assert len(stats_devices) == ndev and \
+            all(d["launches"] > 0 for d in stats_devices), stats_devices
+    finally:
+        p.close()
+
+    return {
+        "n_devices": ndev,
+        "host_cores": os.cpu_count(),
+        "rows_per_submission": rows,
+        "submissions": subs,
+        "device_counts": counts,
+        "crc_mb_s": {str(nd): round(r, 1) for nd, r in rates.items()},
+        "scaling_x": round(rates[counts[-1]] / max(rates[1], 1e-9), 2),
+        "wire_bitexact": True,
+        "stats_devices": stats_devices,
+        "legs": legs,
+    }
+
+
 def governor_bench() -> dict:
     """bench.py --governor (ISSUE 3 acceptance): the adaptive offload
     governor measured leg by leg, every leg asserting bit-exactness vs
@@ -1026,6 +1177,10 @@ def smoke_bench() -> dict:
     engine leg — sync provider, pipelined engine, fetch pipeline,
     governor (warmup-gate routing + fused multi-poly) — the pre-commit
     gate next to scripts/tier1.sh."""
+    # first: mesh legs need >1 device, and the virtual-device contract
+    # only applies before jax initializes
+    n_devices = _ensure_virtual_devices()
+
     from librdkafka_tpu.ops import cpu as _c
     from librdkafka_tpu.ops.engine import AsyncOffloadEngine
     from librdkafka_tpu.ops.tpu import TpuCodecProvider
@@ -1100,6 +1255,34 @@ def smoke_bench() -> dict:
     fused = eng2.stats["fused_launches"]
     eng2.close()
     legs["fused"] = f"bit-identical ({fused} fused launch)"
+
+    # mesh dispatch lanes (ISSUE 6): 2-device bit-exactness — one
+    # group big enough to shard across both chips, plus small groups
+    # spreading whole-to-one-lane — auto-skipped when <2 devices
+    if n_devices >= 2:
+        eng3 = AsyncOffloadEngine(depth=2, min_batches=1,
+                                  governor=False, warmup=False,
+                                  mesh_devices=2,
+                                  cpu_fallback=_cpu_crc_fb)
+        big = [rng.integers(0, 256, 65536, dtype=np.uint8).tobytes()
+               for _ in range(16)]
+        assert eng3.submit(big, "crc32c",
+                           window=False).result(300).tolist() == \
+            [crc32c(b) for b in big], "mesh sharded leg not bit-exact"
+        assert eng3.stats["sharded_launches"] >= 1, eng3.stats
+        for _ in range(3):
+            assert eng3.submit(bufs, "crc32c",
+                               window=False).result(120).tolist() == \
+                want_c, "mesh lane leg not bit-exact"
+        rows = eng3.devices_snapshot()
+        # scaling sanity: both lanes exist and both launched
+        assert len(rows) == 2 and all(r["launches"] > 0 for r in rows), \
+            rows
+        eng3.close()
+        legs["mesh"] = ("bit-identical (sharded across 2 devices; "
+                        "both lanes launched)")
+    else:
+        legs["mesh"] = f"skipped ({n_devices} device)"
 
     # transactional producer round trip (ISSUE 4): commit then abort
     # through the real Producer API against the in-process mock — the
@@ -1261,6 +1444,16 @@ def _trace_overhead_gate() -> dict:
 
 
 def main():
+    if "--mesh" in sys.argv:
+        # must run before ANY leg initializes jax, so CPU hosts get
+        # the 8-virtual-device contract for the mesh measurements
+        _ensure_virtual_devices()
+    if "--mesh" in sys.argv and "--pipeline" not in sys.argv:
+        _emit({"metric": "mesh-sharded codec engine: per-device "
+                                    "dispatch-lane CRC scaling "
+                                    "(bench.py --mesh)",
+                          **mesh_bench()})
+        return
     if "--governor" in sys.argv:
         _emit({"metric": "adaptive offload governor: warmup "
                                     "cold-start, adaptive fan-in, fused "
@@ -1397,6 +1590,20 @@ def main():
         finally:
             _reset_mock()
     off = codec_offload()
+    # mesh dispatch-lane scaling (ISSUE 6): recorded in the BENCH_r*
+    # trajectory whenever this host has >1 device (the multichip
+    # environment); 1-device hosts skip — a 1-lane "curve" is noise
+    mesh = None
+    if os.environ.get("BENCH_MESH", "1") != "0":
+        try:
+            import jax
+            if len(jax.devices()) >= 2:
+                mesh = mesh_bench()
+            else:
+                mesh = {"skipped": "1 device visible",
+                        "n_devices": 1}
+        except Exception as e:
+            print(f"mesh_bench failed: {e!r}", file=sys.stderr)
     _emit({
         "metric": "batched CRC32C codec offload, 128x64KB partition "
                   "batches (64 toppars x 2 blocks): TPU plane-split MXU "
@@ -1424,6 +1631,7 @@ def main():
         "producer_dr_batch_msgs_s":
             round(dr_batch_rate, 1) if dr_batch_rate is not None else None,
         "codec_size_sweep": sweep,
+        "mesh": mesh,
         "detail": off,
     })
 

@@ -368,9 +368,16 @@ PROPERTIES: list[Prop] = [
        "the broker thread (pipeline overlap of batch build vs codec).",
        vmin=0, vmax=64, app=P),
     _p("tpu.mesh.devices", GLOBAL, "int", 0,
-       "Number of devices to shard the DEVICE lz4 encoder's block "
-       "compression over (0 = all local). Only reachable with "
-       "tpu.lz4.force=true — default routing runs lz4 on CPU.",
+       "Number of devices the async offload engine spreads its "
+       "per-device CRC dispatch lanes over (0 = all local devices, "
+       "1 = single-lane): each mesh device gets its own staging rings "
+       "and in-flight launch tracking, whole launch groups route to "
+       "the least-loaded lane, and groups spanning a mesh multiple "
+       "split across every chip via shard_map "
+       "(parallel/mesh.py sharded_crc_step) — wire bytes bit-identical "
+       "on every route. Also shards the DEVICE lz4 encoder's block "
+       "compression when tpu.lz4.force=true. No effect with "
+       "compression.backend=cpu.",
        vmin=0, vmax=8192),
     _p("tpu.transport.min.mb.s", GLOBAL, "int", 100,
        "Adaptive offload gate: minimum measured host->device bandwidth "

@@ -155,7 +155,12 @@ class StatsCollector:
                 # per-stage latency decomposition + pipeline-occupancy
                 # gauges (ISSUE 5; STATISTICS.md codec_engine section)
                 "stage_latency": eng.stage_latency_snapshot(),
-                "gauges": eng.gauges_snapshot()}
+                "gauges": eng.gauges_snapshot(),
+                # per-device dispatch lanes (ISSUE 6): launch counts,
+                # in-flight depth, launch-time EWMAs and warm-kernel
+                # count per mesh device (STATISTICS.md
+                # codec_engine.devices[])
+                "devices": eng.devices_snapshot()}
         if rk.cgrp is not None:
             blob["cgrp"] = {"state": rk.cgrp.join_state,
                             "rebalance_cnt": rk.cgrp.rebalance_cnt,

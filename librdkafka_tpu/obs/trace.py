@@ -22,6 +22,11 @@ worker -> engine dispatch -> device -> broker IO):
     site captures ``t0 = trace.now()`` and emits ONE event at resolve
     time with the computed duration — no begin/end pairing across the
     pipeline's thread hops.
+  * Engine spans carry the ROUTING DECISION as args: ``device_launch``
+    and ``readback`` stamp ``device=<id>`` (the dispatch lane's mesh
+    device, or -1 for a whole-mesh sharded launch) plus
+    ``sharded=bool``, so scripts/traceview.py and Perfetto can
+    attribute launch latency per chip (ISSUE 6).
   * Flight recorder: on fatal error, CRC mismatch, or request timeout
     the last N events are auto-dumped to ``flight_dir`` (bounded per
     process) so the trace that EXPLAINS the failure survives it.

@@ -251,17 +251,14 @@ def _term_host(n: int, poly: str = "crc32c") -> int:
     return v
 
 
-@lru_cache(maxsize=16)
-def _jit_mxu(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c"):
-    """Plane-split MXU kernel (r4): EIGHT (B, N) x (N, 32) int8 dots —
-    one per bit plane — instead of one (B, N*8) x (N*8, 32) dot over an
-    expanded bit matrix.  XLA fuses the `(data >> k) & 1` plane
-    extraction into each dot's operand read, so the 8x bit expansion is
-    never materialized in HBM: traffic is 8 streaming reads of the raw
-    bytes (64 MB for 128x64KB) and the kernel runs at the bandwidth
-    floor — measured 0.07-0.08 ms for 8 MB on v5e-1 (~100 GB/s), 10x
-    the r2/r3 single-dot form whose (B, N*8) int8 operand cost 128 MB
-    of HBM round trip plus a badly tiled K=524288 contraction."""
+@lru_cache(maxsize=8)
+def _mxu_rows_fn(N: int = _MXU_BLOCK, poly: str = "crc32c"):
+    """The un-jitted plane-split kernel body (data (B, N) uint8 left-
+    padded, terms (B,) uint32) -> (B,) uint32 — shape-polymorphic in B.
+    Shared by :func:`_jit_mxu` (whole-device launches) and the mesh
+    shard_map step (parallel/mesh.py sharded_crc_step), so the sharded
+    per-chip computation is EXACTLY the single-device kernel applied to
+    that chip's row shard — bit-exact by construction."""
     Qp = np.ascontiguousarray(
         _q_matrix(N, poly).reshape(N, 8, 32).transpose(1, 0, 2))
     Qk = [jnp.asarray(Qp[k]) for k in range(8)]     # (N, 32) int8 each
@@ -280,20 +277,28 @@ def _jit_mxu(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c"):
                       axis=1, dtype=_U32)
         return ~(raw ^ terms)
 
-    return jax.jit(fn)
+    return fn
 
 
 @lru_cache(maxsize=16)
-def _jit_mxu_fused(B: int, N: int = _MXU_BLOCK):
-    """Fused multi-polynomial launch kernel (ISSUE 3 tentpole #4):
-    crc32c and legacy-crc32 rows of the SAME padded (B, N) launch,
-    selected per row.  Both Q matrices ride the same eight bit-plane
-    dots (the operand read — the bandwidth floor the plane-split kernel
-    runs at — is shared; only the 32-column accumulate doubles, a
-    rounding error against the (B, N) stream), so a mixed v2/legacy
-    fetch response costs ONE launch instead of two.  Bit-exact by
-    construction: each row's result is exactly the single-poly kernel's
-    for its polynomial."""
+def _jit_mxu(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c"):
+    """Plane-split MXU kernel (r4): EIGHT (B, N) x (N, 32) int8 dots —
+    one per bit plane — instead of one (B, N*8) x (N*8, 32) dot over an
+    expanded bit matrix.  XLA fuses the `(data >> k) & 1` plane
+    extraction into each dot's operand read, so the 8x bit expansion is
+    never materialized in HBM: traffic is 8 streaming reads of the raw
+    bytes (64 MB for 128x64KB) and the kernel runs at the bandwidth
+    floor — measured 0.07-0.08 ms for 8 MB on v5e-1 (~100 GB/s), 10x
+    the r2/r3 single-dot form whose (B, N*8) int8 operand cost 128 MB
+    of HBM round trip plus a badly tiled K=524288 contraction."""
+    return jax.jit(_mxu_rows_fn(N, poly))
+
+
+@lru_cache(maxsize=8)
+def _mxu_fused_rows_fn(N: int = _MXU_BLOCK):
+    """Un-jitted fused multi-poly body (data, terms, sel) -> (B,)
+    uint32, shape-polymorphic in B — shared by :func:`_jit_mxu_fused`
+    and the mesh shard_map step exactly like :func:`_mxu_rows_fn`."""
     Qc = np.ascontiguousarray(
         _q_matrix(N, "crc32c").reshape(N, 8, 32).transpose(1, 0, 2))
     Ql = np.ascontiguousarray(
@@ -322,7 +327,21 @@ def _jit_mxu_fused(B: int, N: int = _MXU_BLOCK):
         raw = jnp.where(sel != 0, raw_l, raw_c)
         return ~(raw ^ terms)
 
-    return jax.jit(fn)
+    return fn
+
+
+@lru_cache(maxsize=16)
+def _jit_mxu_fused(B: int, N: int = _MXU_BLOCK):
+    """Fused multi-polynomial launch kernel (ISSUE 3 tentpole #4):
+    crc32c and legacy-crc32 rows of the SAME padded (B, N) launch,
+    selected per row.  Both Q matrices ride the same eight bit-plane
+    dots (the operand read — the bandwidth floor the plane-split kernel
+    runs at — is shared; only the 32-column accumulate doubles, a
+    rounding error against the (B, N) stream), so a mixed v2/legacy
+    fetch response costs ONE launch instead of two.  Bit-exact by
+    construction: each row's result is exactly the single-poly kernel's
+    for its polynomial."""
+    return jax.jit(_mxu_fused_rows_fn(N))
 
 
 # ------------------------------------------------- warmup / readiness ------
@@ -333,43 +352,86 @@ def _jit_mxu_fused(B: int, N: int = _MXU_BLOCK):
 # throwaway execution) falling back to the jitted fn itself when the
 # AOT API is unavailable; storing the executable also makes readiness
 # immune to lru_cache eviction of _jit_mxu.
-_READY: dict[tuple[int, int, str], object] = {}
+#
+# ISSUE 6 makes the registry PER-DEVICE: an AOT executable is bound to
+# the device it was lowered for, so the mesh-sharded engine's dispatch
+# lanes each need their own warmed copy — keys carry the device id and
+# the warmup sweep compiles every bucket on every lane.  ``device=None``
+# means the process-default device (jax.devices()[0], id 0 on every
+# supported platform), keeping the pre-mesh callers' view intact.
+_READY: dict[tuple[int, int, str, int], object] = {}
 _READY_LOCK = threading.Lock()
 
 
-def kernel_ready(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c") -> bool:
-    """True once the (B, N, poly) bucket kernel is compiled
-    (poly: 'crc32c' | 'crc32' | 'fused')."""
-    return (B, N, poly) in _READY
+def _dev_key(device) -> int:
+    """Registry device component: a Device object's id, a raw int id,
+    or 0 for None (the process-default device) — resolved WITHOUT
+    importing jax so stats-emitter callers stay light."""
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    return device.id
 
 
-def ready_kernel(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c"):
-    """The warmed compiled executable for a bucket, or None."""
-    return _READY.get((B, N, poly))
+def kernel_ready(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c",
+                 device=None) -> bool:
+    """True once the (B, N, poly) bucket kernel is compiled for
+    ``device`` (poly: 'crc32c' | 'crc32' | 'fused')."""
+    return (B, N, poly, _dev_key(device)) in _READY
 
 
-def warm_kernel(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c") -> None:
-    """Compile the (B, N, poly) bucket kernel and mark it ready.
-    Idempotent; safe from any thread (the engine's background warmup
-    thread is the intended caller)."""
-    key = (B, N, poly)
+def ready_kernel(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c",
+                 device=None):
+    """The warmed compiled executable for a bucket on a device, or
+    None."""
+    return _READY.get((B, N, poly, _dev_key(device)))
+
+
+def warm_bucket_count(device=None) -> int:
+    """How many (B, N, poly) buckets are warm on ``device`` — the
+    per-device ``warm_buckets`` gauge of codec_engine.devices[]."""
+    dk = _dev_key(device)
+    with _READY_LOCK:
+        return sum(1 for k in _READY if k[3] == dk)
+
+
+def warm_kernel(B: int, N: int = _MXU_BLOCK, poly: str = "crc32c",
+                device=None) -> None:
+    """Compile the (B, N, poly) bucket kernel for ``device`` and mark
+    it ready.  Idempotent; safe from any thread (the engine's
+    background warmup thread is the intended caller).  Per-device AOT
+    rides ShapeDtypeStruct shardings (SingleDeviceSharding) so the
+    executable is lowered for the target chip; when that API is
+    unavailable the fallback executes zeros placed on the device."""
+    key = (B, N, poly, _dev_key(device))
     if key in _READY:
         return
     fused = poly == "fused"
     fn = _jit_mxu_fused(B, N) if fused else _jit_mxu(B, N, poly)
-    d = jax.ShapeDtypeStruct((B, N), jnp.uint8)
-    t = jax.ShapeDtypeStruct((B,), jnp.uint32)
-    args = (d, t, jax.ShapeDtypeStruct((B,), jnp.uint32)) if fused \
-        else (d, t)
+    sds_kw = {}
+    if device is not None and not isinstance(device, int):
+        try:
+            from jax.sharding import SingleDeviceSharding
+            sds_kw = {"sharding": SingleDeviceSharding(device)}
+        except Exception:
+            sds_kw = {}
+    d = jax.ShapeDtypeStruct((B, N), jnp.uint8, **sds_kw)
+    t = jax.ShapeDtypeStruct((B,), jnp.uint32, **sds_kw)
+    args = (d, t, jax.ShapeDtypeStruct((B,), jnp.uint32, **sds_kw)) \
+        if fused else (d, t)
     try:
         exe = fn.lower(*args).compile()
     except Exception:
-        # no AOT path in this jax: compile by executing zeros once
+        # no AOT path in this jax: compile by executing zeros once,
+        # placed on the target device so the jit cache entry matches
+        dev = device if device is not None and not isinstance(device, int) \
+            else None
         data = np.zeros((B, N), dtype=np.uint8)
         terms = np.zeros((B,), dtype=np.uint32)
         cargs = ((data, terms, np.zeros((B,), np.uint32)) if fused
                  else (data, terms))
-        np.asarray(fn(*(jax.device_put(a) for a in cargs)))
+        np.asarray(fn(*(jax.device_put(a, dev) for a in cargs)))
         exe = fn
     with _READY_LOCK:
         _READY[key] = exe

@@ -62,6 +62,35 @@ policy layer:
 
 Every route is bit-identical by construction; the governor only moves
 WHERE a checksum is computed, never WHAT it is.
+
+The MESH-SHARDED DISPATCH LANES (ISSUE 6) spread the engine across
+every healthy chip instead of parking 7/8 of them behind the default
+device:
+
+  * **Per-device lanes**: each mesh device owns a ``_Lane`` — its own
+    persistent ``_Staging`` ring (fills never cross chips) and its own
+    in-flight launch deque honoring ``depth`` per lane, so eight chips
+    sustain eight pipelines instead of sharing one.
+  * **Whole-to-one-lane routing**: a fused launch group below the
+    shard threshold goes entirely to the least-loaded lane (fewest
+    in-flight launches, then the lane's per-bucket launch-time EWMA,
+    then total launches — spreading cold lanes first).
+  * **Sharded launches**: a group spanning a mesh multiple
+    (``SHARD_MIN_ROWS`` blocks per device) is laid out contiguously
+    and shard_mapped over the 1-D batch mesh
+    (parallel/mesh.py sharded_crc_step) so every chip checksums its
+    row shard concurrently — the single biggest raw-speed multiplier
+    (ROADMAP item 1).
+  * **Mesh-aware governor**: launch-time EWMAs are per (device,
+    bucket); routing compares the BEST device estimate against the CPU
+    model, lane selection prefers the measured-faster chip, and the
+    background warmup AOT-compiles every bucket on every device
+    (device 0 first, so routes open exactly as fast as before) plus
+    the sharded steps for the standard buckets.
+
+Wire bytes stay bit-identical on every route: sharding only moves
+WHERE each 64KB block's CRC runs — the block split, left-padding,
+GF(2) affine term and host-side combine are untouched.
 """
 from __future__ import annotations
 
@@ -177,7 +206,7 @@ class _Launch:
     """One in-flight device launch awaiting readback."""
 
     __slots__ = ("kind", "jobs", "spans", "outs", "chunk_lens",
-                 "ticket", "out_tree", "t0", "bucket")
+                 "ticket", "out_tree", "t0", "bucket", "lane", "sharded")
 
     def __init__(self, kind):
         self.kind = kind
@@ -189,6 +218,31 @@ class _Launch:
         self.out_tree = None
         self.t0: Optional[float] = None          # launch wall-clock start
         self.bucket: Optional[int] = None        # padded B of first chunk
+        self.lane: Optional["_Lane"] = None      # dispatch lane (ISSUE 6)
+        self.sharded = False                     # shard_map'd over the mesh
+
+
+class _Lane:
+    """One per-device dispatch lane (ISSUE 6): the device, its private
+    staging rings (a fill for lane k never races another lane's
+    in-flight transfer), its own in-flight launch deque honoring the
+    engine ``depth``, and per-device observability counters feeding
+    ``codec_engine.devices[]``.  The whole-mesh sharded launches ride a
+    pseudo-lane (``dev_id == -1``) with the same depth discipline."""
+
+    __slots__ = ("dev_id", "device", "staging", "inflight", "launches",
+                 "blocks", "jobs", "launch_avg")
+
+    def __init__(self, dev_id: int, device, staging: "_Staging",
+                 launch_avg):
+        self.dev_id = dev_id
+        self.device = device            # jax Device (None: mesh lane)
+        self.staging = staging
+        self.inflight: deque = deque()  # _Launch records, oldest first
+        self.launches = 0
+        self.blocks = 0
+        self.jobs = 0
+        self.launch_avg = launch_avg    # per-device stage_latency window
 
 
 class _Governor:
@@ -199,8 +253,14 @@ class _Governor:
       * ``interarrival_s`` — CRC submission inter-arrival time, updated
         by submitter threads under the engine lock; sizes the fan-in
         window.
-      * ``dev_launch_s[bucket]`` — per-bucket device launch latency
-        (dispatch → readback complete), updated on the dispatch thread.
+      * ``dev_launch_s[(device, bucket)]`` — per-device per-bucket
+        launch latency (dispatch → readback complete), updated on the
+        dispatch thread.  Mesh-aware (ISSUE 6): routing compares the
+        BEST device's estimate against the CPU model, and the engine's
+        lane selection uses the per-lane estimate, so a slow or cold
+        chip neither poisons the route decision nor hides behind a
+        fast one.  A sharded launch records under every participating
+        device (the whole mesh was busy for that window).
       * ``cpu_ns_per_byte`` — the CPU provider's observed checksum
         rate, updated whenever the engine serves a group on CPU.
 
@@ -222,7 +282,8 @@ class _Governor:
         self.interarrival_s: Optional[float] = None
         self._last_submit: Optional[float] = None
         self.cpu_ns_per_byte: Optional[float] = None
-        self.dev_launch_s: dict[int, float] = {}
+        # (device id, bucket B) -> launch-time EWMA seconds
+        self.dev_launch_s: dict[tuple[int, int], float] = {}
         self._since_explore = 0
 
     def _ewma(self, old: Optional[float], v: float) -> float:
@@ -249,10 +310,28 @@ class _Governor:
             return 0.0
         return min(cap, 2.0 * max(1, need) * ia)
 
-    def note_device(self, bucket: Optional[int], dt: float) -> None:
+    def note_device(self, bucket: Optional[int], dt: float,
+                    dev: int = 0) -> None:
         if bucket is not None:
-            self.dev_launch_s[bucket] = self._ewma(
-                self.dev_launch_s.get(bucket), dt)
+            key = (dev, bucket)
+            self.dev_launch_s[key] = self._ewma(
+                self.dev_launch_s.get(key), dt)
+
+    def lane_device_s(self, dev: int, bucket: int) -> Optional[float]:
+        """The (device, bucket) launch-time estimate — lane selection's
+        tie-break (None: the lane hasn't run this bucket yet)."""
+        return self.dev_launch_s.get((dev, bucket))
+
+    def best_device_s(self, bucket: int) -> Optional[float]:
+        """The fastest known device estimate for a bucket — what the
+        CPU-vs-device route decision compares against (the engine will
+        pick that lane, or a less-loaded one that can only be busy
+        because it is also making progress)."""
+        best = None
+        for (d, b), s in self.dev_launch_s.items():
+            if b == bucket and (best is None or s < best):
+                best = s
+        return best
 
     def note_cpu(self, nbytes: int, dt: float) -> None:
         if nbytes > 0:
@@ -263,7 +342,7 @@ class _Governor:
         """('device'|'cpu', explored) for an at-quorum group.  Unknown
         estimates prefer the device — exactly the static policy — so
         configs without governor history behave identically."""
-        dev = self.dev_launch_s.get(bucket)
+        dev = self.best_device_s(bucket)
         cpu = self.cpu_ns_per_byte
         if dev is None or cpu is None:
             return "device", False
@@ -275,7 +354,14 @@ class _Governor:
         return pick, False
 
     def snapshot(self) -> dict:
-        """JSON-ready gauges for the statistics blob."""
+        """JSON-ready gauges for the statistics blob.  dev_launch_ms
+        keeps its pre-mesh shape — the best (fastest) device estimate
+        per bucket; the full per-device split rides
+        codec_engine.devices[]."""
+        best: dict[int, float] = {}
+        for (d, b), s in self.dev_launch_s.items():
+            if b not in best or s < best[b]:
+                best[b] = s
         return {
             "enabled": self.enabled,
             "interarrival_us": (None if self.interarrival_s is None
@@ -283,8 +369,14 @@ class _Governor:
             "cpu_ns_per_byte": (None if self.cpu_ns_per_byte is None
                                 else round(self.cpu_ns_per_byte, 3)),
             "dev_launch_ms": {str(b): round(s * 1e3, 3)
-                              for b, s in sorted(self.dev_launch_s.items())},
+                              for b, s in sorted(best.items())},
         }
+
+    def device_launch_ms(self, dev: int) -> dict:
+        """One device's {bucket: ms} EWMAs (codec_engine.devices[])."""
+        return {str(b): round(s * 1e3, 3)
+                for (d, b), s in sorted(self.dev_launch_s.items())
+                if d == dev}
 
 
 class AsyncOffloadEngine:
@@ -297,14 +389,19 @@ class AsyncOffloadEngine:
     #: tile, so B is always one of exactly these three
     WARM_BUCKETS = (64, 128, 256)
     WARM_KINDS = ("crc32c", "crc32", "fused")
+    #: minimum blocks PER DEVICE before a group splits across the mesh
+    #: (below it, whole-to-one-lane beats the scatter/gather overhead)
+    SHARD_MIN_ROWS = 8
 
     def __init__(self, *, depth: int = 2, fanin_window_s: float = 0.0005,
                  min_batches: int = 4,
                  cpu_fallback: Optional[Callable] = None,
                  name: str = "tpu-engine",
                  governor: bool = True, warmup: bool = False,
-                 compile_cache_dir: Optional[str] = None):
-        # depth: launches kept in flight before the oldest is read back
+                 compile_cache_dir: Optional[str] = None,
+                 mesh_devices: int = 0):
+        # depth: launches kept in flight PER LANE before that lane's
+        # oldest is read back
         self.depth = max(1, int(depth))
         self.fanin_window_s = max(0.0, float(fanin_window_s))
         self.min_batches = max(1, int(min_batches))
@@ -317,14 +414,23 @@ class AsyncOffloadEngine:
         # keeps the old behavior (dispatch thread compiles inline)
         self.warmup_enabled = bool(warmup) and cpu_fallback is not None
         self.compile_cache_dir = compile_cache_dir or None
+        # tpu.mesh.devices: how many devices to spread dispatch lanes
+        # over — 0 = every visible device, 1 = the pre-mesh single-lane
+        # engine.  Lanes resolve lazily on the dispatch/warmup thread
+        # (jax stays unimported for host-only workloads).
+        self.mesh_devices = int(mesh_devices)
+        self._lanes: list[_Lane] = []
+        self._shard_lane: Optional[_Lane] = None
+        self._lanes_ready = False
+        self._lanes_lock = threading.Lock()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: deque[_Job] = deque()
         self._closed = False
-        self._staging = _Staging(copies=self.depth + 1)
-        # (B, kind) buckets the dispatch thread missed on — the warmup
-        # thread compiles these before continuing its sweep
-        self._warm_requests: deque[tuple[int, str]] = deque()
+        # warm items the dispatch thread missed on — the warmup thread
+        # compiles these before continuing its sweep; items are
+        # ("kernel", B, kind, dev_id) or ("shard", Bs, kind)
+        self._warm_requests: deque[tuple] = deque()
         # observability (PERF.md pipeline section + governor counters)
         self.stats = {"launches": 0, "blocks": 0, "jobs": 0,
                       "aggregated": 0, "cpu_fallback_jobs": 0,
@@ -332,7 +438,9 @@ class AsyncOffloadEngine:
                       # governor decisions (ISSUE 3)
                       "fanin_skips": 0, "warmup_miss_jobs": 0,
                       "warmup_compiled": 0, "routed_cpu_jobs": 0,
-                      "explore_routes": 0, "fused_launches": 0}
+                      "explore_routes": 0, "fused_launches": 0,
+                      # mesh-sharded dispatch (ISSUE 6)
+                      "sharded_launches": 0}
         # per-stage latency decomposition (ISSUE 5): windowed
         # HdrHistogram Avgs feeding codec_engine.stage_latency in the
         # stats JSON — submit->launch wait, launch->readback (device),
@@ -341,6 +449,7 @@ class AsyncOffloadEngine:
         # no cycle, but keeping it out of module scope lets
         # `import librdkafka_tpu.ops.engine` stay light.
         from ..client.stats import Avg
+        self._Avg = Avg                 # lanes build their own windows
         self.stage_submit_wait = Avg()
         self.stage_launch = Avg()
         self.stage_reap = Avg()
@@ -404,10 +513,14 @@ class AsyncOffloadEngine:
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop the dispatch thread.  Outstanding work drains
-        deterministically: queued + in-flight jobs are completed by the
-        exiting thread, and anything it could not reach (a wedged or
-        crashed dispatch thread, or a join timeout) is FAILED rather
-        than left to hang its waiter forever in Ticket.result()."""
+        deterministically — PER LANE: every lane's queued + in-flight
+        launches are completed by the exiting thread (the _main finally
+        sweeps each lane's deque), and anything it could not reach (a
+        wedged or crashed dispatch thread, or a join timeout) is FAILED
+        rather than left to hang its waiter forever in
+        Ticket.result().  A multi-lane engine also releases the mesh
+        module's compiled sharded steps (the close-time hook the
+        conftest leak fixture asserts)."""
         with self._cond:
             self._closed = True
             self._cond.notify()
@@ -417,6 +530,11 @@ class AsyncOffloadEngine:
             # compile in progress finishes (it cannot be cancelled) and
             # the thread exits — deterministic drain, no leak
             self._warmup_thread.join(timeout)
+        if self._shard_lane is not None:
+            import sys
+            mesh_mod = sys.modules.get("librdkafka_tpu.parallel.mesh")
+            if mesh_mod is not None:
+                mesh_mod.release_step_cache()
         if self._thread.is_alive():
             # join timed out: the dispatch thread is wedged (e.g. a hung
             # device launch).  Fail every job still visible so waiters
@@ -430,14 +548,16 @@ class AsyncOffloadEngine:
                 j.ticket._fail(exc)
 
     def warm_wait(self, B: int, poly: str = "crc32c",
-                  timeout: float = 120.0) -> bool:
+                  timeout: float = 120.0, device=None) -> bool:
         """Block until the (B, 64KB, poly) kernel bucket is compiled
-        (test/bench hook); returns False on timeout."""
+        for ``device`` (default: the default device / lane 0 — the
+        first the sweep warms); test/bench hook; returns False on
+        timeout."""
         from .crc32c_jax import _MXU_BLOCK, kernel_ready
         deadline = time.monotonic() + timeout
-        while not kernel_ready(B, _MXU_BLOCK, poly):
+        while not kernel_ready(B, _MXU_BLOCK, poly, device=device):
             if time.monotonic() >= deadline or self._closed:
-                return kernel_ready(B, _MXU_BLOCK, poly)
+                return kernel_ready(B, _MXU_BLOCK, poly, device=device)
             time.sleep(0.02)
         return True
 
@@ -452,11 +572,15 @@ class AsyncOffloadEngine:
     def stage_latency_snapshot(self) -> dict:
         """Per-stage windowed latency decomposition for the stats JSON
         (codec_engine.stage_latency, STATISTICS.md): submit->launch
-        wait, launch->readback (device round trip) and the host-side
-        reap.  Rolls the windows over, like every rd_avg_t emit."""
+        wait, launch->readback (device round trip), the host-side reap,
+        and the per-device launch split (``launch_dev``, keyed by
+        device id) so launch latency is attributable per chip.  Rolls
+        the windows over, like every rd_avg_t emit."""
         return {"submit_wait": self.stage_submit_wait.rollover(),
                 "launch": self.stage_launch.rollover(),
-                "reap": self.stage_reap.rollover()}
+                "reap": self.stage_reap.rollover(),
+                "launch_dev": {str(ln.dev_id): ln.launch_avg.rollover()
+                               for ln in self._lanes}}
 
     def gauges_snapshot(self) -> dict:
         """Instantaneous pipeline-occupancy gauges (codec_engine.gauges):
@@ -467,20 +591,92 @@ class AsyncOffloadEngine:
                 "inflight_launches": self._inflight_cnt,
                 "fanin_occupancy": self._fanin_last}
 
+    def devices_snapshot(self) -> list:
+        """Per-device lane gauges for the statistics JSON
+        (codec_engine.devices[], STATISTICS.md): launch/block/job
+        counts, in-flight depth, the governor's per-bucket launch-time
+        EWMAs and the warm-kernel count for each mesh device.  Empty
+        until the first launch resolves the lanes.  Never imports jax
+        (sys.modules guard) — safe from the stats emitter."""
+        import sys
+        cj = sys.modules.get("librdkafka_tpu.ops.crc32c_jax")
+        out = []
+        for ln in self._lanes:
+            out.append({
+                "id": ln.dev_id,
+                "launches": ln.launches,
+                "blocks": ln.blocks,
+                "jobs": ln.jobs,
+                "inflight": len(ln.inflight),
+                "dev_launch_ms": self.governor.device_launch_ms(
+                    ln.dev_id),
+                "warm_buckets": (cj.warm_bucket_count(ln.dev_id)
+                                 if cj is not None else 0),
+            })
+        return out
+
+    # ------------------------------------------------------------- lanes --
+    def _get_lanes(self) -> list:
+        """Resolve the per-device dispatch lanes (dispatch/warmup
+        thread only — imports jax).  mesh_devices=0 takes every visible
+        device; a >1 lane count also creates the whole-mesh pseudo-lane
+        that tracks sharded launches."""
+        if self._lanes_ready:
+            return self._lanes
+        with self._lanes_lock:
+            if self._lanes_ready:
+                return self._lanes
+            import jax
+            devs = jax.devices()
+            n = (len(devs) if self.mesh_devices <= 0
+                 else min(self.mesh_devices, len(devs)))
+            lanes = [_Lane(d.id, d, _Staging(copies=self.depth + 1),
+                           self._Avg()) for d in devs[:n]]
+            if n > 1:
+                self._shard_lane = _Lane(
+                    -1, None, _Staging(copies=self.depth + 1),
+                    self._Avg())
+            self._lanes = lanes
+            self._lanes_ready = True
+        return self._lanes
+
+    def _all_lanes(self) -> list:
+        return (self._lanes + [self._shard_lane]
+                if self._shard_lane is not None else self._lanes)
+
+    def _inflight_total(self) -> int:
+        return sum(len(ln.inflight) for ln in self._all_lanes())
+
+    def _oldest_lane(self) -> Optional["_Lane"]:
+        """The lane holding the oldest in-flight launch (drain order:
+        by dispatch time across lanes, so no lane's results are held
+        hostage behind a busier one)."""
+        best = None
+        for ln in self._all_lanes():
+            if not ln.inflight:
+                continue
+            if best is None or ((ln.inflight[0].t0 or 0.0)
+                                < (best.inflight[0].t0 or 0.0)):
+                best = ln
+        return best
+
     # ----------------------------------------------------- warmup thread --
-    def _request_warm(self, B: int, kind: str) -> None:
+    def _request_warm(self, item: tuple) -> None:
         """Dispatch-thread side: a launch missed this bucket — move it
-        to the front of the warmup queue."""
+        to the front of the warmup queue.  ``item`` is
+        ("kernel", B, kind, dev_id) or ("shard", Bs, kind)."""
         with self._lock:
-            if (B, kind) not in self._warm_requests:
-                self._warm_requests.append((B, kind))
+            if item not in self._warm_requests:
+                self._warm_requests.append(item)
 
     def _warmup_main(self):
         """Low-priority sweep compiling every (B, 64KB) bucket for both
-        polynomials + the fused variant, smallest first (short compiles
-        open routes early and keep close() joins snappy); buckets the
-        dispatch thread actually missed on jump the queue.  Exits when
-        the sweep is complete or the engine closes."""
+        polynomials + the fused variant ON EVERY LANE (device 0 first,
+        so routes open exactly as fast as the single-device sweep did,
+        then the remaining chips fill in), followed by the sharded
+        whole-mesh steps for the standard buckets; items the dispatch
+        thread actually missed on jump the queue.  Exits when the
+        sweep is complete or the engine closes."""
         try:
             if self.compile_cache_dir:
                 # persistent compile cache: kernels compile once per
@@ -501,8 +697,18 @@ class AsyncOffloadEngine:
                 except Exception:
                     pass
             from .crc32c_jax import _MXU_BLOCK, kernel_ready, warm_kernel
-            sweep = [(B, kind) for B in self.WARM_BUCKETS
-                     for kind in self.WARM_KINDS]
+            lanes = self._get_lanes()
+            by_id = {ln.dev_id: ln for ln in lanes}
+            sweep: list[tuple] = [("kernel", B, kind, ln.dev_id)
+                                  for ln in lanes
+                                  for B in self.WARM_BUCKETS
+                                  for kind in self.WARM_KINDS]
+            if len(lanes) > 1:
+                # whole-mesh sharded steps for the standard per-shard
+                # buckets; odd shapes warm on demand via requests
+                sweep += [("shard", Bs, kind)
+                          for Bs in self.WARM_BUCKETS
+                          for kind in self.WARM_KINDS]
             i = 0
             while not self._closed:
                 with self._lock:
@@ -513,11 +719,26 @@ class AsyncOffloadEngine:
                         return
                     item = sweep[i]
                     i += 1
-                B, kind = item
-                if kernel_ready(B, _MXU_BLOCK, kind):
-                    continue
                 try:
-                    warm_kernel(B, _MXU_BLOCK, kind)
+                    if item[0] == "kernel":
+                        _, B, kind, dev_id = item
+                        if kernel_ready(B, _MXU_BLOCK, kind,
+                                        device=dev_id):
+                            continue
+                        lane = by_id.get(dev_id)
+                        warm_kernel(B, _MXU_BLOCK, kind,
+                                    device=(lane.device if lane
+                                            else None))
+                    else:
+                        _, Bs, kind = item
+                        from ..parallel import mesh as _mesh
+                        ids = [ln.dev_id for ln in lanes]
+                        if _mesh.sharded_crc_ready(ids, Bs, _MXU_BLOCK,
+                                                   kind):
+                            continue
+                        _mesh.warm_sharded_crc(
+                            [ln.device for ln in lanes], Bs,
+                            _MXU_BLOCK, kind)
                     self.stats["warmup_compiled"] += 1
                 except Exception:
                     # a failing compile must never kill warmup; the
@@ -528,35 +749,41 @@ class AsyncOffloadEngine:
 
     # ---------------------------------------------------- dispatch thread --
     def _main(self):
-        inflight: deque[_Launch] = deque()
         try:
-            self._main_loop(inflight)
+            self._main_loop()
         finally:
             # deterministic shutdown: whether the loop exited cleanly
             # (drained) or died on an unexpected error, no ticket may be
             # left unresolved — a parked _PendingFetch/_PendingCodec
-            # would otherwise block its thread forever in result()
+            # would otherwise block its thread forever in result().
+            # Every LANE fail-or-drains (the PR-2 semantics, per lane):
+            # in-flight launches of chip k fail exactly like the
+            # single-device engine's did.
             with self._cond:
                 stranded = self._pop_jobs_locked()
             exc = RuntimeError("offload engine dispatch thread exited")
             for j in stranded:
                 j.ticket._fail(exc)
-            for rec in inflight:
-                if rec.kind == "crc":
-                    for j in rec.jobs:
-                        j.ticket._fail(exc)
-                elif rec.ticket is not None:
-                    rec.ticket._fail(exc)
+            for lane in self._all_lanes():
+                for rec in lane.inflight:
+                    if rec.kind == "crc":
+                        for j in rec.jobs:
+                            j.ticket._fail(exc)
+                    elif rec.ticket is not None:
+                        rec.ticket._fail(exc)
+                lane.inflight.clear()
 
-    def _main_loop(self, inflight: deque):
+    def _main_loop(self):
         while True:
             with self._cond:
                 if not self._queue and not self._closed:
                     # with launches in flight, linger only briefly: a
                     # pipelining submitter's NEXT job should launch
                     # before the oldest readback blocks this thread
-                    self._cond.wait(timeout=0.0002 if inflight else None)
-                if self._closed and not self._queue and not inflight:
+                    self._cond.wait(
+                        timeout=0.0002 if self._inflight_total() else None)
+                if (self._closed and not self._queue
+                        and not self._inflight_total()):
                     return
                 jobs = self._pop_jobs_locked()
             if jobs:
@@ -564,19 +791,22 @@ class AsyncOffloadEngine:
                 for group in self._group(jobs):
                     rec = self._launch(group)
                     if rec is not None:
-                        inflight.append(rec)
-                    # pipeline full: sync the oldest — the newer
-                    # launches keep executing on the device meanwhile
-                    while len(inflight) > self.depth:
-                        self._inflight_cnt = len(inflight)
-                        self._readback(inflight.popleft())
-                self._inflight_cnt = len(inflight)
+                        lane = rec.lane
+                        lane.inflight.append(rec)
+                        # lane pipeline full: sync that lane's oldest —
+                        # every other lane's launches keep executing on
+                        # their chips meanwhile
+                        while len(lane.inflight) > self.depth:
+                            self._inflight_cnt = self._inflight_total()
+                            self._readback(lane.inflight.popleft())
+                    self._inflight_cnt = self._inflight_total()
                 continue            # re-check the queue before syncing
-            if inflight:
+            lane = self._oldest_lane()
+            if lane is not None:
                 # nothing new queued: drain completed work rather than
                 # hold results hostage waiting for more submissions
-                self._readback(inflight.popleft())
-                self._inflight_cnt = len(inflight)
+                self._readback(lane.inflight.popleft())
+                self._inflight_cnt = self._inflight_total()
 
     def _pop_jobs_locked(self) -> list[_Job]:
         jobs = list(self._queue)
@@ -686,6 +916,10 @@ class AsyncOffloadEngine:
     def _launch_compute(self, job: _Job) -> _Launch:
         rec = _Launch("compute")
         rec.ticket = job.ticket
+        # compute fns place their own arrays; track the launch on lane
+        # 0 (the default device) for depth accounting and drain order
+        rec.lane = self._get_lanes()[0]
+        rec.t0 = time.perf_counter()
         rec.out_tree = job.fn(*job.args)     # async dispatch
         return rec
 
@@ -726,6 +960,32 @@ class AsyncOffloadEngine:
             shapes.append(B)
         return shapes
 
+    @staticmethod
+    def _shard_bucket(nrows: int, ndev: int) -> int:
+        """Per-shard padded row count for a sharded chunk of ``nrows``
+        blocks over ``ndev`` devices.  The pow2 floor is
+        SHARD_MIN_ROWS, not the whole-device 64 (a 64-row-per-chip
+        floor would stage up to 32 MB of zeros for a small split);
+        the 128-row MXU tile floor still applies once a shard fills
+        64+ rows, exactly like the whole-device buckets."""
+        from .packing import next_pow2
+        rows = -(-nrows // ndev)
+        Bs = next_pow2(rows, lo=AsyncOffloadEngine.SHARD_MIN_ROWS)
+        if rows >= 64:
+            Bs = max(Bs, 128)       # MXU tile floor (crc32c_jax.py)
+        return Bs
+
+    def _pick_lane(self, lanes: list, bucket: Optional[int]) -> "_Lane":
+        """Least-loaded whole-group lane pick: fewest in-flight
+        launches first, then the governor's per-device launch-time
+        EWMA for this bucket (unknown sorts first — cold chips get
+        measured), then total launches (round-robin among equals)."""
+        return min(lanes, key=lambda ln: (
+            len(ln.inflight),
+            self.governor.lane_device_s(ln.dev_id, bucket) or 0.0
+            if bucket is not None else 0.0,
+            ln.launches))
+
     def _launch_crc(self, group: list[_Job]) -> Optional[_Launch]:
         from .crc32c_jax import (_MXU_BLOCK, _MXU_MAX_B, _term_host,
                                  kernel_ready, ready_kernel)
@@ -761,17 +1021,51 @@ class AsyncOffloadEngine:
         mixed = len(polys) > 1
         shapes = self._bucket_shapes(len(blocks))
         kinds = ("fused",) if mixed else tuple(polys)
-        if self.warmup_enabled:
-            # warmup gate: an unwarmed bucket must not stall this
-            # thread behind an XLA compile — CPU serves it and the
-            # missed shape jumps the warmup queue
-            missing = [(B, k) for B in set(shapes) for k in kinds
-                       if not kernel_ready(B, blk, k)]
+
+        lanes = self._get_lanes()
+        ndev = len(lanes)
+        # sharded route (ISSUE 6): a group spanning a mesh multiple
+        # splits over every chip via shard_map — bit-identical, only
+        # WHERE each block's CRC runs changes
+        shard = (ndev > 1
+                 and len(blocks) >= ndev * self.SHARD_MIN_ROWS)
+        shard_cap = _MXU_MAX_B * ndev
+        if shard and self.warmup_enabled:
+            from ..parallel.mesh import sharded_crc_ready
+            ids = [ln.dev_id for ln in lanes]
+            sbuckets = {self._shard_bucket(
+                min(shard_cap, len(blocks) - s), ndev)
+                for s in range(0, len(blocks), shard_cap)}
+            missing = [(Bs, k) for Bs in sbuckets for k in kinds
+                       if not sharded_crc_ready(ids, Bs, blk, k)]
             if missing:
-                for B, k in missing:
-                    self._request_warm(B, k)
-                self._serve_cpu(group, "warmup_miss_jobs")
-                return None
+                # the sharded step is still compiling: fall back to
+                # whole-to-one-lane (never stall), ask for the step
+                for Bs, k in missing:
+                    self._request_warm(("shard", Bs, k))
+                shard = False
+        lane = None
+        if not shard:
+            if self.warmup_enabled:
+                # warmup gate, per lane: route to any lane whose
+                # kernels are ALL warm; with none warm, CPU serves and
+                # the missed shapes jump the warmup queue (requested
+                # for the least-loaded lane first)
+                need = [(B, k) for B in set(shapes) for k in kinds]
+                ok = [ln for ln in lanes
+                      if all(kernel_ready(B, blk, k, device=ln.dev_id)
+                             for B, k in need)]
+                if not ok:
+                    want = self._pick_lane(
+                        lanes, shapes[0] if shapes else None)
+                    for B, k in need:
+                        self._request_warm(("kernel", B, k,
+                                            want.dev_id))
+                    self._serve_cpu(group, "warmup_miss_jobs")
+                    return None
+            else:
+                ok = lanes
+            lane = self._pick_lane(ok, shapes[0] if shapes else None)
         explored = False
         if self.governor.enabled and self.cpu_fallback is not None:
             nbytes = sum(len(b) for j in group for b in j.bufs)
@@ -787,7 +1081,11 @@ class AsyncOffloadEngine:
         rec = _Launch("crc")
         rec.jobs = group
         rec.spans = spans
-        rec.bucket = shapes[0] if shapes else None
+        rec.sharded = shard
+        rec.lane = self._shard_lane if shard else lane
+        rec.bucket = (self._shard_bucket(
+            min(shard_cap, len(blocks)), ndev) if shard
+            else (shapes[0] if shapes else None))
         # submit->launch wait: the queue + fan-in share of each job's
         # pipeline latency (codec_engine.stage_latency.submit_wait)
         t_launch = time.perf_counter()
@@ -802,16 +1100,49 @@ class AsyncOffloadEngine:
         self.stats["blocks"] += len(blocks)
         full_terms = {p: _term_host(blk, p) for p in polys}
 
+        if shard:
+            self.stats["sharded_launches"] += 1
+            self._launch_crc_sharded(rec, lanes, blocks, row_poly,
+                                     mixed, polys, full_terms)
+        else:
+            lane.launches += 1
+            lane.blocks += len(blocks)
+            lane.jobs += len(group)
+            self._launch_crc_lane(rec, lane, blocks, row_poly, mixed,
+                                  polys, full_terms)
+        if tr0:
+            # the async dispatch span; governor + lane decisions ride
+            # the args (device: lane id, or -1 for a whole-mesh
+            # sharded launch)
+            _trace.complete("engine", "device_launch", tr0,
+                            {"route": "device", "explored": explored,
+                             "fused": mixed, "bucket": rec.bucket,
+                             "blocks": len(blocks), "jobs": len(group),
+                             "device": rec.lane.dev_id,
+                             "sharded": shard})
+        return rec
+
+    def _launch_crc_lane(self, rec: _Launch, lane: "_Lane",
+                         blocks: list, row_poly: list, mixed: bool,
+                         polys: set, full_terms: dict) -> None:
+        """Whole-to-one-lane dispatch: every chunk of this group on
+        ``lane``'s device, staged from that lane's private rings."""
+        import jax
+
+        from .crc32c_jax import (_MXU_BLOCK, _MXU_MAX_B, _term_host,
+                                 ready_kernel)
+        from .packing import next_pow2
+        blk = _MXU_BLOCK
         for start in range(0, len(blocks), _MXU_MAX_B):
             chunk = blocks[start:start + _MXU_MAX_B]
             cpoly = row_poly[start:start + _MXU_MAX_B]
             B = next_pow2(len(chunk))
             if len(chunk) >= 64:
                 B = max(B, 128)     # MXU tile floor (crc32c_jax.py)
-            # persistent staging: one ring buffer per (B, blk) bucket,
-            # zeroed + row-filled in place (left pad: leading zeros are
-            # a CRC no-op under a zero register)
-            data = self._staging.take(B, blk)
+            # persistent staging: one ring buffer per (B, blk) bucket
+            # PER LANE, zeroed + row-filled in place (left pad: leading
+            # zeros are a CRC no-op under a zero register)
+            data = lane.staging.take(B, blk)
             terms = np.zeros((B,), dtype=np.uint32)
             for i, b in enumerate(chunk):
                 n = len(b)
@@ -820,34 +1151,77 @@ class AsyncOffloadEngine:
                             else _term_host(n, cpoly[i]))
             # async dispatch: device_put + kernel launch return
             # immediately; the readback (np.asarray) is the only sync.
-            # A warmed bucket rides its AOT-compiled executable.
-            d = jax.device_put(data)
-            t = jax.device_put(terms)
+            # A warmed bucket rides its per-device AOT executable.
+            d = jax.device_put(data, lane.device)
+            t = jax.device_put(terms, lane.device)
             if mixed:
                 sel = np.zeros((B,), dtype=np.uint32)
                 for i, p in enumerate(cpoly):
                     if p == "crc32":
                         sel[i] = 1
-                fn = ready_kernel(B, blk, "fused")
+                fn = ready_kernel(B, blk, "fused", device=lane.dev_id)
                 if fn is None:
                     from .crc32c_jax import _jit_mxu_fused
                     fn = _jit_mxu_fused(B, blk)
-                rec.outs.append(fn(d, t, jax.device_put(sel)))
+                rec.outs.append(fn(d, t,
+                                   jax.device_put(sel, lane.device)))
             else:
                 poly = next(iter(polys))
-                fn = ready_kernel(B, blk, poly)
+                fn = ready_kernel(B, blk, poly, device=lane.dev_id)
                 if fn is None:
                     from .crc32c_jax import _jit_mxu
                     fn = _jit_mxu(B, blk, poly)
                 rec.outs.append(fn(d, t))
             rec.chunk_lens.append(len(chunk))
-        if tr0:
-            # the async dispatch span; governor decision rides the args
-            _trace.complete("engine", "device_launch", tr0,
-                            {"route": "device", "explored": explored,
-                             "fused": mixed, "bucket": rec.bucket,
-                             "blocks": len(blocks), "jobs": len(group)})
-        return rec
+
+    def _launch_crc_sharded(self, rec: _Launch, lanes: list,
+                            blocks: list, row_poly: list, mixed: bool,
+                            polys: set, full_terms: dict) -> None:
+        """Whole-mesh dispatch: each chunk laid out (Bs * ndev, 64KB)
+        and shard_mapped so every chip checksums its contiguous
+        Bs-row shard concurrently (parallel/mesh.py sharded_crc_step).
+        Per-device counters record the shared launch on every lane."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import sharded_crc_step
+        from .crc32c_jax import _MXU_BLOCK, _MXU_MAX_B, _term_host
+        blk = _MXU_BLOCK
+        ndev = len(lanes)
+        devices = [ln.device for ln in lanes]
+        shard_cap = _MXU_MAX_B * ndev
+        for start in range(0, len(blocks), shard_cap):
+            chunk = blocks[start:start + shard_cap]
+            cpoly = row_poly[start:start + shard_cap]
+            Bs = self._shard_bucket(len(chunk), ndev)
+            Bt = Bs * ndev
+            data = self._shard_lane.staging.take(Bt, blk)
+            terms = np.zeros((Bt,), dtype=np.uint32)
+            for i, b in enumerate(chunk):
+                n = len(b)
+                data[i, blk - n:] = np.frombuffer(b, dtype=np.uint8)
+                terms[i] = (full_terms[cpoly[i]] if n == blk
+                            else _term_host(n, cpoly[i]))
+            kind = "fused" if mixed else next(iter(polys))
+            mesh, fn = sharded_crc_step(devices, Bs, blk, kind)
+            row = NamedSharding(mesh, P("batch"))
+            d = jax.device_put(data, NamedSharding(mesh,
+                                                   P("batch", None)))
+            t = jax.device_put(terms, row)
+            if mixed:
+                sel = np.zeros((Bt,), dtype=np.uint32)
+                for i, p in enumerate(cpoly):
+                    if p == "crc32":
+                        sel[i] = 1
+                rec.outs.append(fn(d, t, jax.device_put(sel, row)))
+            else:
+                rec.outs.append(fn(d, t))
+            rec.chunk_lens.append(len(chunk))
+            # per-lane share: contiguous row shards — device j owns
+            # global rows [j*Bs, (j+1)*Bs); count its live rows
+            for ji, ln in enumerate(lanes):
+                ln.launches += 1
+                ln.blocks += max(0, min(Bs, len(chunk) - ji * Bs))
 
     # ------------------------------------------------------------ readback --
     def _readback(self, rec: _Launch) -> None:
@@ -879,17 +1253,32 @@ class AsyncOffloadEngine:
         parts = [np.asarray(o).astype(np.uint32)[:n]
                  for o, n in zip(rec.outs, rec.chunk_lens)]
         crcs = parts[0] if len(parts) == 1 else np.concatenate(parts)
-        # launch latency feeds the governor's per-bucket device model
-        # AND the stage_latency.launch window (dispatch -> bulk sync)
+        # launch latency feeds the governor's per-(device, bucket)
+        # model AND the stage_latency.launch window (dispatch -> bulk
+        # sync); a sharded launch records under every participating
+        # chip — the whole mesh was busy for that window
         if rec.t0 is not None:
             dt = time.perf_counter() - rec.t0
-            self.governor.note_device(rec.bucket, dt)
+            if rec.sharded:
+                for ln in self._lanes:
+                    self.governor.note_device(rec.bucket, dt,
+                                              ln.dev_id)
+                    ln.launch_avg.add(dt * 1e6)
+            elif rec.lane is not None:
+                self.governor.note_device(rec.bucket, dt,
+                                          rec.lane.dev_id)
+                rec.lane.launch_avg.add(dt * 1e6)
+            else:
+                self.governor.note_device(rec.bucket, dt)
             self.stage_launch.add(dt * 1e6)
         t_reap = time.perf_counter()
         if tr0:
             _trace.complete("engine", "readback", tr0,
                             {"kind": "crc", "bucket": rec.bucket,
-                             "jobs": len(rec.jobs)})
+                             "jobs": len(rec.jobs),
+                             "device": (rec.lane.dev_id
+                                        if rec.lane is not None
+                                        else 0)})
         # host-side combine of multi-block buffers (µs each), then slice
         # results back out per job in submission order; a fused launch
         # combines each job with ITS polynomial's zero-shift matrices

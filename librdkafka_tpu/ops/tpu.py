@@ -55,7 +55,10 @@ class TpuCodecProvider:
         # below this many independent buffers a launch isn't worth it;
         # fall back to the CPU provider (identical bytes either way).
         self.min_batches = max(1, int(min_batches))
-        # tpu.mesh.devices: >1 shards block compression over a 1-D
+        # tpu.mesh.devices: how many chips the async engine spreads its
+        # per-device dispatch lanes over (0 = all local, 1 = the
+        # pre-mesh single-lane engine); >1 also shards the (lz4.force)
+        # device encoder's block compression over the same 1-D
         # jax.sharding.Mesh (parallel/mesh.py shard_map scale-out)
         self.mesh_devices = int(mesh_devices or 0)
         # tpu.lz4.force: the device lz4 encoder is measured ~3 orders of
@@ -324,7 +327,8 @@ class TpuCodecProvider:
                         name="tpu-codec-engine",
                         governor=self.governor,
                         warmup=self.engine_warmup,
-                        compile_cache_dir=self.compile_cache_dir)
+                        compile_cache_dir=self.compile_cache_dir,
+                        mesh_devices=self.mesh_devices)
         return self._engine
 
     def _cpu_crc_fallback(self, bufs: list[bytes], poly: str) -> list[int]:
@@ -372,11 +376,17 @@ class TpuCodecProvider:
     def close(self) -> None:
         """Tear down the async engine (drains in-flight launches); the
         provider keeps serving synchronously afterwards — a straggling
-        codec job must not respawn a dispatch thread post-close."""
+        codec job must not respawn a dispatch thread post-close.  A
+        provider that built an lz4 mesh also releases the compiled
+        sharded-step cache (parallel/mesh.py close-time hook)."""
         self._engine_closed = True
         eng, self._engine = self._engine, None
         if eng is not None:
             eng.close()
+        if self._mesh is not None:
+            from ..parallel.mesh import release_step_cache
+            self._mesh = None
+            release_step_cache()
 
     def crc32c_many(self, bufs: list[bytes]) -> list[int]:
         if len(bufs) >= self.min_batches and self._offload_pays():

@@ -8,8 +8,22 @@ compresses and checksums its shard locally (zero cross-chip traffic on the
 hot path — the layout rides ICI only for the final stats reduction, a
 psum of byte counters matching the reference's atomic stats counters,
 rdatomic.h).
+
+ISSUE 6 adds the ENGINE-FACING LANE API: the async offload engine
+(ops/engine.py) shards its merged fan-in CRC launch groups across the
+mesh through :func:`sharded_crc_step` — a shard_map of exactly the
+single-device plane-split MXU body (crc32c_jax._mxu_rows_fn), so each
+chip checksums its contiguous row shard locally and the gathered result
+is bit-identical to the whole-to-one-device launch by construction.
+Compiled steps live in a BOUNDED module-level LRU (``_STEP_CACHE``)
+with a close-time release hook (:func:`release_step_cache`) so engines
+and providers drop their compiled steps deterministically — the
+conftest leak fixture asserts no cached step survives a test.
 """
 from __future__ import annotations
+
+import threading
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +56,45 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs), ("batch",))
 
 
-_STEP_CACHE: dict = {}
+# Bounded LRU of compiled sharded steps, keyed by (step kind, device
+# ids, shape...).  Compiled shard_map executables pin device buffers
+# (the broadcast Q-matrix constants alone are N*8*32 int8 per poly per
+# chip), so the cache is BOUNDED — least-recently-used steps evict —
+# and releasable: engine/provider close() calls release_step_cache()
+# so no compiled step outlives its owner (conftest leak fixture).
+_STEP_CACHE: OrderedDict = OrderedDict()
+_STEP_CACHE_MAX = 16
+_STEP_LOCK = threading.Lock()
+
+
+def _step_cache_get(key):
+    with _STEP_LOCK:
+        v = _STEP_CACHE.get(key)
+        if v is not None:
+            _STEP_CACHE.move_to_end(key)
+        return v
+
+
+def _step_cache_put(key, val):
+    with _STEP_LOCK:
+        _STEP_CACHE[key] = val
+        _STEP_CACHE.move_to_end(key)
+        while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+            _STEP_CACHE.popitem(last=False)
+
+
+def step_cache_count() -> int:
+    """Live cached compiled steps (the conftest leak gauge)."""
+    with _STEP_LOCK:
+        return len(_STEP_CACHE)
+
+
+def release_step_cache() -> None:
+    """Close-time hook: drop every cached compiled step (engine close,
+    provider close, test teardown).  Steps recompile on next use —
+    correctness is unaffected, only the compile cost returns."""
+    with _STEP_LOCK:
+        _STEP_CACHE.clear()
 
 
 def sharded_codec_step(mesh: Mesh, N: int, with_crc: bool = True):
@@ -57,8 +109,8 @@ def sharded_codec_step(mesh: Mesh, N: int, with_crc: bool = True):
     checksum elsewhere — e.g. the codec provider, whose batch CRC
     covers the assembled record batch, not raw blocks.
     """
-    key = (tuple(d.id for d in mesh.devices.flat), N, with_crc)
-    cached = _STEP_CACHE.get(key)
+    key = ("codec", tuple(d.id for d in mesh.devices.flat), N, with_crc)
+    cached = _step_cache_get(key)
     if cached is not None:
         return cached
     K, L = _pick_kl(N)
@@ -87,15 +139,98 @@ def sharded_codec_step(mesh: Mesh, N: int, with_crc: bool = True):
         in_specs=(P("batch", None), P("batch"), P("batch")),
         out_specs=out_specs)
     fn = jax.jit(shard)
-    _STEP_CACHE[key] = fn
+    _step_cache_put(key, fn)
     return fn
+
+
+# ---------------------------------------------- engine-facing lane API ----
+# The async offload engine's sharded CRC dispatch (ISSUE 6): a fused
+# launch group whose block count spans a mesh multiple is laid out
+# (B_shard * ndev, 64KB) and shard_mapped so every chip runs the
+# plane-split kernel on its contiguous row shard.  The local body IS
+# crc32c_jax's single-device body — results are bit-identical to the
+# whole-to-one-lane route by construction; only WHERE each block's CRC
+# runs changes.
+
+def _crc_step_key(device_ids, Bs: int, N: int, kind: str) -> tuple:
+    return ("crc", tuple(device_ids), int(Bs), int(N), kind)
+
+
+def sharded_crc_ready(device_ids, Bs: int, N: int, kind: str) -> bool:
+    """True once the sharded CRC step for (devices, per-shard rows Bs,
+    block N, kind) is compiled — the engine's warmup gate for the
+    split route (kind: 'crc32c' | 'crc32' | 'fused')."""
+    return _step_cache_get(_crc_step_key(device_ids, Bs, N, kind)) \
+        is not None
+
+
+def sharded_crc_step(devices, Bs: int, N: int, kind: str):
+    """(mesh, fn) for the sharded CRC launch: fn(data (Bs*ndev, N)
+    uint8 left-padded, terms (Bs*ndev,) uint32[, sel (Bs*ndev,) uint32
+    when kind='fused']) -> (Bs*ndev,) uint32.  Each device computes its
+    Bs-row shard with the single-device MXU body; compiled steps are
+    cached in the bounded module LRU."""
+    ids = [d.id for d in devices]
+    key = _crc_step_key(ids, Bs, N, kind)
+    cached = _step_cache_get(key)
+    if cached is not None:
+        return cached
+    from ..ops.crc32c_jax import _mxu_fused_rows_fn, _mxu_rows_fn
+    fused = kind == "fused"
+    local = _mxu_fused_rows_fn(N) if fused else _mxu_rows_fn(N, kind)
+    mesh = Mesh(np.array(list(devices)), ("batch",))
+    in_specs = ((P("batch", None), P("batch"), P("batch")) if fused
+                else (P("batch", None), P("batch")))
+    fn = jax.jit(_shard_map(local, mesh=mesh, in_specs=in_specs,
+                            out_specs=P("batch")))
+    val = (mesh, fn)
+    _step_cache_put(key, val)
+    return val
+
+
+def warm_sharded_crc(devices, Bs: int, N: int, kind: str) -> None:
+    """Compile the sharded CRC step off the hot path (the engine's
+    warmup thread): AOT-lower against sharded ShapeDtypeStructs when
+    the jax supports it, else execute zeros once.  Idempotent."""
+    ids = [d.id for d in devices]
+    if sharded_crc_ready(ids, Bs, N, kind):
+        return
+    mesh, fn = sharded_crc_step(devices, Bs, N, kind)
+    ndev = mesh.devices.size
+    B = Bs * ndev
+    fused = kind == "fused"
+    row = NamedSharding(mesh, P("batch"))
+    try:
+        d = jax.ShapeDtypeStruct((B, N), jnp.uint8,
+                                 sharding=NamedSharding(
+                                     mesh, P("batch", None)))
+        t = jax.ShapeDtypeStruct((B,), jnp.uint32, sharding=row)
+        args = (d, t, jax.ShapeDtypeStruct((B,), jnp.uint32,
+                                           sharding=row)) \
+            if fused else (d, t)
+        exe = fn.lower(*args).compile()
+        _step_cache_put(_crc_step_key(ids, Bs, N, kind), (mesh, exe))
+    except Exception:
+        # no AOT path: compile by executing zeros once (the jitted fn
+        # keeps its own executable cache; the step stays cached)
+        data = jax.device_put(np.zeros((B, N), np.uint8),
+                              NamedSharding(mesh, P("batch", None)))
+        terms = jax.device_put(np.zeros((B,), np.uint32), row)
+        cargs = ((data, terms,
+                  jax.device_put(np.zeros((B,), np.uint32), row))
+                 if fused else (data, terms))
+        np.asarray(fn(*cargs))
 
 
 def shard_compress(mesh: Mesh, blocks: list[bytes], with_crc: bool = True):
     """Compress blocks across the mesh (pads B up to a mesh multiple).
     Returns (blocks, crcs, total) with crcs=None/total=0 when
-    with_crc=False."""
+    with_crc=False.  An empty block list short-circuits — shard_map
+    cannot partition zero rows."""
     from ..ops.packing import next_pow2, pad_right
+
+    if not blocks:
+        return [], (np.zeros((0,), np.uint32) if with_crc else None), 0
 
     ndev = mesh.devices.size
     N = next_pow2(max((len(b) for b in blocks), default=64))

@@ -43,8 +43,13 @@ def _pct(sorted_vals: list[float], p: float) -> float:
 def summarize(events: list[dict], top: int = 10) -> dict:
     """{"stages": [{name, cat, cnt, total_us, p50_us, p90_us, p99_us,
     max_us}...] (total-time desc), "widest": [top-N span dicts],
-    "instants": {name: count}}."""
+    "instants": {name: count}, "by_device": [{name, device, cnt,
+    p50_us, max_us, total_us}...]}.  ``by_device`` splits every span
+    stamped with a ``device`` arg (engine ``device_launch``/
+    ``readback``; device -1 = whole-mesh sharded launch) so launch
+    latency is attributable per chip (ISSUE 6)."""
     by_name: dict[tuple, list[float]] = {}
+    by_dev: dict[tuple, list[float]] = {}
     spans: list[dict] = []
     instants: dict[str, int] = {}
     for e in events:
@@ -53,6 +58,10 @@ def summarize(events: list[dict], top: int = 10) -> dict:
             dur = float(e.get("dur", 0.0))
             by_name.setdefault((e.get("cat", ""), e["name"]),
                                []).append(dur)
+            args = e.get("args") or {}
+            if "device" in args:
+                by_dev.setdefault((e["name"], args["device"]),
+                                  []).append(dur)
             spans.append(e)
         elif ph == "i":
             instants[e["name"]] = instants.get(e["name"], 0) + 1
@@ -74,7 +83,19 @@ def summarize(events: list[dict], top: int = 10) -> dict:
                "ts_us": round(float(e.get("ts", 0.0)), 1),
                "tid": e.get("tid"), "args": e.get("args")}
               for e in spans[:top]]
-    return {"stages": stages, "widest": widest, "instants": instants}
+    by_device = []
+    for (name, dev), durs in sorted(by_dev.items(),
+                                    key=lambda kv: (kv[0][0],
+                                                    kv[0][1])):
+        durs.sort()
+        by_device.append({
+            "name": name, "device": dev, "cnt": len(durs),
+            "p50_us": round(_pct(durs, 50), 1),
+            "max_us": round(durs[-1], 1),
+            "total_us": round(sum(durs), 1),
+        })
+    return {"stages": stages, "widest": widest, "instants": instants,
+            "by_device": by_device}
 
 
 def render(summary: dict) -> str:
@@ -92,6 +113,16 @@ def render(summary: dict) -> str:
     for i, w in enumerate(summary["widest"], 1):
         out.append(f"{i:<3}{w['name']:<22}{w['dur_us']:>10}  "
                    f"{w['args'] if w['args'] else ''}")
+    if summary.get("by_device"):
+        out.append("")
+        out.append("per-device launch attribution (device -1 = "
+                   "whole-mesh sharded)")
+        out.append(f"{'stage':<22}{'device':>7}{'cnt':>6}{'p50us':>10}"
+                   f"{'maxus':>10}{'totalus':>12}")
+        for d in summary["by_device"]:
+            out.append(f"{d['name']:<22}{d['device']:>7}{d['cnt']:>6}"
+                       f"{d['p50_us']:>10}{d['max_us']:>10}"
+                       f"{d['total_us']:>12}")
     if summary["instants"]:
         out.append("")
         out.append("instant events: " + ", ".join(

@@ -77,6 +77,23 @@ def _no_leaked_engine_threads():
         f"still registered — a client with statistics.interval.ms "
         f"was not closed")
 
+    # ISSUE 6: no compiled shard_map step may outlive its test —
+    # compiled steps pin per-device buffers (Q-matrix constants on
+    # every chip), so a leak taxes all later tests.  Engine close()
+    # (multi-lane) and TpuCodecProvider.close() (lz4 mesh) release the
+    # cache; tests driving parallel/mesh.py directly must call
+    # release_step_cache() themselves.  sys.modules guard: most tests
+    # never import the mesh module and should not pay for it here.
+    import sys
+    mesh_mod = sys.modules.get("librdkafka_tpu.parallel.mesh")
+    if mesh_mod is not None:
+        n = mesh_mod.step_cache_count()
+        assert n == 0, (
+            f"leaked compiled sharded steps: {n} still cached in "
+            f"parallel.mesh._STEP_CACHE — a mesh engine/provider was "
+            f"not closed (or a direct mesh test skipped "
+            f"release_step_cache())")
+
 
 # The interop tier's reference build lives in test_0200_interop.py as a
 # module-scoped fixture — it only builds when that module actually runs

@@ -553,6 +553,214 @@ def test_engine_close_races_warmup_and_fanin_window():
     assert not eng2._thread.is_alive()
 
 
+# ------------------------------------------- mesh-sharded dispatch lanes --
+
+def test_engine_mesh_bitexact_across_device_counts():
+    """ISSUE 6 tentpole: the same CRC workload must produce identical
+    checksums with tpu.mesh.devices at 1 (the pre-mesh single lane), 2,
+    and 0 (all 8 virtual devices) — across staging-ring reuse rounds
+    and both polynomials.  Sharding only moves WHERE each block's CRC
+    runs, never the result."""
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+    from librdkafka_tpu.utils.crc import crc32
+
+    rng = np.random.default_rng(26)
+    bufs = [b"", b"a", b"123456789", bytes(100)] + [
+        rng.integers(0, 256, int(n), dtype=np.uint8).tobytes()
+        for n in [1, 63, 1000, 65535, 65536, 65537, 200_000]]
+    want_c = [crc32c(b) for b in bufs]
+    want_l = [crc32(b) for b in bufs]
+    for nd in (1, 2, 0):
+        eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                                 warmup=False, mesh_devices=nd,
+                                 cpu_fallback=_cpu_fallback)
+        try:
+            for round_ in range(3):
+                batch = bufs[round_:] + bufs[:round_]
+                got = eng.submit(batch, "crc32c",
+                                 window=False).result(300)
+                assert got.tolist() == want_c[round_:] + want_c[:round_]
+            got32 = eng.submit(bufs, "crc32", window=False).result(300)
+            assert got32.tolist() == want_l
+            lanes = eng._lanes
+            assert len(lanes) == (nd if nd else 8)
+            if nd != 1:
+                # whole-to-one-lane least-loaded pick spreads cold
+                # lanes first: 4 sequential launches land on >1 chip
+                assert sum(1 for ln in lanes if ln.launches) >= 2, \
+                    [(ln.dev_id, ln.launches) for ln in lanes]
+        finally:
+            eng.close()
+
+
+def test_engine_mesh_sharded_launch_bitexact_and_counted():
+    """A group spanning a mesh multiple (>= SHARD_MIN_ROWS blocks per
+    device) splits across every chip via shard_map: checksums stay
+    oracle-exact, the launch counts as sharded, every lane records it,
+    and the per-device stats rows carry the split."""
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                             warmup=False, mesh_devices=2,
+                             cpu_fallback=_cpu_fallback)
+    try:
+        rng = np.random.default_rng(27)
+        # 17 full 64KB blocks >= 2 devices * SHARD_MIN_ROWS(8)
+        bufs = [rng.integers(0, 256, 65536, dtype=np.uint8).tobytes()
+                for _ in range(16)] + [b"tail-block" * 7]
+        want = [crc32c(b) for b in bufs]
+        got = eng.submit(bufs, "crc32c", window=False).result(300)
+        assert got.tolist() == want
+        assert eng.stats["sharded_launches"] >= 1, eng.stats
+        rows = eng.devices_snapshot()
+        assert len(rows) == 2
+        for row in rows:
+            # a sharded launch records on every participating lane
+            assert row["launches"] >= 1, rows
+            assert row["blocks"] >= 1, rows
+        # the shard pseudo-lane drained (nothing left in flight)
+        assert eng._shard_lane is not None
+        assert not eng._shard_lane.inflight
+    finally:
+        eng.close()
+    # engine close released the compiled shard_map steps (the mesh
+    # module's close-time hook; the conftest fixture asserts this too)
+    from librdkafka_tpu.parallel.mesh import step_cache_count
+    assert step_cache_count() == 0
+
+
+def test_engine_mesh_governor_explore_and_fanin_skip_bitexact():
+    """ISSUE 6 satellite: the governor's explore and adaptive fan-in
+    paths stay bit-exact when dispatch is mesh-sharded — exploration
+    flips routes with per-(device, bucket) EWMAs live, and the low-rate
+    fan-in shed dispatches below-quorum jobs immediately."""
+    import time as _time
+
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    eng = AsyncOffloadEngine(depth=2, fanin_window_s=0.3, min_batches=2,
+                             governor=True, warmup=False,
+                             mesh_devices=0, cpu_fallback=_cpu_fallback)
+    try:
+        rng = np.random.default_rng(28)
+        bufs = [rng.integers(0, 256, 2048, dtype=np.uint8).tobytes()
+                for _ in range(2)]
+        want = [crc32c(b) for b in bufs]
+        # seed the device side (multiple lanes get measured: cold
+        # chips sort first in the least-loaded pick)...
+        for _ in range(4):
+            assert eng.submit(bufs, "crc32c",
+                              window=False).result(300).tolist() == want
+        # ...and the CPU side via a below-floor group
+        assert eng.submit(bufs[:1], "crc32c",
+                          window=False).result(60).tolist() == want[:1]
+        g = eng.governor
+        assert g.dev_launch_s and g.cpu_ns_per_byte is not None
+        # per-device EWMAs: >1 (device, bucket) key measured
+        assert len({d for (d, _b) in g.dev_launch_s}) >= 2, \
+            g.dev_launch_s
+        # exploration provably flips some decisions over enough rounds
+        for _ in range(2 * g.EXPLORE_EVERY):
+            assert eng.submit(bufs, "crc32c",
+                              window=False).result(60).tolist() == want
+        assert eng.stats["explore_routes"] >= 1, eng.stats
+        # the stats blob's governor view is the best-device collapse
+        snap = eng.governor_snapshot()
+        assert snap["dev_launch_ms"]
+        # fan-in skip at low rate: below-quorum windowed jobs dispatch
+        # immediately once the inter-arrival EWMA exceeds the cap
+        last = None
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            t = eng.submit(bufs[:1], "crc32c", window=True)
+            assert t.result(60).tolist() == want[:1]
+            last = _time.perf_counter() - t0
+            _time.sleep(0.45)
+        assert eng.stats["fanin_skips"] >= 1, eng.stats
+        assert last < 0.15, f"still paying the window: {last:.3f}s"
+    finally:
+        eng.close()
+
+
+def test_engine_close_racing_warmup_on_device_k():
+    """ISSUE 6 satellite: close() racing the warmup sweep while it
+    compiles on a NON-default device must still drain deterministically
+    — per-lane in-flight launches resolve, both threads join, and the
+    compiled shard-step cache is released."""
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=True,
+                             warmup=True, mesh_devices=0,
+                             cpu_fallback=_cpu_fallback)
+    try:
+        # jump a device-7 bucket to the front of the sweep and wait for
+        # it: the race now provably closes mid-sweep on device k
+        eng._request_warm(("kernel", 64, "crc32c", 7))
+        assert eng.warm_wait(64, "crc32c", timeout=300, device=7), \
+            "warmup never compiled the device-7 bucket"
+        t = eng.submit([b"racing-mesh-warmup" * 200], "crc32c",
+                       window=False)
+    finally:
+        eng.close()
+    assert t.result(5).tolist() == [crc32c(b"racing-mesh-warmup" * 200)]
+    assert not eng._warmup_thread.is_alive()
+    assert not eng._thread.is_alive()
+    for ln in eng._all_lanes():
+        assert not ln.inflight, "lane left launches in flight"
+    from librdkafka_tpu.parallel.mesh import step_cache_count
+    assert step_cache_count() == 0
+
+
+def _wire_build(provider, ticketed: bool) -> bytes:
+    """Deterministic multi-batch msgset build (writer-level, so wire
+    bytes are timing-independent): mixed batch sizes, one spanning
+    enough 64KB blocks to take the sharded route on a 2-lane mesh."""
+    from librdkafka_tpu.protocol.msgset import MsgsetWriterV2, Record
+
+    now = 1_700_000_000_000
+    rng = np.random.default_rng(29)
+    batches = [
+        [Record(key=b"k%d" % i, value=(b"mesh-%d " % i) * 30,
+                timestamp=now + i) for i in range(16)],
+        [Record(key=None, value=rng.integers(
+            0, 256, 70_000, dtype=np.uint8).tobytes(),
+            timestamp=now) for _ in range(18)],   # ~18+ 64KB blocks
+        [Record(key=b"solo", value=b"x", timestamp=now)],
+    ]
+    wires = []
+    for msgs in batches:
+        w = MsgsetWriterV2(codec="lz4")
+        w.build(msgs, now)
+        blob = provider.compress_many("lz4", [w.records_bytes])[0]
+        if len(blob) >= len(w.records_bytes):
+            blob, w.codec = None, None
+        region = w.assemble(blob)
+        if ticketed:
+            t = provider.crc32c_submit([region])
+            assert t is not None
+            crc = int(t.result(300)[0])
+        else:
+            crc = int(provider.crc32c_many([region])[0])
+        wires.append(w.patch_crc(crc))
+    return b"".join(wires)
+
+
+def test_mesh_produce_wire_bitexact_across_device_counts():
+    """ISSUE 6 satellite: the same produce workload assembles
+    bit-identical msgset wire bytes (CRCs included) with
+    tpu.mesh.devices at 1, 2, and all — every route vs the CPU
+    provider's build."""
+    want = _wire_build(cpu.CpuCodecProvider(), ticketed=False)
+    for nd in (1, 2, 0):
+        prov = TpuCodecProvider(min_batches=1, warmup=False,
+                                min_transport_mb_s=0, mesh_devices=nd)
+        try:
+            assert _wire_build(prov, ticketed=True) == want, \
+                f"wire bytes diverged at mesh_devices={nd}"
+        finally:
+            prov.close()
+
+
 def test_provider_pipelined_crc_bitexact(tpu_provider):
     """TpuCodecProvider's async submit seam resolves to the same values
     as the synchronous interface and the oracle."""

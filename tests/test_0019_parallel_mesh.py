@@ -3,10 +3,22 @@ must match the single-device oracles bit-for-bit (lz4 blocks) and
 value-for-value (crc32c), including when B is not a mesh multiple (pad
 rows must not pollute results or the psum'd byte counter)."""
 import numpy as np
+import pytest
 
 from librdkafka_tpu.ops import cpu
-from librdkafka_tpu.parallel.mesh import make_mesh, shard_compress
+from librdkafka_tpu.parallel.mesh import (make_mesh, release_step_cache,
+                                          shard_compress,
+                                          step_cache_count)
 from librdkafka_tpu.utils.crc import crc32c
+
+
+@pytest.fixture(autouse=True)
+def _release_compiled_steps():
+    """Direct mesh tests compile sharded steps outside any engine or
+    provider, so the close-time hook never fires for them — release
+    here so the conftest leak fixture's step-cache assertion holds."""
+    yield
+    release_step_cache()
 
 
 def test_shard_compress_matches_oracles():
@@ -29,3 +41,48 @@ def test_shard_compress_full_multiple():
     assert [int(c) for c in crcs] == [crc32c(b) for b in blocks]
     assert outs == [cpu.lz4_block_compress(b) for b in blocks]
     assert total == sum(len(o) for o in outs)
+
+
+def test_shard_compress_empty_blocks():
+    """ISSUE 6 satellite: zero blocks must short-circuit (shard_map
+    cannot partition zero rows) without touching the step cache."""
+    mesh = make_mesh(2)
+    outs, crcs, total = shard_compress(mesh, [])
+    assert outs == [] and total == 0 and len(crcs) == 0
+    outs, crcs, total = shard_compress(mesh, [], with_crc=False)
+    assert outs == [] and crcs is None and total == 0
+    assert step_cache_count() == 0
+
+
+def test_step_cache_bounded_lru():
+    """ISSUE 6 satellite: the compiled-step cache is a bounded LRU —
+    inserts past the cap evict least-recently-USED (a get refreshes),
+    and release_step_cache() empties it (the engine/provider close-time
+    hook the conftest leak fixture asserts)."""
+    from librdkafka_tpu.parallel import mesh as m
+
+    release_step_cache()
+    try:
+        for i in range(m._STEP_CACHE_MAX):
+            m._step_cache_put(("t", i), i)
+        assert step_cache_count() == m._STEP_CACHE_MAX
+        m._step_cache_get(("t", 0))             # refresh: 0 is now MRU
+        m._step_cache_put(("t", "overflow"), -1)
+        assert step_cache_count() == m._STEP_CACHE_MAX
+        assert m._step_cache_get(("t", 0)) == 0          # survived
+        assert m._step_cache_get(("t", 1)) is None       # LRU evicted
+        assert m._step_cache_get(("t", "overflow")) == -1
+    finally:
+        release_step_cache()
+    assert step_cache_count() == 0
+
+
+def test_step_cache_caches_compiled_steps():
+    """A real shard_compress populates the cache (so the bound and the
+    release hook actually govern compiled executables, not just the
+    test doubles above)."""
+    mesh = make_mesh(2)
+    shard_compress(mesh, [b"payload" * 64] * 4)
+    assert step_cache_count() > 0
+    release_step_cache()
+    assert step_cache_count() == 0

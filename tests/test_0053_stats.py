@@ -291,10 +291,23 @@ def test_stats_schema_matches_statistics_md():
     assert set(ce["stage_latency"]) == doc["codec_engine.stage_latency"]
     assert set(ce["gauges"]) == doc["codec_engine.gauges"]
 
-    # every `{}`-marked window renders the full rd_avg_t field set
+    # ISSUE 6: the per-device dispatch-lane rows — the engine resolved
+    # its lanes (the producer's CRC group reached the launch path), and
+    # every row carries exactly the documented fields
+    assert ce["devices"], "codec_engine.devices[] empty: no lane resolved"
+    for row in ce["devices"]:
+        assert set(row) == doc["codec_engine.devices[]"], \
+            set(row) ^ doc["codec_engine.devices[]"]
+        assert isinstance(row["dev_launch_ms"], dict)
+
+    # every `{}`-marked window renders the full rd_avg_t field set;
+    # stage_latency.launch_dev is a {device id: window} split, its
+    # VALUES are windows
+    sl = dict(ce["stage_latency"])
+    launch_dev = sl.pop("launch_dev")
     for w in (pb["int_latency"], pb["codec_latency"], b["rtt"],
               b["outbuf_latency"], b["throttle"], b["fetch_latency"],
-              *ce["stage_latency"].values()):
+              *sl.values(), *launch_dev.values()):
         assert set(w) == WINDOW_KEYS, set(w) ^ WINDOW_KEYS
 
 
@@ -381,7 +394,8 @@ def test_stats_blob_codec_engine_governor_counters():
     for field in ("launches", "jobs", "aggregated", "cpu_fallback_jobs",
                   "warmup_miss_jobs", "warmup_compiled",
                   "routed_cpu_jobs", "explore_routes", "fused_launches",
-                  "fanin_skips", "fanin_waits", "governor"):
+                  "sharded_launches", "fanin_skips", "fanin_waits",
+                  "governor", "devices"):
         assert field in ce, field
     assert ce["jobs"] >= 1, ce
     gov = ce["governor"]

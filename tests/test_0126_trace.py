@@ -90,11 +90,16 @@ def test_trace_e2e_produce_consume_all_stages(tmp_path):
                     "readback", "produce_tx", "ack",
                     "fetch_rx", "crc_verify", "decompress", "deliver"}
         assert required <= names, f"missing spans: {required - names}"
-        # governor route decisions ride the launch/serve span args
+        # governor route decisions ride the launch/serve span args,
+        # including the ISSUE 6 dispatch-lane attribution (device id,
+        # -1 for a whole-mesh sharded launch)
         launch = next(e for e in evs if e["name"] == "device_launch")
         assert launch["args"]["route"] == "device"
-        assert {"explored", "fused", "bucket", "blocks"} \
-            <= set(launch["args"])
+        assert {"explored", "fused", "bucket", "blocks", "device",
+                "sharded"} <= set(launch["args"])
+        assert launch["args"]["device"] >= -1
+        rb = next(e for e in evs if e["name"] == "readback")
+        assert "device" in rb["args"]
         # thread metadata present (Perfetto track names)
         assert any(e["ph"] == "M" and e["name"] == "thread_name"
                    for e in evs)
@@ -289,6 +294,12 @@ def test_traceview_summarize_and_render(tmp_path):
             t0 = trace.now()
             time.sleep(0.001 if i != 7 else 0.02)   # one wide outlier
             trace.complete("stage", "work", t0, {"i": i})
+        # device-stamped spans (engine launch/readback shape): the
+        # summarizer must attribute them per chip (ISSUE 6)
+        for dev in (0, 1, -1):
+            t0 = trace.now()
+            trace.complete("engine", "device_launch", t0,
+                           {"device": dev, "sharded": dev == -1})
         trace.instant("stage", "blip")
         path = str(tmp_path / "tv.json")
         trace.dump(path)
@@ -303,8 +314,12 @@ def test_traceview_summarize_and_render(tmp_path):
     assert summary["widest"][0]["name"] == "work"
     assert summary["widest"][0]["args"]["i"] == 7
     assert summary["instants"].get("blip") == 1
+    devs = {d["device"] for d in summary["by_device"]
+            if d["name"] == "device_launch"}
+    assert devs == {-1, 0, 1}, summary["by_device"]
     out = tv.render(summary)
     assert "work" in out and "top widest spans" in out
+    assert "per-device launch attribution" in out
     # the bare-array form loads too (hand-built dumps)
     alt = str(tmp_path / "arr.json")
     with open(alt, "w") as f:
