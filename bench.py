@@ -1172,6 +1172,43 @@ def governor_bench() -> dict:
     return out
 
 
+def chaos_bench() -> dict:
+    """bench.py --chaos (<30 s): the chaos smoke leg — run every FAST
+    scenario from the chaos library (one broker kill/restart storm, one
+    network-shaping storm, the oracle self-test) and gate on a clean
+    delivery-invariant verdict; the full storms live behind
+    scripts/chaos.sh (pytest -m chaos)."""
+    from librdkafka_tpu.chaos.oracle import OracleViolation
+    from librdkafka_tpu.chaos.scenarios import SCENARIOS
+
+    legs = {}
+    all_ok = True
+    for name, (fn, _desc, fast) in SCENARIOS.items():
+        if not fast:
+            continue
+        t0 = time.perf_counter()
+        try:
+            report = fn()
+            # the self-test PASSES by detecting its planted violation
+            # and proving the dump artifacts exist
+            ok = ((not report["ok"] and bool(report.get("diff_path"))
+                   and bool(report.get("flight_path")))
+                  if name == "oracle_selftest" else
+                  (report["ok"] and not report["errors"]
+                   and not report["schedule_errors"]))
+            legs[name] = {
+                "ok": ok, "acked": report.get("acked"),
+                "consumed": report.get("consumed"),
+                "violations": {k: len(v) for k, v in
+                               report["violations"].items() if v},
+                "wall_s": round(time.perf_counter() - t0, 2)}
+        except (OracleViolation, Exception) as e:  # noqa: B014
+            legs[name] = {"ok": False, "error": repr(e),
+                          "wall_s": round(time.perf_counter() - t0, 2)}
+        all_ok = all_ok and legs[name]["ok"]
+    return {"ok": all_ok, "legs": legs}
+
+
 def smoke_bench() -> dict:
     """bench.py --smoke (<60 s): one bit-exactness pass over every
     engine leg — sync provider, pipelined engine, fetch pipeline,
@@ -1453,6 +1490,12 @@ def main():
                                     "dispatch-lane CRC scaling "
                                     "(bench.py --mesh)",
                           **mesh_bench()})
+        return
+    if "--chaos" in sys.argv:
+        _emit({"metric": "chaos smoke: fast fault-schedule storms "
+                         "with a clean delivery-invariant oracle "
+                         "verdict (bench.py --chaos)",
+               **chaos_bench()})
         return
     if "--governor" in sys.argv:
         _emit({"metric": "adaptive offload governor: warmup "

@@ -1,0 +1,23 @@
+"""Chaos-engineering subsystem (ISSUE 7): scripted fault schedules,
+a delivery-invariant oracle, and a scenario library over the real-TCP
+mock cluster.  See CHAOS.md for the DSL reference, oracle invariants
+and the replay-from-seed workflow.
+
+    from librdkafka_tpu.chaos import (Schedule, ChaosScheduler,
+                                      DeliveryOracle, broker_kill, ...)
+
+CLI: ``python -m librdkafka_tpu.chaos --list``.
+"""
+from .oracle import DeliveryOracle, OracleViolation
+from .schedule import (Action, ChaosContext, ChaosScheduler, Schedule,
+                       broker_kill, broker_restart, call, conn_kill,
+                       leader_migrate, net)
+from .scenarios import SCENARIOS, Storm
+
+__all__ = [
+    "Action", "ChaosContext", "ChaosScheduler", "Schedule",
+    "broker_kill", "broker_restart", "call", "conn_kill",
+    "leader_migrate", "net",
+    "DeliveryOracle", "OracleViolation",
+    "SCENARIOS", "Storm",
+]

@@ -1,0 +1,83 @@
+"""CLI storm runner: ``python -m librdkafka_tpu.chaos``.
+
+    python -m librdkafka_tpu.chaos --list
+    python -m librdkafka_tpu.chaos --scenario rolling_restart_eos --seed 1
+    python -m librdkafka_tpu.chaos --fast          # the tier-1 smoke set
+    python -m librdkafka_tpu.chaos --all
+
+Exit status 0 iff every requested storm's oracle verdict is clean
+(``oracle_selftest`` passes by *detecting* its planted violation).
+Reports print as JSON — the ``replay_key`` field plus ``--seed`` is the
+replay workflow: same seed, same fault timeline, byte-for-byte.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .oracle import OracleViolation
+from .scenarios import SCENARIOS
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m librdkafka_tpu.chaos",
+        description="chaos storms over the mock cluster")
+    ap.add_argument("--scenario", action="append", default=[],
+                    help="scenario name (repeatable); see --list")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the scenario's default seed "
+                         "(replay-from-seed)")
+    ap.add_argument("--fast", action="store_true",
+                    help="run the fast (tier-1) scenario set")
+    ap.add_argument("--all", action="store_true",
+                    help="run every scenario, storms included")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, (_fn, desc, fast) in SCENARIOS.items():
+            tier = "fast" if fast else "slow"
+            print(f"{name:32s} [{tier}] {desc}")
+        return 0
+
+    names = list(args.scenario)
+    if args.all:
+        names = list(SCENARIOS)
+    elif args.fast:
+        names = [n for n, (_f, _d, fast) in SCENARIOS.items() if fast]
+    if not names:
+        ap.error("pick --scenario NAME, --fast, or --all (see --list)")
+
+    rc = 0
+    for name in names:
+        if name not in SCENARIOS:
+            print(f"unknown scenario {name!r} (see --list)",
+                  file=sys.stderr)
+            return 2
+        fn = SCENARIOS[name][0]
+        kwargs = {} if args.seed is None else {"seed": args.seed}
+        print(f"== {name} ==", file=sys.stderr)
+        try:
+            report = fn(**kwargs)
+        except OracleViolation as v:
+            report = v.report
+            rc = 1
+        # timeline is valuable but long; keep stderr JSON complete and
+        # stdout summary humane
+        print(json.dumps(report, indent=1, default=str))
+        ok = report.get("ok")
+        if name == "oracle_selftest":
+            ok = not ok and report.get("diff_path")
+        status = "PASS" if ok else "FAIL"
+        print(f"== {name}: {status} (acked={report.get('acked')} "
+              f"consumed={report.get('consumed')})", file=sys.stderr)
+        if not ok:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

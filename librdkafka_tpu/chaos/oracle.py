@@ -1,0 +1,264 @@
+"""Delivery-invariant oracle: the ledger that decides whether a chaos
+storm actually broke anything.
+
+Records every **acked** produce (topic, partition, offset, key, value,
+txn id — fed by the delivery-report callback, so only what the broker
+confirmed counts) and every **consumed** message, then asserts the
+delivery contract after the storm:
+
+  * **zero acked loss** — every committed ack is consumed;
+  * **zero duplication** — under EOS ``read_committed`` no record is
+    delivered twice;
+  * **per-partition order** — records of one partition arrive in
+    offset order, the order they were acked in;
+  * **txn atomicity** — a transaction's records land all-or-nothing:
+    committed txns fully visible, aborted txns fully invisible.
+
+On any violation the oracle dumps the PR-5 flight recorder (the trace
+that *explains* the failure) plus its own diff as JSON, then raises
+``OracleViolation`` carrying the structured report — the chaos analog
+of the fetch path's CRC-mismatch flight trigger.
+
+Identity: message **values must be unique per oracle** (scenario
+producers stamp a monotonically increasing sequence into each value);
+loss/dup/order are judged on ``(topic, partition, value)``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Optional
+
+from ..obs import trace
+
+
+class OracleViolation(AssertionError):
+    """Delivery contract broken; ``.report`` holds the full verdict
+    (violations, flight-recorder path, oracle-diff path)."""
+
+    def __init__(self, report: dict):
+        self.report = report
+        v = report["violations"]
+        summary = ", ".join(f"{k}={len(rows)}" for k, rows in v.items()
+                            if rows)
+        super().__init__(
+            f"delivery invariants violated ({summary}); "
+            f"oracle diff: {report.get('diff_path')}, "
+            f"flight dump: {report.get('flight_path')}")
+
+
+#: cap per-violation rows carried in the in-memory report / exception;
+#: the JSON diff on disk always holds everything
+REPORT_ROW_CAP = 50
+
+
+class DeliveryOracle:
+    """Thread-safe ledger (DR callbacks fire on client poll threads,
+    consumers record from their own loops)."""
+
+    def __init__(self, *, dump_dir: Optional[str] = None):
+        self._lock = threading.Lock()
+        self.dump_dir = dump_dir
+        # acked produces: (topic, partition, offset, key, value, txn)
+        self.acked: list[tuple] = []
+        # produce failures: (topic, partition, value, txn, err_str) —
+        # not required to be delivered, kept for the report
+        self.failed: list[tuple] = []
+        # consumed: (topic, partition, offset, value) in arrival order
+        self.consumed: list[tuple] = []
+        # txn id -> "open" | "committed" | "aborted" | "unknown"
+        self.txns: dict[str, str] = {}
+
+    # ---------------------------------------------------- producer side --
+    def dr(self, txn: Optional[str] = None):
+        """A delivery-report callback bound to ``txn`` (None = plain
+        produce): ``produce(..., on_delivery=oracle.dr(tid))``."""
+        def _cb(err, msg):
+            if err is None:
+                self.record_ack(msg.topic, msg.partition, msg.offset,
+                                msg.key, msg.value, txn)
+            else:
+                with self._lock:
+                    self.failed.append((msg.topic, msg.partition,
+                                        msg.value, txn, str(err)))
+        return _cb
+
+    def record_ack(self, topic: str, partition: int, offset: int,
+                   key: Optional[bytes], value: Optional[bytes],
+                   txn: Optional[str] = None) -> None:
+        with self._lock:
+            self.acked.append((topic, partition, offset, key, value, txn))
+
+    def begin_txn(self, txn: str) -> None:
+        with self._lock:
+            self.txns[txn] = "open"
+
+    def commit_txn(self, txn: str) -> None:
+        with self._lock:
+            self.txns[txn] = "committed"
+
+    def abort_txn(self, txn: str) -> None:
+        with self._lock:
+            self.txns[txn] = "aborted"
+
+    def unknown_txn(self, txn: str) -> None:
+        """Outcome undeterminable client-side (commit AND abort both
+        errored mid-storm): its records are exempt from loss/dup checks
+        but still must land atomically; storms assert this stays 0."""
+        with self._lock:
+            self.txns[txn] = "unknown"
+
+    # ---------------------------------------------------- consumer side --
+    def record_consumed(self, msg) -> None:
+        """Feed one consumed Message (or anything with topic/partition/
+        offset/value attributes)."""
+        with self._lock:
+            self.consumed.append((msg.topic, msg.partition, msg.offset,
+                                  msg.value))
+
+    # ---------------------------------------------------------- verdict --
+    def stats(self) -> dict:
+        with self._lock:
+            committed = sum(1 for *_x, txn in self.acked
+                            if txn is None
+                            or self.txns.get(txn) == "committed")
+            return {"acked": len(self.acked),
+                    "acked_committed": committed,
+                    "consumed": len(self.consumed),
+                    "failed": len(self.failed),
+                    "txns": dict(self.txns)}
+
+    def _committed(self, txn: Optional[str]) -> bool:
+        return txn is None or self.txns.get(txn) == "committed"
+
+    def missing_count(self) -> int:
+        """Committed acks not yet consumed — the drain phase polls
+        until this reaches 0 (or its deadline: that's a loss)."""
+        with self._lock:
+            have = {(t, p, v) for t, p, _o, v in self.consumed}
+            return sum(1 for t, p, _o, _k, v, txn in self.acked
+                       if self._committed(txn) and (t, p, v) not in have)
+
+    def verify(self, *, check_duplicates: bool = True,
+               check_order: bool = True,
+               raise_on_violation: bool = True) -> dict:
+        """Judge the ledger. Scenarios without exactly-once semantics
+        (plain consumer-group rebalances are at-least-once) relax
+        ``check_duplicates``/``check_order``; loss and txn atomicity
+        are always enforced."""
+        with self._lock:
+            acked = list(self.acked)
+            consumed = list(self.consumed)
+            txns = dict(self.txns)
+            failed = list(self.failed)
+
+        lost, duplicated, reordered = [], [], []
+        aborted_seen, torn = [], []
+
+        consumed_count: dict[tuple, int] = {}
+        for topic, part, off, value in consumed:
+            consumed_count[(topic, part, value)] = \
+                consumed_count.get((topic, part, value), 0) + 1
+
+        # -- zero acked-message loss (committed/plain acks only) ----------
+        for topic, part, off, key, value, txn in acked:
+            st = txns.get(txn) if txn is not None else None
+            if txn is not None and st != "committed":
+                continue
+            if (topic, part, value) not in consumed_count:
+                lost.append({"topic": topic, "partition": part,
+                             "offset": off, "txn": txn,
+                             "value": _short(value)})
+
+        # -- zero duplication (EOS read_committed) ------------------------
+        if check_duplicates:
+            for (topic, part, value), n in consumed_count.items():
+                if n > 1:
+                    duplicated.append({"topic": topic, "partition": part,
+                                       "count": n, "value": _short(value)})
+
+        # -- per-partition ordering ---------------------------------------
+        if check_order:
+            last: dict[tuple, tuple] = {}
+            for topic, part, off, value in consumed:
+                prev = last.get((topic, part))
+                if prev is not None and off <= prev[0]:
+                    reordered.append(
+                        {"topic": topic, "partition": part,
+                         "offset": off, "after_offset": prev[0],
+                         "value": _short(value)})
+                last[(topic, part)] = (off, value)
+
+        # -- txn visibility + atomicity -----------------------------------
+        by_txn: dict[str, list] = {}
+        for topic, part, off, key, value, txn in acked:
+            if txn is not None:
+                by_txn.setdefault(txn, []).append((topic, part, value))
+        for txn, msgs in by_txn.items():
+            st = txns.get(txn, "open")
+            seen = sum(1 for m in msgs if m in consumed_count)
+            if st == "aborted" and seen:
+                for topic, part, value in msgs:
+                    if (topic, part, value) in consumed_count:
+                        aborted_seen.append(
+                            {"txn": txn, "topic": topic, "partition": part,
+                             "value": _short(value)})
+            # all-or-nothing regardless of which outcome won
+            if 0 < seen < len(msgs):
+                torn.append({"txn": txn, "state": st,
+                             "acked": len(msgs), "consumed": seen})
+
+        violations = {"lost": lost, "duplicated": duplicated,
+                      "reordered": reordered,
+                      "aborted_seen": aborted_seen, "torn_txns": torn}
+        ok = not any(violations.values())
+        report = {
+            "ok": ok,
+            "acked": len(acked), "consumed": len(consumed),
+            "failed_produces": len(failed),
+            "txns": {"committed":
+                     sum(1 for s in txns.values() if s == "committed"),
+                     "aborted":
+                     sum(1 for s in txns.values() if s == "aborted"),
+                     "unknown":
+                     sum(1 for s in txns.values() if s == "unknown"),
+                     "open":
+                     sum(1 for s in txns.values() if s == "open")},
+            "violations": {k: v[:REPORT_ROW_CAP]
+                           for k, v in violations.items()},
+        }
+        if not ok:
+            report["diff_path"] = self._dump_diff(violations, report)
+            # the trace that explains the storm must survive it: stamp
+            # the verdict into the rings, then flight-dump them
+            trace.instant("chaos", "oracle_violation",
+                          {k: len(v) for k, v in violations.items()})
+            report["flight_path"] = trace.flight_record("oracle_violation")
+            if raise_on_violation:
+                raise OracleViolation(report)
+        return report
+
+    def _dump_diff(self, violations: dict, report: dict) -> Optional[str]:
+        d = self.dump_dir or tempfile.gettempdir()
+        path = os.path.join(
+            d, f"tk_oracle_{os.getpid()}_{id(self) & 0xFFFF:x}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump({"summary": {k: len(v)
+                                       for k, v in violations.items()},
+                           "stats": {"acked": report["acked"],
+                                     "consumed": report["consumed"],
+                                     "txns": report["txns"]},
+                           "violations": violations}, f, indent=1,
+                          default=_short)
+        except OSError:
+            return None
+        return path
+
+
+def _short(v) -> str:
+    if isinstance(v, (bytes, bytearray)):
+        return v[:48].decode("latin1")
+    return str(v)[:64]

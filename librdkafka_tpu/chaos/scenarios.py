@@ -1,0 +1,413 @@
+"""Scenario library: canned chaos storms over the real-TCP mock
+cluster, each returning a structured report (oracle verdict + fault
+timeline + replay key).
+
+Run via ``python -m librdkafka_tpu.chaos`` (``--list`` to enumerate),
+``bench.py --chaos`` (the fast legs as a smoke gate), or the pytest
+tier in tests/test_0127_chaos.py (fast scenarios in tier-1, full storms
+``slow``-marked behind ``scripts/chaos.sh``).
+
+Every scenario is deterministic from its seed: the fault timeline's
+``replay_key`` is identical across runs (schedule.py's contract), so a
+failing storm is re-run with the same seed and the same faults fire in
+the same order against the same targets.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ..client.consumer import Consumer
+from ..client.errors import KafkaException
+from ..client.producer import Producer
+from ..mock.cluster import MockCluster
+from ..mock.sockem import Sockem
+from ..obs import trace
+from .oracle import DeliveryOracle, OracleViolation
+from .schedule import (ChaosScheduler, Schedule, broker_kill,
+                       broker_restart, conn_kill, leader_migrate, net)
+
+
+# ---------------------------------------------------------------- storm --
+class Storm:
+    """One storm run: cluster + optional sockem + oracle + scheduler +
+    paced producer/consumer loops.  Scenarios configure and run it;
+    everything tears down in ``finally`` so a failed storm never leaks
+    threads into the next one (the conftest fixtures police this)."""
+
+    def __init__(self, *, seed: int, brokers: int = 3,
+                 partitions: int = 4, topic: str = "chaos",
+                 use_sockem: bool = False, min_alive: int = 1,
+                 transactional: bool = False, txn_size: int = 5,
+                 abort_every: int = 0, isolation: str = "read_committed",
+                 consumers: int = 1, consumer_start_delays=(0.0,),
+                 duration_s: float = 3.0, pace_ms: float = 4.0,
+                 drain_s: float = 20.0,
+                 check_duplicates: bool = True, check_order: bool = True,
+                 producer_conf: Optional[dict] = None):
+        self.seed = seed
+        self.topic = topic
+        self.partitions = partitions
+        self.transactional = transactional
+        self.txn_size = txn_size
+        self.abort_every = abort_every
+        self.isolation = isolation
+        self.n_consumers = consumers
+        self.consumer_start_delays = consumer_start_delays
+        self.duration_s = duration_s
+        self.pace_ms = pace_ms
+        self.drain_s = drain_s
+        self.check_duplicates = check_duplicates
+        self.check_order = check_order
+        self.producer_conf = producer_conf or {}
+
+        self.cluster = MockCluster(num_brokers=brokers,
+                                   topics={topic: partitions})
+        self.sockem = Sockem() if use_sockem else None
+        self.oracle = DeliveryOracle()
+        self.chaos = ChaosScheduler(self.cluster, self.sockem,
+                                    min_alive=min_alive)
+        self.produced = 0
+        self.errors: list[str] = []
+        self._stop_consumers = threading.Event()
+
+    # -- client builders --------------------------------------------------
+    def _conf(self, extra: dict) -> dict:
+        conf = {"bootstrap.servers": self.cluster.bootstrap_servers()}
+        if self.sockem is not None:
+            conf["connect_cb"] = self.sockem.connect_cb
+        conf.update(extra)
+        return conf
+
+    def _make_producer(self) -> Producer:
+        conf = self._conf({
+            "linger.ms": 2,
+            "enable.idempotence": True,
+            "message.send.max.retries": 1000,
+            "retry.backoff.ms": 50,
+            "message.timeout.ms": 120000,
+            "reconnect.backoff.ms": 50,
+        })
+        if self.transactional:
+            conf["transactional.id"] = f"chaos-tx-{self.seed}"
+        conf.update(self.producer_conf)
+        return Producer(conf)
+
+    def _make_consumer(self, i: int) -> Consumer:
+        return Consumer(self._conf({
+            "group.id": f"chaos-g-{self.seed}",
+            "client.id": f"chaos-c{i}",
+            "auto.offset.reset": "earliest",
+            "isolation.level": self.isolation,
+            "reconnect.backoff.ms": 50,
+        }))
+
+    # -- loops ------------------------------------------------------------
+    def _consume_loop(self, i: int, delay: float):
+        if delay > 0:
+            time.sleep(delay)
+        c = self._make_consumer(i)
+        try:
+            c.subscribe([self.topic])
+            while not self._stop_consumers.is_set():
+                m = c.poll(0.2)
+                if m is not None and m.error is None:
+                    self.oracle.record_consumed(m)
+        except Exception as e:
+            self.errors.append(f"consumer{i}: {e!r}")
+        finally:
+            c.close()
+
+    def _produce_plain(self, p: Producer, deadline: float):
+        seq = 0
+        while time.monotonic() < deadline:
+            v = b"s%08d" % seq
+            try:
+                p.produce(self.topic, v, partition=seq % self.partitions,
+                          on_delivery=self.oracle.dr())
+                seq += 1
+            except KafkaException as e:
+                if e.error.code.name == "_QUEUE_FULL":
+                    p.poll(0.05)
+                    continue
+                raise
+            p.poll(0)
+            if self.pace_ms:
+                time.sleep(self.pace_ms / 1000.0)
+        self.produced = seq
+
+    def _produce_txns(self, p: Producer, deadline: float):
+        seq = 0
+        tno = 0
+        while time.monotonic() < deadline:
+            tid = f"txn-{self.seed}-{tno}"
+            tno += 1
+            want_abort = (self.abort_every
+                          and tno % self.abort_every == 0)
+            self.oracle.begin_txn(tid)
+            try:
+                p.begin_transaction()
+                for _ in range(self.txn_size):
+                    v = b"s%08d" % seq
+                    p.produce(self.topic, v,
+                              partition=seq % self.partitions,
+                              on_delivery=self.oracle.dr(tid))
+                    seq += 1
+                    p.poll(0)
+                if want_abort:
+                    p.abort_transaction(60)
+                    self.oracle.abort_txn(tid)
+                else:
+                    p.commit_transaction(60)
+                    self.oracle.commit_txn(tid)
+            except KafkaException as e:
+                # abortable mid-storm error: roll the txn back and keep
+                # storming; if even the abort fails the outcome is
+                # client-side unknowable — record it as such (the storm
+                # asserts this never actually happens)
+                self.errors.append(f"txn {tid}: {e!r}")
+                try:
+                    p.abort_transaction(60)
+                    self.oracle.abort_txn(tid)
+                except KafkaException as e2:
+                    self.errors.append(f"txn {tid} abort: {e2!r}")
+                    self.oracle.unknown_txn(tid)
+            if self.pace_ms:
+                time.sleep(self.pace_ms / 1000.0)
+        self.produced = seq
+
+    # -- run --------------------------------------------------------------
+    def run(self, schedule: Schedule, *, tamper: Optional[Callable] = None,
+            raise_on_violation: bool = True) -> dict:
+        trace.enable()        # flight recorder armed for the whole storm
+        t0 = time.monotonic()
+        consumers = []
+        violation: Optional[OracleViolation] = None
+        try:
+            for i in range(self.n_consumers):
+                delay = (self.consumer_start_delays[i]
+                         if i < len(self.consumer_start_delays) else 0.0)
+                th = threading.Thread(target=self._consume_loop,
+                                      args=(i, delay),
+                                      name=f"chaos-consumer-{i}",
+                                      daemon=True)
+                th.start()
+                consumers.append(th)
+
+            p = self._make_producer()
+            try:
+                if self.transactional:
+                    p.init_transactions(30)
+                self.chaos.start(schedule)
+                deadline = time.monotonic() + self.duration_s
+                if self.transactional:
+                    self._produce_txns(p, deadline)
+                else:
+                    self._produce_plain(p, deadline)
+                self.chaos.join(timeout=schedule.duration + 30)
+                self.chaos.heal()
+                left = p.flush(60)
+                if left:
+                    self.errors.append(f"flush left {left} undelivered")
+            finally:
+                self.chaos.stop()
+                p.close()
+
+            # drain: consumers keep polling until every committed ack
+            # arrived (or the deadline turns the gap into a loss verdict)
+            drain_end = time.monotonic() + self.drain_s
+            while (self.oracle.missing_count() > 0
+                   and time.monotonic() < drain_end):
+                time.sleep(0.2)
+            # one extra grace round so trailing duplicates/reorders
+            # land in the ledger too, not just the last missing ack
+            time.sleep(0.5)
+            self._stop_consumers.set()
+            for th in consumers:
+                th.join(15)
+
+            if tamper is not None:
+                tamper(self.oracle)
+            try:
+                report = self.oracle.verify(
+                    check_duplicates=self.check_duplicates,
+                    check_order=self.check_order,
+                    raise_on_violation=raise_on_violation)
+            except OracleViolation as v:
+                violation = v
+                report = v.report
+            report.update({
+                "seed": self.seed,
+                "produced": self.produced,
+                "wall_s": round(time.monotonic() - t0, 2),
+                "timeline": self.chaos.timeline,
+                "replay_key": self.chaos.replay_key(),
+                "schedule_errors": self.chaos.errors,
+                "errors": self.errors,
+            })
+            if violation is not None:
+                raise violation
+            return report
+        finally:
+            self._stop_consumers.set()
+            for th in consumers:
+                th.join(15)
+            self.chaos.stop()
+            if self.sockem is not None:
+                self.sockem.kill_all()
+            self.cluster.stop()
+            trace.disable()
+
+
+# ------------------------------------------------------------ scenarios --
+def rolling_restart_eos(seed: int = 1, *, kills: int = 5,
+                        raise_on_violation: bool = True) -> dict:
+    """FLAGSHIP: >=5 rolling broker kill/restarts under sustained
+    transactional produce + read_committed consume; the oracle asserts
+    zero loss / zero duplication / per-partition order / txn atomicity
+    (ISSUE 7 acceptance storm)."""
+    interval = 1.2
+    storm = Storm(seed=seed, brokers=3, partitions=4, min_alive=2,
+                  transactional=True, txn_size=5, abort_every=7,
+                  duration_s=1.0 + kills * interval + 0.5, pace_ms=2,
+                  drain_s=30.0)
+    sched = Schedule(seed=seed)
+    for i in range(kills):
+        t = 1.0 + i * interval
+        sched.at(t, broker_kill("any"))
+        sched.at(t + 0.7, broker_restart())    # revive in kill order
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    kills_fired = sum(1 for e in report["timeline"]
+                      if e["action"] == "broker_kill"
+                      and (e.get("resolved") or {}).get("broker"))
+    report["kills_fired"] = kills_fired
+    return report
+
+
+def coordinator_death_midcommit(seed: int = 2, *, rounds: int = 3,
+                                raise_on_violation: bool = True) -> dict:
+    """Kill the transaction coordinator while commits are in flight;
+    the client must FindCoordinator its way to the failover broker and
+    the retried EndTxn must stay idempotent (no torn txns)."""
+    storm = Storm(seed=seed, brokers=3, partitions=2, min_alive=2,
+                  transactional=True, txn_size=4, abort_every=5,
+                  duration_s=1.0 + rounds * 2.0, pace_ms=2, drain_s=30.0)
+    tid = f"chaos-tx-{seed}"          # Storm._make_producer's txn id
+    sched = Schedule(seed=seed)
+    for i in range(rounds):
+        t = 1.0 + i * 2.0
+        sched.at(t, broker_kill(f"coordinator:{tid}"))
+        sched.at(t + 1.0, broker_restart())
+    return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def leader_migration_midbatch(seed: int = 3, *, migrations: int = 8,
+                              raise_on_violation: bool = True) -> dict:
+    """Migrate partition leadership every 400 ms while an idempotent
+    producer streams batches: every NOT_LEADER redirect must re-route
+    without loss, duplication, or reorder."""
+    storm = Storm(seed=seed, brokers=3, partitions=4,
+                  duration_s=1.0 + migrations * 0.4, pace_ms=2,
+                  drain_s=20.0)
+    sched = Schedule(seed=seed).every(
+        0.8, 0.4, migrations, lambda: leader_migrate("chaos", "any"))
+    return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def slow_network_rebalance(seed: int = 4, *,
+                           raise_on_violation: bool = True) -> dict:
+    """Slow, jittery, briefly half-partitioned network while a second
+    consumer joins mid-stream (eager rebalance): plain consumer-group
+    semantics are at-least-once, so only zero-loss is asserted —
+    duplicates/reorder across the handoff are legal here."""
+    storm = Storm(seed=seed, brokers=2, partitions=4, use_sockem=True,
+                  consumers=2, consumer_start_delays=(0.0, 1.5),
+                  isolation="read_uncommitted",
+                  check_duplicates=False, check_order=False,
+                  duration_s=4.5, pace_ms=3, drain_s=25.0)
+    sched = (Schedule(seed=seed)
+             .at(0.5, net(delay_ms=120, jitter_ms=80))
+             .at(2.0, net(rx_drop=True))          # half-open partition
+             .at(2.6, net(rx_drop=False))
+             .at(3.2, conn_kill())
+             .at(4.0, net(delay_ms=0, jitter_ms=0)))
+    return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def fast_kill_restart(seed: int = 7, *,
+                      raise_on_violation: bool = True) -> dict:
+    """Tier-1 deterministic smoke (<10 s): one broker kill + restart
+    under idempotent produce/consume, full invariant check."""
+    storm = Storm(seed=seed, brokers=2, partitions=2, min_alive=1,
+                  duration_s=2.2, pace_ms=2, drain_s=15.0)
+    sched = (Schedule(seed=seed)
+             .at(0.7, broker_kill("any"))
+             .at(1.5, broker_restart()))
+    return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def fast_net_flap(seed: int = 11, *,
+                  raise_on_violation: bool = True) -> dict:
+    """Tier-1 deterministic smoke (<10 s): partial writes, latency
+    jitter and a mid-flight connection kill via sockem, full invariant
+    check on a single-broker cluster."""
+    storm = Storm(seed=seed, brokers=1, partitions=2, use_sockem=True,
+                  duration_s=2.2, pace_ms=2, drain_s=15.0)
+    sched = (Schedule(seed=seed)
+             .at(0.3, net(max_write=7))
+             .at(0.8, net(delay_ms=80, jitter_ms=40, max_write=0))
+             .at(1.3, conn_kill())
+             .at(1.7, net(delay_ms=0, jitter_ms=0)))
+    return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def oracle_selftest(seed: int = 13) -> dict:
+    """Intentionally broken: a quiet run whose ledger is tampered
+    (one consumed record dropped = loss; one double-recorded = dup)
+    to prove a violation yields an OracleViolation carrying a flight-
+    recorder dump + oracle diff. Returns the report (ok=False)."""
+    def _tamper(oracle: DeliveryOracle):
+        with oracle._lock:
+            if len(oracle.consumed) >= 2:
+                oracle.consumed.pop()                    # lose one
+                oracle.consumed.append(oracle.consumed[0])   # dup one
+    storm = Storm(seed=seed, brokers=1, partitions=1,
+                  duration_s=0.8, pace_ms=2, drain_s=10.0)
+    try:
+        storm.run(Schedule(seed=seed), tamper=_tamper)
+    except OracleViolation as v:
+        return v.report
+    raise AssertionError("oracle self-test: tampered ledger was not "
+                         "flagged — the oracle is blind")
+
+
+#: name -> (callable(seed=..), description, runs-in-tier-1)
+SCENARIOS = {
+    "rolling_restart_eos": (
+        rolling_restart_eos,
+        "flagship: >=5 rolling broker kill/restarts under EOS "
+        "produce + read_committed consume", False),
+    "coordinator_death_midcommit": (
+        coordinator_death_midcommit,
+        "kill the txn coordinator mid-commit; EndTxn retry must stay "
+        "idempotent across failover", False),
+    "leader_migration_midbatch": (
+        leader_migration_midbatch,
+        "migrate partition leaders every 400ms under idempotent "
+        "produce", False),
+    "slow_network_rebalance": (
+        slow_network_rebalance,
+        "slow/jittery/half-partitioned network during a consumer-group "
+        "rebalance (zero-loss)", False),
+    "fast_kill_restart": (
+        fast_kill_restart,
+        "tier-1 smoke: one kill/restart, full invariants, <10s", True),
+    "fast_net_flap": (
+        fast_net_flap,
+        "tier-1 smoke: partial writes + jitter + conn kill, <10s", True),
+    "oracle_selftest": (
+        oracle_selftest,
+        "intentionally broken ledger proves violations dump flight + "
+        "diff", True),
+}

@@ -262,16 +262,14 @@ class MockCluster:
         # parked fetches: (deadline, conn, corrid, parsed_request)
         self._parked_fetches: list = []
         self._stop = threading.Event()
+        # controller bookkeeping: bumped on every leadership /
+        # broker-liveness change (a real controller bumps the metadata
+        # epoch; clients here refresh via NOT_LEADER/connection errors,
+        # tests and the chaos oracle observe this counter)
+        self.metadata_version = 1
 
         for b in range(1, num_brokers + 1):
-            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            ls.bind(("127.0.0.1", 0))
-            ls.listen(64)
-            ls.setblocking(False)
-            self._listeners[b] = ls
-            self._ports[b] = ls.getsockname()[1]
-            self._sel.register(ls, selectors.EVENT_READ, ("accept", b))
+            self._open_listener(b)
 
         if topics:
             for name, nparts in topics.items():
@@ -280,6 +278,33 @@ class MockCluster:
         self._thread = threading.Thread(target=self._run, name="mock-cluster",
                                         daemon=True)
         self._thread.start()
+
+    def _open_listener(self, broker_id: int) -> None:
+        """Bind + register broker ``broker_id``'s TCP listener. First
+        call picks an ephemeral port; later calls (broker restart)
+        rebind the SAME port so clients' cached metadata stays valid."""
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind(("127.0.0.1", self._ports.get(broker_id, 0)))
+        ls.listen(64)
+        ls.setblocking(False)
+        self._listeners[broker_id] = ls
+        self._ports[broker_id] = ls.getsockname()[1]
+        self._sel.register(ls, selectors.EVENT_READ, ("accept", broker_id))
+
+    def _close_listener(self, broker_id: int) -> None:
+        ls = self._listeners.get(broker_id)
+        if ls is None:
+            return
+        try:
+            self._sel.unregister(ls)
+        except (KeyError, ValueError):
+            pass
+        try:
+            ls.close()
+        except OSError:
+            pass
+        del self._listeners[broker_id]
 
     # ------------------------------------------------------------- public --
     def bootstrap_servers(self) -> str:
@@ -295,10 +320,28 @@ class MockCluster:
                                  for i in range(n)]
 
     def _new_partition(self, topic: str, i: int) -> MockPartition:
+        leader = (i % self.num_brokers) + 1
+        if leader in self._down:
+            # a topic created mid-storm must not be born with a dead
+            # leader — place it on the next alive broker in the ring
+            leader = self._next_alive(leader) or leader
         return MockPartition(topic=topic, id=i,
-                             leader=(i % self.num_brokers) + 1,
-                             replicas=[(i % self.num_brokers) + 1],
+                             leader=leader, replicas=[leader],
                              retention_bytes=self.retention_bytes)
+
+    def _next_alive(self, after: int) -> Optional[int]:
+        """Next alive broker in ring order after ``after``; None when
+        every broker is down."""
+        for k in range(1, self.num_brokers + 1):
+            b = ((after - 1 + k) % self.num_brokers) + 1
+            if b not in self._down:
+                return b
+        return None
+
+    def alive_brokers(self) -> list[int]:
+        with self._lock:
+            return [b for b in range(1, self.num_brokers + 1)
+                    if b not in self._down]
 
     def partition(self, topic: str, part: int) -> MockPartition:
         return self.topics[topic][part]
@@ -329,21 +372,94 @@ class MockCluster:
             self._throttle_ms[broker_id] = throttle_ms
 
     def set_broker_down(self, broker_id: int, down: bool = True) -> None:
+        """Take a broker down (or back up). Down means the LISTENER is
+        closed — new connects get ECONNREFUSED, so clients exercise the
+        real connect-retry/backoff path — and every established
+        connection is dropped mid-flight. Up rebinds the same port.
+
+        This is liveness only; ``kill_broker`` adds the controller's
+        reaction (leadership + coordinator reassignment)."""
         with self._lock:
             if down:
+                if broker_id in self._down:
+                    return
                 self._down.add(broker_id)
+                self._close_listener(broker_id)
                 for c in list(self._conns):
                     if c.broker_id == broker_id:
                         self._close(c)
             else:
+                if broker_id not in self._down:
+                    return
                 self._down.discard(broker_id)
+                self._open_listener(broker_id)
+            self.metadata_version += 1
+
+    # ------------------------------- controller role (chaos subsystem) ----
+    def kill_broker(self, broker_id: int) -> dict:
+        """Broker death as the controller sees it: close the listener
+        (new connects refused), drop in-flight connections, and move
+        partition leadership + controller id off the dead broker onto
+        alive replicas (coordinator placement follows automatically —
+        ``coordinator_for`` only ever names alive brokers). Returns a
+        summary dict (migrated leaders) for chaos timelines/tests."""
+        migrated = []
+        self.set_broker_down(broker_id, True)
+        with self._lock:
+            for tname, parts in self.topics.items():
+                for p in parts:
+                    if p.leader != broker_id:
+                        continue
+                    new = next((r for r in p.replicas
+                                if r not in self._down), None)
+                    new = new or self._next_alive(broker_id)
+                    if new is None:
+                        continue        # whole cluster is down
+                    p.leader = new
+                    if new not in p.replicas:
+                        p.replicas.append(new)
+                    migrated.append((tname, p.id, broker_id, new))
+            if self.controller_id == broker_id:
+                self.controller_id = self._next_alive(broker_id) or broker_id
+            self.metadata_version += 1
+        return {"broker": broker_id, "migrated": migrated}
+
+    def restart_broker(self, broker_id: int) -> dict:
+        """Bring a killed broker back: rebind its listener on the same
+        port. Leadership stays where the kill moved it (a real cluster
+        fails back only on preferred-leader election, which a chaos
+        schedule scripts explicitly via ``leader_migrate``)."""
+        self.set_broker_down(broker_id, False)
+        return {"broker": broker_id}
+
+    def rolling_restart(self, pause_s: float = 0.5) -> None:
+        """Kill + restart every broker in id order, one at a time,
+        waiting ``pause_s`` between steps (blocking convenience; chaos
+        schedules script the same thing with precise timing)."""
+        for b in range(1, self.num_brokers + 1):
+            self.kill_broker(b)
+            time.sleep(pause_s)
+            self.restart_broker(b)
+            time.sleep(pause_s)
 
     def set_partition_leader(self, topic: str, part: int, broker_id: int):
         with self._lock:
-            self.topics[topic][part].leader = broker_id
+            p = self.topics[topic][part]
+            p.leader = broker_id
+            if broker_id not in p.replicas:
+                p.replicas.append(broker_id)
+            self.metadata_version += 1
 
     def coordinator_for(self, group: str) -> int:
-        return (hash(group) % self.num_brokers) + 1
+        """Group/txn coordinator placement: hash ring, skipping dead
+        brokers — when a coordinator dies, FindCoordinator immediately
+        names the next alive broker (state is cluster-global here, so
+        the successor serves seamlessly, like a real coordinator
+        failover after __consumer_offsets replay)."""
+        base = (hash(group) % self.num_brokers) + 1
+        if base not in self._down:
+            return base
+        return self._next_alive(base) or base
 
     # -------------------------------------------------------------- loop ---
     def _run(self):
@@ -733,6 +849,7 @@ class MockCluster:
                         elif (part.leader == conn.broker_id
                               and part.follower_id is not None
                               and part.follower_id != conn.broker_id
+                              and part.follower_id not in self._down
                               and ver >= 11):
                             # KIP-392 redirect: the leader answers a
                             # v11 fetch with the nominated follower and
@@ -1176,7 +1293,15 @@ class MockCluster:
                 body["producer_epoch"])
             if err is None:
                 t = self.transactions[body["transactional_id"]]
-                if t.state != "Ongoing":
+                if t.state == ("CompleteCommit" if body["committed"]
+                               else "CompleteAbort"):
+                    # idempotent retry: the previous EndTxn landed but
+                    # its response was lost (coordinator died mid-
+                    # commit); the markers are already written, so the
+                    # retry must succeed, not INVALID_TXN_STATE — or
+                    # every coordinator-failover storm would go fatal
+                    pass
+                elif t.state != "Ongoing":
                     err = Err.INVALID_TXN_STATE
                 else:
                     self._end_txn_locked(t, body["committed"])

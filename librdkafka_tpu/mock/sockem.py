@@ -8,6 +8,12 @@ rdkafka_conf.c), applying scriptable network conditions
 
   - ``delay`` / ``jitter``: per-direction latency in ms
   - ``rate``: bandwidth cap in bytes/sec
+  - ``max_write``: partial writes — at most N bytes forwarded per
+    send(), so a request/response frame arrives in many small pieces
+    (reference sockem.c "txsize"; exercises frame reassembly)
+  - ``rx_drop`` / ``tx_drop``: one-direction partition — data in that
+    direction (rx = broker->client, tx = client->broker) is silently
+    discarded while set, the classic half-open network partition
   - ``kill()``: drop connections mid-flight (mid-request)
 
 Settings apply live to established connections — the knob set can be
@@ -41,6 +47,7 @@ class _Pump(threading.Thread):
         self.conn = conn
         self.src = src
         self.dst = dst
+        self.label = label          # "tx" (client->broker) or "rx"
 
     def run(self):
         em = self.conn.em
@@ -54,6 +61,12 @@ class _Pump(threading.Thread):
                     break
                 if not data:
                     break
+                # one-direction partition: silently discard this
+                # direction's traffic while the drop flag is set (the
+                # peer still sees an established connection — exactly a
+                # half-open network partition, not a close)
+                if (em.tx_drop if self.label == "tx" else em.rx_drop):
+                    continue
                 # latency: hold the chunk for delay ± jitter
                 d = em.delay_s
                 if em.jitter_s:
@@ -68,8 +81,13 @@ class _Pump(threading.Thread):
                 # retry on send timeout: a momentarily-full socketpair
                 # buffer must stall the pump, not kill the connection
                 while data and not self.conn.dead:
+                    # partial writes: cap each send at max_write bytes
+                    # so one frame lands in many pieces (live-settable,
+                    # like delay/rate — re-read every iteration)
+                    mw = em.max_write
+                    chunk = data[:mw] if mw > 0 else data
                     try:
-                        n = self.dst.send(data)
+                        n = self.dst.send(chunk)
                         data = data[n:]
                     except socket.timeout:
                         continue
@@ -117,10 +135,14 @@ class Sockem:
     """Factory + live control panel for emulated connections."""
 
     def __init__(self, *, delay_ms: float = 0, jitter_ms: float = 0,
-                 rate_bps: int = 0):
+                 rate_bps: int = 0, max_write: int = 0,
+                 rx_drop: bool = False, tx_drop: bool = False):
         self.delay_s = delay_ms / 1000.0
         self.jitter_s = jitter_ms / 1000.0
         self.rate = rate_bps
+        self.max_write = max_write
+        self.rx_drop = rx_drop
+        self.tx_drop = tx_drop
         self.conns: list[SockemConn] = []
         self._lock = threading.Lock()
         self.connect_count = 0
@@ -128,7 +150,10 @@ class Sockem:
     # -------------------------------------------------------- live knobs --
     def set(self, *, delay_ms: Optional[float] = None,
             jitter_ms: Optional[float] = None,
-            rate_bps: Optional[int] = None) -> None:
+            rate_bps: Optional[int] = None,
+            max_write: Optional[int] = None,
+            rx_drop: Optional[bool] = None,
+            tx_drop: Optional[bool] = None) -> None:
         """Change conditions for all current and future connections
         (reference: sockem_set 'delay'/'jitter'/'rate', sockem.c)."""
         if delay_ms is not None:
@@ -137,16 +162,26 @@ class Sockem:
             self.jitter_s = jitter_ms / 1000.0
         if rate_bps is not None:
             self.rate = rate_bps
+        if max_write is not None:
+            self.max_write = max_write
+        if rx_drop is not None:
+            self.rx_drop = rx_drop
+        if tx_drop is not None:
+            self.tx_drop = tx_drop
 
     def kill_all(self) -> int:
         """Drop every live connection mid-flight. Returns count killed."""
+        return self.kill()
+
+    def kill(self, count: Optional[int] = None) -> int:
+        """Drop live connections mid-flight, oldest (connect order)
+        first; ``count=None`` kills all. Returns count killed."""
         with self._lock:
-            conns = list(self.conns)
+            conns = [c for c in self.conns if not c.dead]
         n = 0
-        for c in conns:
-            if not c.dead:
-                c.close()
-                n += 1
+        for c in conns if count is None else conns[:count]:
+            c.close()
+            n += 1
         self._gc()
         return n
 

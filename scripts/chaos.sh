@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Full chaos tier: every fault-schedule storm, including the slow ones
+# tier-1 excludes (rolling EOS restarts, coordinator death, leader
+# migration, slow-network rebalance).  Pair with scripts/tier1.sh; the
+# quick pre-commit gate is `python bench.py --chaos` (<30 s, fast
+# scenarios only).  See CHAOS.md for the replay-from-seed workflow.
+cd "$(dirname "$0")/.."
+set -o pipefail
+timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"

@@ -57,14 +57,21 @@ def _no_leaked_engine_threads():
     yield
     deadline = time.monotonic() + 2.0   # grace for in-progress close()
 
+    # ISSUE 7 widens the thread contract to the chaos subsystem: a
+    # leaked "sockem-*" pump means a SockemConn outlived its test (its
+    # sockets still open), and a leaked "chaos-sched-*" thread means a
+    # ChaosScheduler was started but never joined/stopped — both keep
+    # injecting faults into whatever runs next.
     def leaked():
         return [t.name for t in threading.enumerate()
-                if t.is_alive() and "engine" in t.name]
+                if t.is_alive() and ("engine" in t.name
+                                     or t.name.startswith("sockem-")
+                                     or t.name.startswith("chaos-sched"))]
 
     while leaked() and time.monotonic() < deadline:
         time.sleep(0.05)
     assert not leaked(), \
-        f"leaked offload-engine dispatch threads: {leaked()}"
+        f"leaked engine/sockem/chaos threads: {leaked()}"
 
     from librdkafka_tpu.client.stats import _ACTIVE_STATS_TIMERS
     from librdkafka_tpu.obs import trace as _trace

@@ -94,6 +94,54 @@ def test_kill_mid_produce_retries_without_duplication(cluster):
     p.close()
 
 
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_request_timeout_retry_no_duplicate(cluster, backend):
+    """The reference 0075-retry.c shape: 2 s of injected latency makes
+    the in-flight ProduceRequest overshoot the request timeout
+    (socket.timeout.ms — the client-side budget; the topic's
+    request.timeout.ms is the broker-side wait), the client times it
+    out and retries, and after the latency clears the retry succeeds
+    with NO duplicate: whichever copy lands second is deduped broker-
+    side via the idempotent (pid, epoch, seq) check.  Runs on both the
+    sync CPU codec path and the ticketed offload-engine path — retry
+    semantics must be identical."""
+    em = Sockem()
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "connect_cb": em.connect_cb,
+                  "enable.idempotence": True,
+                  "compression.backend": backend,
+                  "compression.codec": "lz4",
+                  "linger.ms": 2,
+                  "socket.timeout.ms": 1000, "socket.max.fails": 0,
+                  "retry.backoff.ms": 100,
+                  "message.send.max.retries": 20,
+                  "message.timeout.ms": 30000})
+    # warm connection + PID assignment at full speed
+    p.produce("net", value=b"warm", partition=0)
+    assert p.flush(10.0) == 0
+
+    em.set(delay_ms=2000)
+    p.produce("net", value=b"timeout-victim", partition=0)
+    time.sleep(1.4)          # > socket.timeout.ms: the timeout fired
+    brokers = list(p.rk.brokers.values())
+    assert sum(b.c_req_timeouts for b in brokers) >= 1, \
+        "request should have timed out under 2s latency"
+    em.set(delay_ms=0)
+    assert p.flush(20.0) == 0
+
+    vals = _log_values(cluster)
+    assert vals.count(b"timeout-victim") == 1, \
+        f"retry duplicated the message: {vals}"
+    # the broker really saw the request more than once (original +
+    # timed-out retry), i.e. success came from a retry + dedup, not
+    # from a lucky slow first attempt
+    from librdkafka_tpu.protocol.proto import ApiKey
+    n_produce = sum(1 for _b, api in cluster.request_log
+                    if api == int(ApiKey.Produce))
+    assert n_produce >= 3, f"expected warm + original + retry, saw {n_produce}"
+    p.close()
+
+
 def test_connection_kill_recovery_consumer(cluster):
     """Consumer side: kill the connection between fetches; the consumer
     reconnects and resumes from its offsets without message loss."""
