@@ -1410,9 +1410,12 @@ def smoke_bench() -> dict:
         except OSError:
             pass
 
+    trace_ovh = _trace_overhead_gate()
     return {"elapsed_s": round(time.perf_counter() - t_start, 1),
             "legs": legs,
-            "trace_overhead": _trace_overhead_gate()}
+            "trace_overhead": trace_ovh,
+            "lockdep_overhead": _lockdep_overhead_gate(
+                trace_ovh["produce_ns_per_msg"])}
 
 
 def _traceview():
@@ -1478,6 +1481,48 @@ def _trace_overhead_gate() -> dict:
             "overhead_pct": round(overhead_pct, 4),
             "acceptance_pct_lt": 2.0,
             "pass": bool(overhead_pct < 2.0)}
+
+
+def _lockdep_overhead_gate(produce_ns_per_msg: float) -> dict:
+    """Disabled-lockdep overhead gate (ISSUE 8 satellite, same
+    methodology as the PR 5 trace gate): with the checker off, the
+    analysis.locks factory hands back PLAIN threading primitives — the
+    plain-vs-instrumented decision is made once at lock CREATION, so
+    the only conceivable per-message cost is a factory-made lock being
+    slower than a raw one.  The gate measures both round trips
+    directly and scales the delta by a conservative bound on lock
+    round trips per produced message (msg_cnt claim + toppar/arena
+    enqueue + broker queue push + DR accounting), against the measured
+    produce budget from the trace gate's leg.  Must stay < 1%."""
+    import threading
+    import timeit
+
+    from librdkafka_tpu.analysis import lockdep as _ld
+    from librdkafka_tpu.analysis.locks import new_lock
+
+    assert not _ld.enabled
+    factory = new_lock("bench.lockdep_gate")
+    plain = threading.Lock()
+    assert type(factory) is type(plain), \
+        "disabled factory must return a plain threading.Lock"
+    n = 200_000
+    t_factory = min(timeit.repeat(
+        "l.acquire(); l.release()", globals={"l": factory},
+        number=n, repeat=5))
+    t_plain = min(timeit.repeat(
+        "l.acquire(); l.release()", globals={"l": plain},
+        number=n, repeat=5))
+    delta_ns = max(0.0, (t_factory - t_plain) / n * 1e9)
+    locks_per_msg = 4.0
+    overhead_pct = delta_ns * locks_per_msg / produce_ns_per_msg * 100.0
+    return {"factory_lock_ns": round(t_factory / n * 1e9, 2),
+            "plain_lock_ns": round(t_plain / n * 1e9, 2),
+            "delta_ns": round(delta_ns, 2),
+            "locks_per_msg_bound": locks_per_msg,
+            "produce_ns_per_msg": round(produce_ns_per_msg, 1),
+            "overhead_pct": round(overhead_pct, 4),
+            "acceptance_pct_lt": 1.0,
+            "pass": bool(overhead_pct < 1.0)}
 
 
 def main():

@@ -1,0 +1,29 @@
+"""Static + runtime concurrency analysis (ANALYSIS.md).
+
+The engine is a genuinely concurrent system — per-device dispatch
+lanes, a warmup thread, broker threads, codec workers, the chaos
+scheduler and sockem pumps coordinate through ~35 lock sites — and the
+PR history established a set of invariants by convention (condvar
+waits not sleep-polls, one-attr-check trace hooks, validated conf
+Props, named threads).  This package turns those conventions into
+checks:
+
+  * :mod:`lockdep` — a runtime lock-ORDER checker in the spirit of the
+    kernel's lockdep and the helgrind/TSAN CI the reference client
+    runs (PAPER.md survey; librdkafka's ``rd_kafka_*lock`` discipline):
+    instrumented Lock/RLock/Condition wrappers record per-thread
+    acquisition stacks, build the global lock-order graph, and report
+    AB/BA inversions, longer cycles, and locks held across blocking
+    calls — each with the stack traces that created the edge.
+  * :mod:`locks` — the central factory every concurrent layer creates
+    its primitives through.  Disabled (the default), it returns PLAIN
+    ``threading`` primitives: the production hot path pays exactly
+    nothing (the decision happens once, at lock creation).
+  * :mod:`lint` — an AST lint encoding the project invariants (rule
+    catalog + rationale in ANALYSIS.md).
+
+Gate: ``scripts/check.sh`` runs the lint over the whole package plus a
+lockdep-enabled stress pass (engine pipeline, a fast chaos storm, txn
+commit/abort) and exits nonzero on any finding.  ``pytest --lockdep``
+runs the whole test suite under instrumented locks.
+"""

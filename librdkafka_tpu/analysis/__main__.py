@@ -1,0 +1,27 @@
+"""CLI: ``python -m librdkafka_tpu.analysis [lint|stress|all]``.
+
+``lint``   — AST project-invariant lint over the package (lint.py)
+``stress`` — lockdep-enabled stress pass (stress.py)
+``all``    — both (the scripts/check.sh gate); exit 1 on any finding
+"""
+import sys
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    cmd = argv[0] if argv else "all"
+    if cmd not in ("lint", "stress", "all"):
+        print(__doc__)
+        return 2
+    rc = 0
+    if cmd in ("lint", "all"):
+        from .lint import main as lint_main
+        rc |= lint_main(argv[1:] if cmd == "lint" else [])
+    if cmd in ("stress", "all"):
+        from .stress import main as stress_main
+        rc |= stress_main()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,113 @@
+"""Lockdep-enabled stress pass: the runtime half of scripts/check.sh.
+
+Drives every concurrent layer under instrumented locks and asserts a
+clean lock-order graph:
+
+  * **engine pipeline** — a tpu-backend producer (ticketed compress +
+    CRC through the offload engine's dispatch lanes) and a CRC-checking
+    consumer against the in-process mock, so app thread, rdk:main,
+    broker threads, the engine dispatch thread and the mock cluster
+    thread all interleave;
+  * **txn commit/abort** — the transactional FSM's RLock+condvar
+    against the coordinator paths;
+  * **fast chaos storm** — one broker kill/restart under idempotent
+    produce/consume (chaos scheduler + oracle + connect-retry paths).
+
+Any cycle or held-across-blocking finding fails the gate (exit 1) with
+both acquisition stacks printed.  Run: ``python -m
+librdkafka_tpu.analysis stress`` (or ``scripts/check.sh``).
+"""
+from __future__ import annotations
+
+import time
+
+from . import lockdep
+
+
+def _engine_pipeline_leg() -> int:
+    from .. import Consumer, Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.launch.min.batches": 2, "tpu.governor": False,
+                  "tpu.warmup": False, "compression.codec": "lz4",
+                  "linger.ms": 5})
+    c = None
+    try:
+        bs = p._rk.mock_cluster.bootstrap_servers()
+        for i in range(300):
+            p.produce("lockdep-eng", value=b"v%d" % i * 20,
+                      partition=i % 4)
+        assert p.flush(120.0) == 0, "engine leg: flush left messages"
+        c = Consumer({"bootstrap.servers": bs, "group.id": "lockdep-g",
+                      "auto.offset.reset": "earliest",
+                      "check.crcs": True})
+        c.subscribe(["lockdep-eng"])
+        got = 0
+        deadline = time.monotonic() + 60
+        while got < 300 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 300, f"engine leg: consumed {got}/300"
+        return got
+    finally:
+        p.close()
+        if c is not None:
+            c.close()
+
+
+def _txn_leg() -> None:
+    from .. import Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "transactional.id": "lockdep-tx",
+                  "compression.codec": "lz4", "linger.ms": 1})
+    try:
+        p.init_transactions(30)
+        p.begin_transaction()
+        for i in range(20):
+            p.produce("lockdep-txn", value=b"c%d" % i, partition=0)
+        p.commit_transaction(30)
+        p.begin_transaction()
+        for i in range(20):
+            p.produce("lockdep-txn", value=b"a%d" % i, partition=0)
+        p.flush(30)
+        p.abort_transaction(30)
+    finally:
+        p.close()
+
+
+def _chaos_leg() -> None:
+    from ..chaos.scenarios import fast_kill_restart
+
+    res = fast_kill_restart(seed=7)
+    assert res.get("ok", True), f"chaos leg violated delivery: {res}"
+
+
+def run_stress() -> dict:
+    """All three legs under one enabled window; returns the lockdep
+    report (``lockdep.clean(report)`` is the pass predicate)."""
+    lockdep.reset()
+    lockdep.enable()
+    try:
+        _engine_pipeline_leg()
+        _txn_leg()
+        _chaos_leg()
+    finally:
+        lockdep.disable()
+    return lockdep.report()
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    rep = run_stress()
+    print(lockdep.format_report(rep))
+    print(f"stress: engine pipeline + txn commit/abort + fast chaos "
+          f"storm in {time.perf_counter() - t0:.1f}s")
+    return 0 if lockdep.clean(rep) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -32,6 +32,7 @@ import threading
 from typing import Optional
 
 from ..obs import trace
+from ..analysis.locks import new_lock
 
 
 class OracleViolation(AssertionError):
@@ -59,7 +60,7 @@ class DeliveryOracle:
     consumers record from their own loops)."""
 
     def __init__(self, *, dump_dir: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = new_lock("chaos.oracle")
         self.dump_dir = dump_dir
         # acked produces: (topic, partition, offset, key, value, txn)
         self.acked: list[tuple] = []
@@ -233,8 +234,11 @@ class DeliveryOracle:
             report["diff_path"] = self._dump_diff(violations, report)
             # the trace that explains the storm must survive it: stamp
             # the verdict into the rings, then flight-dump them
-            trace.instant("chaos", "oracle_violation",
-                          {k: len(v) for k, v in violations.items()})
+            # (flight_record self-checks and returns None when tracing
+            # is off, so the key is present either way)
+            if trace.enabled:
+                trace.instant("chaos", "oracle_violation",
+                              {k: len(v) for k, v in violations.items()})
             report["flight_path"] = trace.flight_record("oracle_violation")
             if raise_on_violation:
                 raise OracleViolation(report)

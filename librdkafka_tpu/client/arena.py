@@ -24,6 +24,8 @@ from __future__ import annotations
 import time
 from typing import Optional
 
+from ..analysis.locks import new_lock
+
 _enqlane = None
 _enqlane_err = False
 
@@ -50,7 +52,6 @@ class _PyLane:
     same interface, always routes produce() to the fallback."""
 
     def __init__(self):
-        import threading
         self.map: dict = {}
         self.enabled = 0
         self.fatal = 0
@@ -59,7 +60,7 @@ class _PyLane:
         self.max_msgs = 100000
         self.max_bytes = 1 << 30
         self._fallback = None
-        self._lock = threading.Lock()
+        self._lock = new_lock("arena.pylane")
 
     def configure(self, fallback, wake, max_msgs, max_bytes,
                   copy_max=None):

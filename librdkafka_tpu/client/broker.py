@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional, TYPE_CHECKING
 
 from ..obs import trace as _trace
+from ..analysis import lockdep as _lockdep
 from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..utils import sockbuf
@@ -522,7 +523,6 @@ class Broker:
         self._codec_outstanding = 0     # async codec jobs in flight
         self._last_throttle = 0         # throttle_cb change detection
         self.toppars: set = set()           # toppars led by this broker
-        self._lock = threading.Lock()
         self.ts_connected = 0.0
         self.ts_state = time.monotonic()    # last state CHANGE (stats)
         # stats
@@ -602,7 +602,9 @@ class Broker:
             except Exception as e:  # keep the broker thread alive
                 self.rk.log("ERROR", f"broker {self.name} serve error: {e!r}")
                 self._disconnect(KafkaError(Err._FAIL, repr(e)))
-                time.sleep(0.05)
+                # error backoff, not a wait-for-state: nothing signals
+                # "the fault cleared", so there is no condvar to wait on
+                time.sleep(0.05)  # lint: ok sleep-poll
         self._disconnect(KafkaError(Err._DESTROY, "terminating"))
         # release deferred partitions' in-flight claims so another
         # broker (or a later instance) can fetch them.  Guarded: close()
@@ -713,6 +715,8 @@ class Broker:
         self._connect_wanted = False
         self._set_state(BrokerState.TRY_CONNECT)
         self.c_connects += 1
+        if _lockdep.enabled:
+            _lockdep.note_blocking("broker.connect")
         try:
             self.sock = self.rk.connect_cb(self.host, self.port,
                                            self.rk.conf.get(
@@ -1007,6 +1011,8 @@ class Broker:
             rlist.append(self.sock)
             if self._wbuf.pending():
                 wlist.append(self.sock)
+        if _lockdep.enabled:
+            _lockdep.note_blocking("broker.select")
         try:
             r, w, _ = select.select(rlist, wlist, [], timeout)
         except (OSError, ValueError):

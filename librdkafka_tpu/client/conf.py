@@ -472,6 +472,17 @@ PROPERTIES: list[Prop] = [
        "trace.ring.events events per thread to a JSON file on fatal "
        "error, CRC mismatch, or request timeout (bounded dumps per "
        "process; see TRACING.md for the dump location and format)."),
+    # ---- concurrency analysis (analysis/lockdep.py; ANALYSIS.md) ----
+    _p("analysis.lockdep", GLOBAL, "bool", False,
+       "Run this client under the lockdep lock-order checker "
+       "(analysis/lockdep.py): every Lock/RLock/Condition the client "
+       "creates is instrumented, feeding the global lock-order graph "
+       "(AB/BA inversions, cycles, locks held across blocking calls). "
+       "Inspect with analysis.lockdep.report(). Debug/CI tool — "
+       "instrumented acquisitions cost a few microseconds; disabled "
+       "(default) the factory returns plain threading primitives and "
+       "the hot path pays nothing (bench.py --smoke gates this at "
+       "< 1% of the produce budget)."),
     # ---- callbacks / opaque ----
     _p("error_cb", GLOBAL, "ptr", None, "Error callback."),
     _p("throttle_cb", GLOBAL, "ptr", None, "Throttle callback."),
@@ -620,6 +631,9 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "trace.enable"),
     (GLOBAL, "trace.ring.events"),
     (GLOBAL, "trace.dump.on.fatal"),
+    # lockdep concurrency analysis (ISSUE 8; the reference's analog is
+    # build-time helgrind/TSAN CI, not a conf row)
+    (GLOBAL, "analysis.lockdep"),
 })
 
 # Scope-keyed lookup: the reference's table has rows of the same name in

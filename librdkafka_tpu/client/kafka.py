@@ -17,6 +17,8 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..analysis import lockdep as _lockdep
+from ..analysis.locks import new_cond, new_lock
 from ..obs import trace as _trace
 from ..protocol import apis, proto
 from ..protocol.msgset import (iter_batches, parse_fetch_messages_v2,
@@ -44,7 +46,7 @@ class Topic:
         self.partition_cnt = -1
         self.ua_msgq: deque[Message] = deque()   # parked until metadata
         self.partitioner = partitioner_fn(tconf.get("partitioner"))
-        self.lock = threading.Lock()
+        self.lock = new_lock("kafka.topic")
 
 
 class IdempotenceManager:
@@ -57,7 +59,7 @@ class IdempotenceManager:
         self.state = "INIT"
         self.pid = -1
         self.epoch = -1
-        self._lock = threading.Lock()
+        self._lock = new_lock("kafka.idemp")
 
     def can_produce(self) -> bool:
         return self.state == "ASSIGNED"
@@ -140,6 +142,14 @@ class Kafka:
     def __init__(self, conf: Conf, client_type: str):
         self.conf = conf
         self.type = client_type
+        # lockdep (analysis/lockdep.py, ANALYSIS.md): must engage
+        # BEFORE the first lock below exists — the factory picks plain
+        # vs instrumented per object at creation time.  Refcounted like
+        # the tracer; released at close().
+        self._lockdep_ref = False
+        if conf.get("analysis.lockdep"):
+            _lockdep.enable()
+            self._lockdep_ref = True
         self.is_producer = client_type == PRODUCER
         self.is_consumer = client_type == CONSUMER
         self.rep = OpQueue("rk_rep")          # app-facing reply queue
@@ -147,18 +157,19 @@ class Kafka:
         self.timers = Timers()
         self.brokers: dict[int, Broker] = {}
         self._bootstrap: list[Broker] = []
-        self._brokers_lock = threading.Lock()
+        self._brokers_lock = new_lock("kafka.brokers")
         self.topics: dict[str, Topic] = {}
-        self._topics_lock = threading.Lock()
+        self._topics_lock = new_lock("kafka.topics")
         self._toppars: dict[tuple[str, int], Toppar] = {}
-        self._toppars_lock = threading.Lock()
+        self._toppars_lock = new_lock("kafka.toppars")
         self.metadata: dict = {"brokers": {}, "topics": {}}
-        self._metadata_lock = threading.Lock()
+        self._metadata_lock = new_lock("kafka.metadata")
         # notified (under _metadata_lock) after every metadata cache
         # update; sync callers (list_topics, offsets_for_times leader
         # wait) block here instead of sleep-polling (reference pattern:
         # replyq pop in rd_kafka_metadata, rdkafka.c)
-        self._metadata_cond = threading.Condition(self._metadata_lock)
+        self._metadata_cond = new_cond("kafka.metadata",
+                                       self._metadata_lock)
         self._metadata_inflight = False
         self._metadata_refresh_queued = False
         self._metadata_full_ts = 0.0   # completion time of last FULL refresh
@@ -183,11 +194,11 @@ class Kafka:
         self.dr_cnt = 0
         # serializes COMPOUND transitions (msg_cnt release + dr_cnt
         # claim) against flush()'s combined read
-        self._msg_cnt_lock = threading.Lock()
+        self._msg_cnt_lock = new_lock("kafka.msg_cnt")
         # flush() blocks here in DR-event mode; outstanding-count
         # decrements notify it only while flushing is set (one bool
         # check on the hot path, no wakeups otherwise)
-        self._outq_cond = threading.Condition(self._msg_cnt_lock)
+        self._outq_cond = new_cond("kafka.msg_cnt", self._msg_cnt_lock)
         self.cgrp = None                       # set by Consumer
         self.consumer = None                   # back-ref set by Consumer
         self.interceptors = conf.get("interceptors") or None
@@ -291,7 +302,7 @@ class Kafka:
         self._oauth_token = None      # (token, principal, expiry_unix)
         self._oauth_failure = None
         self._oauth_timer = None
-        self._oauth_cb_lock = threading.Lock()
+        self._oauth_cb_lock = new_lock("kafka.oauth_cb")
 
         # TLS context — one per instance, shared by all broker threads
         # (reference: rd_kafka_ssl_ctx_init, rdkafka_ssl.c)
@@ -1683,6 +1694,11 @@ class Kafka:
             # disables recording and frees every ring)
             self._trace_ref = False
             _trace.disable()
+        if self._lockdep_ref:
+            # the order graph survives for lockdep.report(); only the
+            # recording refcount drops
+            self._lockdep_ref = False
+            _lockdep.disable()
         with self._brokers_lock:
             brokers = list(self.brokers.values())
         for b in brokers:

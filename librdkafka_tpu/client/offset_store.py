@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Optional, TYPE_CHECKING
 
+from ..analysis.locks import new_lock
+
 if TYPE_CHECKING:
     from .kafka import Kafka
 
@@ -99,7 +101,7 @@ class FileOffsetStore:
     def __init__(self, rk: "Kafka"):
         self.rk = rk
         self._files: dict[tuple[str, int], _OffsetFile] = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("offset_store.files")
 
     def _file(self, topic: str, partition: int) -> _OffsetFile:
         key = (topic, partition)

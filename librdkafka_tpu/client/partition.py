@@ -18,6 +18,7 @@ from collections import deque
 from typing import Optional
 
 from ..protocol import proto
+from ..analysis.locks import new_lock
 from .msg import Message
 from .queue import OpQueue
 
@@ -52,7 +53,7 @@ class Toppar:
     def __init__(self, topic: str, partition: int):
         self.topic = topic
         self.partition = partition
-        self.lock = threading.Lock()
+        self.lock = new_lock("kafka.toppar")
 
         # ---- producer ----
         self.msgq: deque[Message] = deque()        # app → (lock) → broker

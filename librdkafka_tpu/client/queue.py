@@ -17,6 +17,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..analysis.locks import new_cond, new_lock
+
 
 class OpType(enum.Enum):
     """Op types (subset of the reference's ~40, rdkafka_op.h:73-124)."""
@@ -52,8 +54,8 @@ class OpQueue:
 
     def __init__(self, name: str = "q"):
         self.name = name
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lock = new_lock("queue.opq")
+        self._cond = new_cond("queue.opq", self._lock)
         self._items: list[Op] = []
         self._fwd: Optional["OpQueue"] = None
         self._wakeup_cb: Optional[Callable[[], None]] = None
@@ -164,12 +166,18 @@ class OpQueue:
                 return served
 
     def __len__(self) -> int:
+        # follow forwarding like rd_kafka_q_len (rkq_fwdq chain): a
+        # forwarded queue's ops live in its destination.  The
+        # destination's len is taken AFTER our lock drops — the
+        # pytest --lockdep sweep flagged the old nested hold as a
+        # queue.opq self-order (len(A) inside A.lock takes B.lock;
+        # a forwarding cycle would deadlock), and a length read is
+        # inherently a snapshot anyway.
         with self._lock:
-            # follow forwarding like rd_kafka_q_len (rkq_fwdq chain):
-            # a forwarded queue's ops live in its destination
-            if self._fwd is not None:
-                return len(self._fwd)
-            return len(self._items)
+            fwd = self._fwd
+            if fwd is None:
+                return len(self._items)
+        return len(fwd)
 
 
 class SyncReply:
@@ -182,7 +190,7 @@ class SyncReply:
     Replaces the sleep-polled waits flagged in rounds 2-3."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = new_cond("queue.sync_reply")
 
     def post(self) -> None:
         with self._cond:
@@ -218,7 +226,7 @@ class Timers:
 
     def __init__(self):
         self._heap: list[_Timer] = []
-        self._lock = threading.Lock()
+        self._lock = new_lock("queue.timers")
         self._seq = 0
 
     def add(self, interval_s: float, callback: Callable,

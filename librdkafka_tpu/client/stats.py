@@ -12,6 +12,7 @@ import time
 from typing import TYPE_CHECKING
 
 from ..utils.hdrhistogram import HdrHistogram
+from ..analysis.locks import new_lock
 
 if TYPE_CHECKING:
     from .kafka import Kafka
@@ -38,7 +39,7 @@ class Avg:
     def __init__(self, lowest: int = 1, highest: int = 60_000_000,
                  sigfigs: int = 3):
         self._hist = HdrHistogram(lowest, highest, sigfigs)
-        self._lock = threading.Lock()
+        self._lock = new_lock("stats.avg")
 
     def add(self, v: float):
         with self._lock:

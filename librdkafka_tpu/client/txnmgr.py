@@ -38,6 +38,7 @@ import time
 from typing import Optional, TYPE_CHECKING
 
 from ..protocol.proto import ApiKey
+from ..analysis.locks import new_cond, new_rlock
 from .broker import Request
 from .errors import Err, KafkaError, KafkaException
 from .queue import Op, OpType
@@ -75,11 +76,11 @@ class TransactionManager:
         self.pid = -1
         self.epoch = -1
         self.coord_id: Optional[int] = None
-        self._lock = threading.RLock()
+        self._lock = new_rlock("txn.mgr")
         # notified on AddPartitionsToTxn completion and fatal errors;
         # retriable backoffs ride timed waits on it (no sleep-polling
         # in client/ — test_0120 — and close()/fatal can wake them)
-        self._cv = threading.Condition(self._lock)
+        self._cv = new_cond("txn.mgr", self._lock)
         # partitions of the CURRENT transaction
         self._registered: set[tuple[str, int]] = set()
         self._pending: set[tuple[str, int]] = set()

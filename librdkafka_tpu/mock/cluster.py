@@ -30,6 +30,8 @@ from ..protocol.msgset import read_batch_header
 from ..utils import sockbuf
 from ..protocol.proto import ApiKey
 from ..utils.buf import Slice
+from ..analysis import lockdep as _lockdep
+from ..analysis.locks import new_rlock
 
 _TOPIC_CHARS = frozenset(
     "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
@@ -243,7 +245,7 @@ class MockCluster:
         # the pid -> tid reverse map the Produce path fences through
         self.transactions: dict[str, MockTransaction] = {}
         self._pid_tid: dict[int, str] = {}
-        self._lock = threading.RLock()
+        self._lock = new_rlock("mock.cluster")
         # fault injection
         self._err_stacks: dict[int, deque] = defaultdict(deque)
         self._rtt_ms: dict[int, float] = {}           # broker_id -> delay
@@ -464,6 +466,8 @@ class MockCluster:
     # -------------------------------------------------------------- loop ---
     def _run(self):
         while not self._stop.is_set():
+            if _lockdep.enabled:
+                _lockdep.note_blocking("mock.select")
             events = self._sel.select(timeout=0.005)
             now = time.monotonic()
             for key, mask in events:

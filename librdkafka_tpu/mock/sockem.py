@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis.locks import new_lock
+
 
 class _Pump(threading.Thread):
     """One direction of one proxied connection."""
@@ -108,7 +110,7 @@ class SockemConn:
         # the socket handed to the broker thread and our end of it
         self.app_side, self.shim_side = socket.socketpair()
         self.dead = False
-        self._lock = threading.Lock()
+        self._lock = new_lock("sockem.conn")
         # short poll timeout so live setting changes & kills apply fast
         self.real.settimeout(0.1)
         self.shim_side.settimeout(0.1)
@@ -144,7 +146,7 @@ class Sockem:
         self.rx_drop = rx_drop
         self.tx_drop = tx_drop
         self.conns: list[SockemConn] = []
-        self._lock = threading.Lock()
+        self._lock = new_lock("sockem.em")
         self.connect_count = 0
 
     # -------------------------------------------------------- live knobs --

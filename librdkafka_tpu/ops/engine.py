@@ -102,6 +102,8 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..obs import trace as _trace
+from ..analysis import lockdep as _lockdep
+from ..analysis.locks import new_cond, new_lock
 
 
 class Ticket:
@@ -422,9 +424,9 @@ class AsyncOffloadEngine:
         self._lanes: list[_Lane] = []
         self._shard_lane: Optional[_Lane] = None
         self._lanes_ready = False
-        self._lanes_lock = threading.Lock()
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        self._lanes_lock = new_lock("engine.lanes")
+        self._lock = new_lock("engine.queue")
+        self._cond = new_cond("engine.queue", self._lock)
         self._queue: deque[_Job] = deque()
         self._closed = False
         # warm items the dispatch thread missed on — the warmup thread
@@ -1225,6 +1227,10 @@ class AsyncOffloadEngine:
 
     # ------------------------------------------------------------ readback --
     def _readback(self, rec: _Launch) -> None:
+        if _lockdep.enabled:
+            # the device sync below can stall for a full launch round
+            # trip — holding any lock here would freeze submitters
+            _lockdep.note_blocking("engine.readback")
         try:
             if rec.kind == "compute":
                 import jax

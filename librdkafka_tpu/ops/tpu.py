@@ -23,6 +23,7 @@ import struct
 import numpy as np
 
 from . import cpu as _cpu
+from ..analysis.locks import new_lock
 from .crc32c_jax import crc32c_many_mxu as _crc32c_many_mxu
 from .lz4_jax import lz4_block_compress_many
 
@@ -89,7 +90,10 @@ class TpuCodecProvider:
         self.compile_cache_dir = compile_cache_dir or None
         self._engine = None
         self._engine_closed = False
-        self._engine_lock = None    # created lazily with the engine
+        # eager creation kills the old check-then-create race: two
+        # threads hitting _get_engine() concurrently could each have
+        # built a DIFFERENT Lock and both entered the critical section
+        self._engine_lock = new_lock("tpu.engine_init")
         self._mesh = None
         self._cpu = _cpu.CpuCodecProvider()
         self._warmup_thread = None
@@ -313,9 +317,6 @@ class TpuCodecProvider:
         if self.pipeline_depth <= 0 or self._engine_closed:
             return None
         if self._engine is None:
-            import threading
-            if self._engine_lock is None:
-                self._engine_lock = threading.Lock()
             with self._engine_lock:
                 if self._engine is None:
                     from .engine import AsyncOffloadEngine

@@ -5,6 +5,8 @@
 # quick pre-commit gate is `python bench.py --chaos` (<30 s, fast
 # scenarios only).  See CHAOS.md for the replay-from-seed workflow.
 cd "$(dirname "$0")/.."
+# concurrency + invariant gate first (lint + lockdep stress)
+scripts/check.sh || exit $?
 set -o pipefail
 timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m chaos -p no:cacheprovider -p no:xdist -p no:randomly "$@"

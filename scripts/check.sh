@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Concurrency + invariant gate (ANALYSIS.md): the AST project lint over
+# the whole package, then the lockdep-enabled stress pass (engine
+# pipeline + txn commit/abort + a fast chaos storm) asserting a clean
+# lock-order graph.  Exits nonzero on ANY finding — invoked at the top
+# of scripts/tier1.sh and scripts/chaos.sh; run it alone after touching
+# anything concurrent.  Deeper sweep: pytest --lockdep runs the whole
+# suite under instrumented locks.
+cd "$(dirname "$0")/.."
+set -o pipefail
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+    python -m librdkafka_tpu.analysis all

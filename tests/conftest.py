@@ -29,6 +29,36 @@ import time  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lockdep", action="store_true", default=False,
+        help="run the whole suite under instrumented locks "
+             "(analysis/lockdep.py): every Lock/RLock/Condition created "
+             "during the session feeds the lock-order graph; the "
+             "summary reports AB/BA inversions, cycles and locks held "
+             "across blocking calls, and a finding fails the run "
+             "(exit 3). See ANALYSIS.md.")
+
+
+def pytest_configure(config):
+    if config.getoption("--lockdep"):
+        from librdkafka_tpu.analysis import lockdep
+        lockdep.reset()
+        lockdep.enable()
+        config._lockdep_session = True
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not getattr(session.config, "_lockdep_session", False):
+        return
+    from librdkafka_tpu.analysis import lockdep
+    lockdep.disable()
+    rep = lockdep.report()
+    print("\n" + lockdep.format_report(rep))
+    if not lockdep.clean(rep) and session.exitstatus == 0:
+        session.exitstatus = 3
+
+
 def require_zstd():
     """Skip the calling test, actionably, when the optional zstandard
     module is absent (codec sweeps run their zstd legs wherever it is

@@ -1,0 +1,361 @@
+"""Analyzer tier (ISSUE 8): the lockdep runtime checker and the
+project-invariant lint — the tooling itself must be tested, or the
+gate it implements is hope with extra steps.
+
+Covers: a deliberately introduced AB/BA inversion reported with BOTH
+acquisition stacks, held-across-blocking detection, RLock re-entrancy
+(and condvar waits over it) never flagged, same-class distinct-instance
+nesting flagged, the ``analysis.lockdep`` conf knob wiring through a
+real produce round trip (clean graph + released refcount), one
+positive + one negative fixture per lint rule, pragma suppression, and
+a clean lint run over the real package (the scripts/check.sh gate).
+"""
+import threading
+
+from librdkafka_tpu.analysis import lint, lockdep, locks
+
+
+# ===================================================== lockdep runtime ==
+def test_abba_inversion_caught_with_both_stacks():
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            a = lockdep.DepLock("t.A")
+            b = lockdep.DepLock("t.B")
+
+            def fwd():
+                with a:
+                    with b:
+                        pass
+
+            th = threading.Thread(target=fwd, name="abba-fwd")
+            th.start()
+            th.join()
+            with b:            # the inversion, safely sequenced
+                with a:
+                    pass
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    pairs = [c for c in rep["cycles"]
+             if c["kind"] == "inconsistent_order"]
+    assert len(pairs) == 1, rep["cycles"]
+    c = pairs[0]
+    assert set(c["path"]) == {"t.A", "t.B"}
+    # both edges present, each carrying the acquisition stack that
+    # created it, attributed to the right thread
+    assert len(c["edges"]) == 2
+    assert {e["thread"] for e in c["edges"]} == {"abba-fwd",
+                                                 "MainThread"}
+    for e in c["edges"]:
+        assert "test_0128" in e["stack"], e
+        assert ("fwd" in e["stack"]) or ("test_abba" in e["stack"])
+    # the human rendering names the pair and includes the stacks
+    txt = lockdep.format_report(rep)
+    assert "inconsistent_order" in txt and "t.A" in txt
+    assert not lockdep.clean(rep)
+
+
+def test_held_across_blocking_detected_and_exonerated():
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            lk = lockdep.DepLock("t.blk")
+            lockdep.note_blocking("t.sock.recv")   # nothing held: fine
+            with lk:
+                lockdep.note_blocking("t.sock.recv")
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    assert len(rep["blocking"]) == 1, rep["blocking"]
+    v = rep["blocking"][0]
+    assert v["lock"] == "t.blk" and v["call"] == "t.sock.recv"
+    assert "test_0128" in v["stack"]
+    assert not lockdep.clean(rep)
+
+
+def test_rlock_reentrancy_never_flagged():
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            r = lockdep.DepRLock("t.R")
+            with r:
+                with r:                 # re-entrant: NOT an edge
+                    with r:
+                        pass
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    assert rep["cycles"] == [], rep["cycles"]
+    assert lockdep.clean(rep)
+
+
+def test_same_class_distinct_instances_flagged():
+    # two instances of one lock class nested = the two-threads/two-
+    # instances/opposite-order deadlock shape (kernel lockdep flags
+    # this unless explicitly annotated as ordered nesting)
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            a = lockdep.DepLock("t.same")
+            b = lockdep.DepLock("t.same")
+            with a:
+                with b:
+                    pass
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    kinds = {c["kind"] for c in rep["cycles"]}
+    assert kinds == {"self_order"}, rep["cycles"]
+
+
+def test_condition_wait_releases_the_held_set():
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            cv = lockdep.DepCondition("t.cv")
+            entered = threading.Event()
+            done = threading.Event()
+
+            def waiter():
+                with cv:
+                    entered.set()
+                    cv.wait(timeout=5.0)
+                done.set()
+
+            th = threading.Thread(target=waiter, name="cv-waiter")
+            th.start()
+            assert entered.wait(5.0)
+            # if wait() had NOT released through the wrapper, this
+            # acquire would park until the waiter's timeout
+            with cv:
+                cv.notify()
+            assert done.wait(5.0)
+            th.join(5.0)
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    assert lockdep.clean(rep), lockdep.format_report(rep)
+
+
+def test_condition_over_rlock_full_release_at_depth():
+    # the txnmgr pattern: Condition over an RLock, wait() at recursion
+    # depth 2 must fully release (stdlib _release_save) and restore
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            rl = lockdep.DepRLock("t.cvR")
+            cv = lockdep.DepCondition("t.cvR", rl)
+            entered = threading.Event()
+            done = threading.Event()
+
+            def waiter():
+                with rl:
+                    with rl:            # depth 2
+                        with cv:        # depth 3, same lock
+                            entered.set()
+                            cv.wait(timeout=5.0)
+                done.set()
+
+            th = threading.Thread(target=waiter, name="cvR-waiter")
+            th.start()
+            assert entered.wait(5.0)
+            with cv:
+                cv.notify_all()
+            assert done.wait(5.0)
+            th.join(5.0)
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    assert lockdep.clean(rep), lockdep.format_report(rep)
+
+
+def test_forwarded_queue_len_holds_one_lock_only():
+    """Regression (found by the pytest --lockdep sweep, PR 8): len()
+    of a forwarded OpQueue used to take the destination's lock while
+    still holding its own — a same-class nested hold (queue.opq
+    self-order) that a forwarding cycle would turn into a deadlock.
+    The fwd pointer is now read under the lock and the destination
+    measured after it drops."""
+    from librdkafka_tpu.client.queue import Op, OpQueue, OpType
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            a, b = OpQueue("a"), OpQueue("b")
+            a.forward_to(b)
+            a.push(Op(OpType.BROKER_WAKEUP))
+            assert len(a) == 1 == len(b)
+            rep = lockdep.report()
+        finally:
+            lockdep.disable()
+    assert lockdep.clean(rep), lockdep.format_report(rep)
+
+
+def test_factory_plain_when_disabled_instrumented_when_enabled():
+    import pytest
+    if lockdep.enabled:
+        pytest.skip("session runs under --lockdep; the disabled-mode "
+                    "half is covered by the default tier-1 run")
+    assert type(locks.new_lock("t.x")) is type(threading.Lock())
+    assert isinstance(locks.new_rlock("t.x"), type(threading.RLock()))
+    assert isinstance(locks.new_cond("t.x"), threading.Condition)
+    with lockdep.scope():
+        lockdep.enable()
+        try:
+            assert isinstance(locks.new_lock("t.x"), lockdep.DepLock)
+            assert isinstance(locks.new_rlock("t.x"), lockdep.DepRLock)
+            assert isinstance(locks.new_cond("t.x"),
+                              lockdep.DepCondition)
+        finally:
+            lockdep.disable()
+
+
+def test_client_knob_instruments_and_releases():
+    """analysis.lockdep=true wires the whole client through DepLocks:
+    a real produce round trip over the mock must leave a populated,
+    CLEAN graph (this is the tier-1 shadow of the scripts/check.sh
+    stress gate) and close() must drop the checker reference."""
+    from librdkafka_tpu import Producer
+    with lockdep.scope():
+        base = lockdep._enable_count
+        p = Producer({"bootstrap.servers": "",
+                      "test.mock.num.brokers": 1,
+                      "analysis.lockdep": True, "linger.ms": 1})
+        try:
+            assert lockdep.enabled
+            for i in range(100):
+                p.produce("ld-knob", value=b"v%d" % i, partition=i % 2)
+            assert p.flush(30.0) == 0
+        finally:
+            p.close()
+        assert lockdep._enable_count == base
+        rep = lockdep.report()
+    assert rep["acquisitions"] > 100
+    assert rep["classes"] >= 4          # kafka/queue/toppar/broker...
+    # the lock-order graph snapshot: the discipline the stress pass
+    # verified stays acyclic — any new inversion fails HERE first
+    assert lockdep.clean(rep), lockdep.format_report(rep)
+
+
+# ========================================================== lint rules ==
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def test_lint_sleep_poll():
+    bad = "import time\nwhile True:\n    time.sleep(0.1)\n"
+    assert _rules(lint.lint_source(bad, "client/x.py")) == ["sleep-poll"]
+    # same code outside client/: not this rule's scope
+    assert lint.lint_source(bad, "ops/x.py") == []
+    # non-loop sleep in client/ is allowed (startup delays etc.)
+    assert lint.lint_source("import time\ntime.sleep(0.1)\n",
+                            "client/x.py") == []
+    # pragma suppression with a reason
+    ok = ("import time\nwhile True:\n"
+          "    time.sleep(0.1)  # lint: ok sleep-poll\n")
+    assert lint.lint_source(ok, "client/x.py") == []
+
+
+def test_lint_conf_prop():
+    src = ('PROPERTIES = [\n'
+           '    _p("x.ms", GLOBAL, "int", 5, "doc"),\n'
+           ']\n')
+    fs = lint.lint_source(src, "client/conf.py", doc_names={"x.ms"})
+    assert _rules(fs) == ["conf-prop"] and "vmin" in fs[0].msg
+    good = ('PROPERTIES = [\n'
+            '    _p("x.ms", GLOBAL, "int", 5, "doc", vmin=0, vmax=9),\n'
+            '    _p("y.ms", GLOBAL, "int", 5, "Alias.", alias="x.ms"),\n'
+            ']\n')
+    assert lint.lint_source(good, "client/conf.py",
+                            doc_names={"x.ms", "y.ms"}) == []
+    # documented nowhere -> the doc-row finding
+    fs = lint.lint_source(good, "client/conf.py", doc_names={"x.ms"})
+    assert _rules(fs) == ["conf-prop"] and "CONFIGURATION.md" in fs[0].msg
+    # the rule only applies to conf.py
+    assert lint.lint_source(src, "client/other.py") == []
+
+
+def test_lint_trace_guard():
+    bad = "_trace.instant('a', 'b')\n"
+    assert _rules(lint.lint_source(bad, "client/x.py")) == ["trace-guard"]
+    good = "if _trace.enabled:\n    _trace.instant('a', 'b')\n"
+    assert lint.lint_source(good, "client/x.py") == []
+    # guard-variable form (the engine's t0 pattern)
+    gv = ("def f():\n"
+          "    t0 = _trace.now() if _trace.enabled else 0\n"
+          "    if t0:\n"
+          "        _trace.complete('a', 'b', t0)\n")
+    assert lint.lint_source(gv, "ops/x.py") == []
+    # guard ATTRIBUTE form (broker.py's self.t_crc_ns pattern)
+    ga = ("class P:\n"
+          "    def s(self):\n"
+          "        if _trace.enabled:\n"
+          "            self.t0 = _trace.now()\n"
+          "    def f(self):\n"
+          "        if self.t0:\n"
+          "            _trace.complete('a', 'b', self.t0)\n")
+    assert lint.lint_source(ga, "client/x.py") == []
+    # trace.py itself is exempt (it IS the tracer)
+    assert lint.lint_source(bad.replace("_trace", "trace"),
+                            "obs/trace.py") == []
+
+
+def test_lint_bare_except():
+    bad = "try:\n    f()\nexcept:\n    pass\n"
+    assert _rules(lint.lint_source(bad, "utils/x.py")) == ["bare-except"]
+    good = "try:\n    f()\nexcept Exception:\n    pass\n"
+    assert lint.lint_source(good, "utils/x.py") == []
+
+
+def test_lint_chaos_random():
+    bad = "import random\nx = random.random()\n"
+    assert _rules(lint.lint_source(bad, "chaos/x.py")) == ["chaos-random"]
+    # the seeded-Random constructor is exactly what the schedule does
+    good = "import random\nrng = random.Random(7)\nx = rng.random()\n"
+    assert lint.lint_source(good, "chaos/x.py") == []
+    # outside chaos/ the rule does not apply (sockem jitter is mock/)
+    assert lint.lint_source(bad, "mock/x.py") == []
+
+
+def test_lint_thread_name():
+    bad = "import threading\nt = threading.Thread(target=f)\n"
+    assert _rules(lint.lint_source(bad, "ops/x.py")) == ["thread-name"]
+    good = "import threading\nt = threading.Thread(target=f, name='x')\n"
+    assert lint.lint_source(good, "ops/x.py") == []
+    # subclass form: super().__init__ must forward a name
+    sub_bad = ("import threading\n"
+               "class P(threading.Thread):\n"
+               "    def __init__(self):\n"
+               "        super().__init__(daemon=True)\n")
+    assert _rules(lint.lint_source(sub_bad, "mock/x.py")) == ["thread-name"]
+    assert lint.lint_source(
+        sub_bad.replace("daemon=True", "daemon=True, name='p'"),
+        "mock/x.py") == []
+
+
+def test_lint_manual_acquire():
+    bad = "lk.acquire()\ntry:\n    f()\nfinally:\n    lk.release()\n"
+    assert _rules(lint.lint_source(bad, "client/x.py")) == \
+        ["manual-acquire"]
+    assert lint.lint_source("with lk:\n    f()\n", "client/x.py") == []
+    # lockdep's wrappers ARE the acquire implementation — exempt
+    assert lint.lint_source(bad, "analysis/lockdep.py") == []
+
+
+def test_lint_lock_factory():
+    bad = "import threading\nlk = threading.Lock()\n"
+    for scoped in ("client/x.py", "mock/x.py", "chaos/x.py",
+                   "ops/engine.py", "ops/tpu.py"):
+        assert _rules(lint.lint_source(bad, scoped)) == ["lock-factory"], \
+            scoped
+    # out-of-scope layers may keep plain primitives (module-level
+    # import-time locks: obs/trace.py, parallel/mesh.py, utils)
+    assert lint.lint_source(bad, "obs/x.py") == []
+    assert lint.lint_source(bad, "ops/crc32c_jax.py") == []
+    good = "lk = new_lock('x')\n"
+    assert lint.lint_source(good, "client/x.py") == []
+
+
+def test_lint_clean_over_real_package():
+    findings = lint.lint_package()
+    assert findings == [], "\n".join(str(f) for f in findings)
