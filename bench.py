@@ -1173,22 +1173,29 @@ def governor_bench() -> dict:
 
 
 def chaos_bench() -> dict:
-    """bench.py --chaos (<30 s): the chaos smoke leg — run every FAST
-    scenario from the chaos library (one broker kill/restart storm, one
-    network-shaping storm, the oracle self-test) and gate on a clean
+    """bench.py --chaos (<60 s): the chaos smoke leg — run every FAST
+    scenario from the chaos library (broker kill/restart, a real
+    SIGKILL+SIGSTOP storm against the out-of-process cluster, group
+    churn, network shaping, the oracle self-test) and gate on a clean
     delivery-invariant verdict; the full storms live behind
-    scripts/chaos.sh (pytest -m chaos)."""
+    scripts/chaos.sh (pytest -m chaos; --soak adds the soak tier).
+
+    Robustness-as-numbers (ISSUE 9): the external storm's throughput
+    under fire (``storm_msgs_s``) and post-SIGKILL recovery latency
+    (``recovery_*_ms`` time-to-first-ack) surface at top level so the
+    BENCH_r* trajectory tracks robustness regressions, not just
+    speed."""
     from librdkafka_tpu.chaos.oracle import OracleViolation
     from librdkafka_tpu.chaos.scenarios import SCENARIOS
 
     legs = {}
     all_ok = True
-    for name, (fn, _desc, fast) in SCENARIOS.items():
-        if not fast:
+    for name, sc in SCENARIOS.items():
+        if sc.tier != "fast":
             continue
         t0 = time.perf_counter()
         try:
-            report = fn()
+            report = sc.fn()
             # the self-test PASSES by detecting its planted violation
             # and proving the dump artifacts exist
             ok = ((not report["ok"] and bool(report.get("diff_path"))
@@ -1202,11 +1209,26 @@ def chaos_bench() -> dict:
                 "violations": {k: len(v) for k, v in
                                report["violations"].items() if v},
                 "wall_s": round(time.perf_counter() - t0, 2)}
+            if report.get("storm_metrics"):
+                legs[name]["storm_metrics"] = report["storm_metrics"]
+            if report.get("group"):
+                legs[name]["group"] = {
+                    k: report["group"][k]
+                    for k in ("members", "live", "departed",
+                              "assignments", "converged_s")}
         except (OracleViolation, Exception) as e:  # noqa: B014
             legs[name] = {"ok": False, "error": repr(e),
                           "wall_s": round(time.perf_counter() - t0, 2)}
         all_ok = all_ok and legs[name]["ok"]
-    return {"ok": all_ok, "legs": legs}
+    ext = (legs.get("fast_external_kill9") or {}).get("storm_metrics") or {}
+    rec = ext.get("recovery_ms") or {}
+    return {"ok": all_ok,
+            "storm_msgs_s": ext.get("storm_msgs_s"),
+            "storm_kills": ext.get("kills"),
+            "recovery_p50_ms": rec.get("p50"),
+            "recovery_p99_ms": rec.get("p99"),
+            "recovery_max_ms": rec.get("max"),
+            "legs": legs}
 
 
 def smoke_bench() -> dict:

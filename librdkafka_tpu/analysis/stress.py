@@ -86,8 +86,22 @@ def _chaos_leg() -> None:
     assert res.get("ok", True), f"chaos leg violated delivery: {res}"
 
 
+def _external_storm_leg() -> None:
+    """ISSUE 9: the fast out-of-process storm — a real SIGKILL and a
+    SIGSTOP brownout of broker OS processes while the instrumented
+    client-side locks (broker reconnect, oracle, scheduler, handle
+    control plane) feed the lock-order graph."""
+    from ..chaos.scenarios import fast_external_kill9
+
+    res = fast_external_kill9(seed=23)
+    assert res.get("ok", True), f"external leg violated delivery: {res}"
+    pids = [e for e in res.get("proc_events", []) if e["verb"] == "kill9"]
+    assert pids and all(e["verified_dead"] for e in pids), \
+        f"external leg: SIGKILL not pid-verified: {pids}"
+
+
 def run_stress() -> dict:
-    """All three legs under one enabled window; returns the lockdep
+    """All four legs under one enabled window; returns the lockdep
     report (``lockdep.clean(report)`` is the pass predicate)."""
     lockdep.reset()
     lockdep.enable()
@@ -95,6 +109,7 @@ def run_stress() -> dict:
         _engine_pipeline_leg()
         _txn_leg()
         _chaos_leg()
+        _external_storm_leg()
     finally:
         lockdep.disable()
     return lockdep.report()
@@ -105,7 +120,8 @@ def main() -> int:
     rep = run_stress()
     print(lockdep.format_report(rep))
     print(f"stress: engine pipeline + txn commit/abort + fast chaos "
-          f"storm in {time.perf_counter() - t0:.1f}s")
+          f"storm + external SIGKILL storm "
+          f"in {time.perf_counter() - t0:.1f}s")
     return 0 if lockdep.clean(rep) else 1
 
 

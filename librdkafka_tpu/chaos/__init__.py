@@ -11,13 +11,15 @@ CLI: ``python -m librdkafka_tpu.chaos --list``.
 from .oracle import DeliveryOracle, OracleViolation
 from .schedule import (Action, ChaosContext, ChaosScheduler, Schedule,
                        broker_kill, broker_restart, call, conn_kill,
-                       leader_migrate, net)
-from .scenarios import SCENARIOS, Storm
+                       leader_migrate, net, proc_cont, proc_kill9,
+                       proc_pause, proc_restart)
+from .scenarios import SCENARIOS, Scenario, Storm
 
 __all__ = [
     "Action", "ChaosContext", "ChaosScheduler", "Schedule",
     "broker_kill", "broker_restart", "call", "conn_kill",
     "leader_migrate", "net",
+    "proc_kill9", "proc_pause", "proc_cont", "proc_restart",
     "DeliveryOracle", "OracleViolation",
-    "SCENARIOS", "Storm",
+    "SCENARIOS", "Scenario", "Storm",
 ]

@@ -1,14 +1,17 @@
 """CLI storm runner: ``python -m librdkafka_tpu.chaos``.
 
     python -m librdkafka_tpu.chaos --list
-    python -m librdkafka_tpu.chaos --scenario rolling_restart_eos --seed 1
+    python -m librdkafka_tpu.chaos --scenario external_kill9_eos --seed 21
     python -m librdkafka_tpu.chaos --fast          # the tier-1 smoke set
-    python -m librdkafka_tpu.chaos --all
+    python -m librdkafka_tpu.chaos --all           # everything but soak
+    python -m librdkafka_tpu.chaos --all --soak    # everything
 
 Exit status 0 iff every requested storm's oracle verdict is clean
 (``oracle_selftest`` passes by *detecting* its planted violation).
 Reports print as JSON — the ``replay_key`` field plus ``--seed`` is the
-replay workflow: same seed, same fault timeline, byte-for-byte.
+replay workflow: same seed, same fault timeline, byte-for-byte (also
+against the out-of-process cluster: a fresh supervisor resolves the
+same targets).
 """
 from __future__ import annotations
 
@@ -23,7 +26,8 @@ from .scenarios import SCENARIOS
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m librdkafka_tpu.chaos",
-        description="chaos storms over the mock cluster")
+        description="chaos storms over the mock cluster (in-process "
+                    "and out-of-process tiers)")
     ap.add_argument("--scenario", action="append", default=[],
                     help="scenario name (repeatable); see --list")
     ap.add_argument("--seed", type=int, default=None,
@@ -32,22 +36,31 @@ def main(argv=None) -> int:
     ap.add_argument("--fast", action="store_true",
                     help="run the fast (tier-1) scenario set")
     ap.add_argument("--all", action="store_true",
-                    help="run every scenario, storms included")
+                    help="run every scenario except the soak tier "
+                         "(add --soak to include it)")
+    ap.add_argument("--soak", action="store_true",
+                    help="include the multi-minute soak storms in "
+                         "--all (or run them via --scenario)")
     ap.add_argument("--list", action="store_true",
-                    help="list scenarios and exit")
+                    help="list scenarios (name, tier, default seed, "
+                         "invariants checked) and exit")
     args = ap.parse_args(argv)
 
     if args.list:
-        for name, (_fn, desc, fast) in SCENARIOS.items():
-            tier = "fast" if fast else "slow"
-            print(f"{name:32s} [{tier}] {desc}")
+        print(f"{'scenario':32s} {'tier':5s} {'seed':>5s}  "
+              f"invariants checked")
+        for name, sc in SCENARIOS.items():
+            print(f"{name:32s} {sc.tier:5s} {sc.seed:5d}  "
+                  f"{sc.invariants}")
+            print(f"{'':32s} {'':5s} {'':5s}  - {sc.desc}")
         return 0
 
     names = list(args.scenario)
     if args.all:
-        names = list(SCENARIOS)
+        names = [n for n, sc in SCENARIOS.items()
+                 if sc.tier != "soak" or args.soak]
     elif args.fast:
-        names = [n for n, (_f, _d, fast) in SCENARIOS.items() if fast]
+        names = [n for n, sc in SCENARIOS.items() if sc.tier == "fast"]
     if not names:
         ap.error("pick --scenario NAME, --fast, or --all (see --list)")
 
@@ -57,7 +70,7 @@ def main(argv=None) -> int:
             print(f"unknown scenario {name!r} (see --list)",
                   file=sys.stderr)
             return 2
-        fn = SCENARIOS[name][0]
+        fn = SCENARIOS[name].fn
         kwargs = {} if args.seed is None else {"seed": args.seed}
         print(f"== {name} ==", file=sys.stderr)
         try:

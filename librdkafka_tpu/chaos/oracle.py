@@ -29,6 +29,7 @@ import json
 import os
 import tempfile
 import threading
+import time
 from typing import Optional
 
 from ..obs import trace
@@ -71,6 +72,17 @@ class DeliveryOracle:
         self.consumed: list[tuple] = []
         # txn id -> "open" | "committed" | "aborted" | "unknown"
         self.txns: dict[str, str] = {}
+        # monotonic stamp per acked row (parallel to ``acked``): feeds
+        # the storm-metrics recovery clock (time-to-first-ack after a
+        # process kill), never the delivery verdict
+        self.acked_ts: list[float] = []
+        # ---- consumer-group ledger (ISSUE 9 group invariants) ----
+        # member -> {"assigns": n, "current": set[(t,p)] | None,
+        #            "last_poll": ts, "last_assign": ts, "closed": bool}
+        self.members: dict[str, dict] = {}
+        # (ts, member, kind) for every membership/assignment change —
+        # convergence is judged relative to the LAST of these
+        self.group_events: list[tuple] = []
 
     # ---------------------------------------------------- producer side --
     def dr(self, txn: Optional[str] = None):
@@ -91,6 +103,7 @@ class DeliveryOracle:
                    txn: Optional[str] = None) -> None:
         with self._lock:
             self.acked.append((topic, partition, offset, key, value, txn))
+            self.acked_ts.append(time.monotonic())
 
     def begin_txn(self, txn: str) -> None:
         with self._lock:
@@ -119,6 +132,73 @@ class DeliveryOracle:
             self.consumed.append((msg.topic, msg.partition, msg.offset,
                                   msg.value))
 
+    # ------------------------------------------------------ group side --
+    def _member(self, member: str) -> dict:
+        st = self.members.get(member)
+        if st is None:
+            st = self.members[member] = {
+                "assigns": 0, "current": None, "last_poll": 0.0,
+                "last_assign": 0.0, "closed": False}
+        return st
+
+    def record_assign(self, member: str, partitions) -> None:
+        """on_assign callback: ``partitions`` is the member's NEW
+        ownership set as (topic, partition) pairs (empty is a real
+        assignment — a large group legally leaves members idle)."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._member(member)
+            st["assigns"] += 1
+            st["current"] = set(partitions)
+            st["last_assign"] = now
+            self.group_events.append((now, member, "assign"))
+
+    def record_revoke(self, member: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._member(member)
+            st["current"] = None        # between generations: owns nothing
+            self.group_events.append((now, member, "revoke"))
+
+    def record_poll(self, member: str) -> None:
+        """Liveness heartbeat: the member's consume loop is still
+        turning (stamped per loop iteration, stored O(1))."""
+        with self._lock:
+            self._member(member)["last_poll"] = time.monotonic()
+
+    def record_member_closed(self, member: str) -> None:
+        """The member left deliberately (churn departure / shutdown):
+        exempt from stuck-consumer and coverage checks."""
+        now = time.monotonic()
+        with self._lock:
+            self._member(member)["closed"] = True
+            self.group_events.append((now, member, "closed"))
+
+    def group_coverage(self, topic: str, n_partitions: int) -> dict:
+        """Live snapshot of group assignment state — the convergence
+        predicate the storm polls: ``converged`` iff every partition is
+        owned by exactly one live, assigned member."""
+        with self._lock:
+            live = {m: st for m, st in self.members.items()
+                    if not st["closed"]}
+            owned: dict[tuple, list] = {}
+            unassigned = []
+            for m, st in live.items():
+                if st["current"] is None:
+                    unassigned.append(m)
+                    continue
+                for tp in st["current"]:
+                    owned.setdefault(tp, []).append(m)
+        expected = {(topic, p) for p in range(n_partitions)}
+        missing = sorted(p for (t, p) in expected - set(owned)
+                         if t == topic)
+        overlaps = {f"{t}:{p}": sorted(ms)
+                    for (t, p), ms in owned.items() if len(ms) > 1}
+        return {"live_members": len(live), "missing": missing,
+                "overlaps": overlaps, "unassigned": sorted(unassigned),
+                "converged": (bool(live) and not missing and not overlaps
+                              and not unassigned)}
+
     # ---------------------------------------------------------- verdict --
     def stats(self) -> dict:
         with self._lock:
@@ -144,16 +224,39 @@ class DeliveryOracle:
 
     def verify(self, *, check_duplicates: bool = True,
                check_order: bool = True,
+               check_group: bool = False,
+               group_topic: Optional[str] = None,
+               group_partitions: int = 0,
+               converged_s: Optional[float] = None,
+               stuck_after_s: float = 8.0,
+               coverage: Optional[dict] = None,
+               now: Optional[float] = None,
                raise_on_violation: bool = True) -> dict:
         """Judge the ledger. Scenarios without exactly-once semantics
         (plain consumer-group rebalances are at-least-once) relax
         ``check_duplicates``/``check_order``; loss and txn atomicity
-        are always enforced."""
+        are always enforced.
+
+        ``check_group`` adds the ISSUE-9 consumer-group invariants over
+        the assignment ledger: **convergence** (the storm passes its
+        measured ``converged_s`` once the group settled, None = never —
+        a violation), **coverage** (final live assignments partition
+        ``group_topic``'s ``group_partitions`` exactly: nothing
+        unowned, nothing double-owned), and **no stuck consumer** (a
+        live member must have received at least one assignment and
+        polled within ``stuck_after_s`` of the verdict).
+
+        ``coverage``/``now``: the storm freezes its group verdict
+        (``group_coverage()`` snapshot + clock) BEFORE shutting its
+        consumers down — judging the live recompute instead would see
+        the deliberate LeaveGroup cascade of teardown as a coverage
+        hole.  When omitted (unit tests), both default to live."""
         with self._lock:
             acked = list(self.acked)
             consumed = list(self.consumed)
             txns = dict(self.txns)
             failed = list(self.failed)
+            members = {m: dict(st) for m, st in self.members.items()}
 
         lost, duplicated, reordered = [], [], []
         aborted_seen, torn = [], []
@@ -214,6 +317,52 @@ class DeliveryOracle:
         violations = {"lost": lost, "duplicated": duplicated,
                       "reordered": reordered,
                       "aborted_seen": aborted_seen, "torn_txns": torn}
+
+        # -- consumer-group invariants (assignment ledger) ----------------
+        group_blob = None
+        if check_group:
+            unconverged, stuck = [], []
+            cov = (coverage if coverage is not None else
+                   self.group_coverage(group_topic or "",
+                                       group_partitions))
+            if converged_s is None:
+                unconverged.append(
+                    {"reason": "no_convergence_within_bound", **{
+                        k: cov[k] for k in ("missing", "overlaps",
+                                            "unassigned")}})
+            else:
+                # converged once, but the FINAL state must still hold:
+                # a late rebalance may not leave holes or double owners
+                if cov["missing"]:
+                    unconverged.append({"reason": "uncovered_partitions",
+                                        "missing": cov["missing"]})
+                if cov["overlaps"]:
+                    unconverged.append({"reason": "overlapping_ownership",
+                                        "overlaps": cov["overlaps"]})
+            now = time.monotonic() if now is None else now
+            for m, st in sorted(members.items()):
+                if st["closed"]:
+                    continue
+                if st["assigns"] == 0:
+                    stuck.append({"member": m, "reason": "never_assigned"})
+                elif now - st["last_poll"] > stuck_after_s:
+                    stuck.append({"member": m, "reason": "stopped_polling",
+                                  "stale_s": round(now - st["last_poll"],
+                                                   2)})
+            violations["unconverged"] = unconverged
+            violations["stuck_consumer"] = stuck
+            group_blob = {
+                "members": len(members),
+                "live": sum(1 for st in members.values()
+                            if not st["closed"]),
+                "departed": sum(1 for st in members.values()
+                                if st["closed"]),
+                "assignments": sum(st["assigns"]
+                                   for st in members.values()),
+                "converged_s": converged_s,
+                "coverage": cov,
+            }
+
         ok = not any(violations.values())
         report = {
             "ok": ok,
@@ -230,6 +379,8 @@ class DeliveryOracle:
             "violations": {k: v[:REPORT_ROW_CAP]
                            for k, v in violations.items()},
         }
+        if group_blob is not None:
+            report["group"] = group_blob
         if not ok:
             report["diff_path"] = self._dump_diff(violations, report)
             # the trace that explains the storm must survive it: stamp

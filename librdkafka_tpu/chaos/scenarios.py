@@ -1,47 +1,64 @@
 """Scenario library: canned chaos storms over the real-TCP mock
-cluster, each returning a structured report (oracle verdict + fault
-timeline + replay key).
+cluster — in-process OR the ISSUE-9 out-of-process tier, where every
+broker is a real OS process and faults are real signals.
 
 Run via ``python -m librdkafka_tpu.chaos`` (``--list`` to enumerate),
 ``bench.py --chaos`` (the fast legs as a smoke gate), or the pytest
-tier in tests/test_0127_chaos.py (fast scenarios in tier-1, full storms
-``slow``-marked behind ``scripts/chaos.sh``).
+tiers (fast scenarios in tier-1, full storms ``slow``-marked behind
+``scripts/chaos.sh``, the multi-minute soak behind
+``scripts/chaos.sh --soak``).
 
 Every scenario is deterministic from its seed: the fault timeline's
-``replay_key`` is identical across runs (schedule.py's contract), so a
-failing storm is re-run with the same seed and the same faults fire in
-the same order against the same targets.
+``replay_key`` is identical across runs (schedule.py's contract) —
+including against the external cluster, where a fresh supervisor
+process must resolve the same targets (coordinator placement hashes
+stably, alive-set bookkeeping is handle-local).
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable, NamedTuple, Optional
 
 from ..client.consumer import Consumer
 from ..client.errors import KafkaException
 from ..client.producer import Producer
 from ..mock.cluster import MockCluster
+from ..mock.external import ClusterHandle
 from ..mock.sockem import Sockem
 from ..obs import trace
 from .oracle import DeliveryOracle, OracleViolation
 from .schedule import (ChaosScheduler, Schedule, broker_kill,
-                       broker_restart, conn_kill, leader_migrate, net)
+                       broker_restart, conn_kill, leader_migrate, net,
+                       proc_cont, proc_kill9, proc_pause, proc_restart)
+
+
+def _pct(vals: list, q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 1)
 
 
 # ---------------------------------------------------------------- storm --
 class Storm:
-    """One storm run: cluster + optional sockem + oracle + scheduler +
-    paced producer/consumer loops.  Scenarios configure and run it;
+    """One storm run: cluster (in-process MockCluster or external
+    ClusterHandle) + optional sockem + oracle + scheduler + paced
+    producer/consumer loops.  Scenarios configure and run it;
     everything tears down in ``finally`` so a failed storm never leaks
-    threads into the next one (the conftest fixtures police this)."""
+    threads — or broker subprocesses — into the next one (the conftest
+    fixtures police both)."""
 
     def __init__(self, *, seed: int, brokers: int = 3,
                  partitions: int = 4, topic: str = "chaos",
+                 external: bool = False,
                  use_sockem: bool = False, min_alive: int = 1,
                  transactional: bool = False, txn_size: int = 5,
                  abort_every: int = 0, isolation: str = "read_committed",
                  consumers: int = 1, consumer_start_delays=(0.0,),
+                 check_group: bool = False, converge_s: float = 20.0,
+                 churn_consumers: int = 0, churn_start_s: float = 1.0,
+                 churn_period_s: float = 0.5, churn_lifetime_s: float = 2.0,
                  duration_s: float = 3.0, pace_ms: float = 4.0,
                  drain_s: float = 20.0,
                  check_duplicates: bool = True, check_order: bool = True,
@@ -49,12 +66,19 @@ class Storm:
         self.seed = seed
         self.topic = topic
         self.partitions = partitions
+        self.external = external
         self.transactional = transactional
         self.txn_size = txn_size
         self.abort_every = abort_every
         self.isolation = isolation
         self.n_consumers = consumers
         self.consumer_start_delays = consumer_start_delays
+        self.check_group = check_group
+        self.converge_s = converge_s
+        self.churn_consumers = churn_consumers
+        self.churn_start_s = churn_start_s
+        self.churn_period_s = churn_period_s
+        self.churn_lifetime_s = churn_lifetime_s
         self.duration_s = duration_s
         self.pace_ms = pace_ms
         self.drain_s = drain_s
@@ -62,14 +86,22 @@ class Storm:
         self.check_order = check_order
         self.producer_conf = producer_conf or {}
 
-        self.cluster = MockCluster(num_brokers=brokers,
-                                   topics={topic: partitions})
+        if external:
+            assert not use_sockem, \
+                "sockem shapes the CLIENT socket; pair it with the " \
+                "in-process tier (process faults cover the server side)"
+            self.cluster = ClusterHandle(brokers=brokers,
+                                         topics={topic: partitions})
+        else:
+            self.cluster = MockCluster(num_brokers=brokers,
+                                       topics={topic: partitions})
         self.sockem = Sockem() if use_sockem else None
         self.oracle = DeliveryOracle()
         self.chaos = ChaosScheduler(self.cluster, self.sockem,
                                     min_alive=min_alive)
         self.produced = 0
         self.errors: list[str] = []
+        self._converged_s: Optional[float] = None
         self._stop_consumers = threading.Event()
 
     # -- client builders --------------------------------------------------
@@ -88,6 +120,11 @@ class Storm:
             "retry.backoff.ms": 50,
             "message.timeout.ms": 120000,
             "reconnect.backoff.ms": 50,
+            # storms kill the same broker many times in a row; the
+            # default 10 s backoff ceiling compounds across cycles
+            # into multi-second ack wedges (correct client behavior,
+            # wrong rig tuning — a chaos rig wants fast re-probing)
+            "reconnect.backoff.max.ms": 1000,
         })
         if self.transactional:
             conf["transactional.id"] = f"chaos-tx-{self.seed}"
@@ -95,28 +132,64 @@ class Storm:
         return Producer(conf)
 
     def _make_consumer(self, i: int) -> Consumer:
-        return Consumer(self._conf({
+        conf = {
             "group.id": f"chaos-g-{self.seed}",
             "client.id": f"chaos-c{i}",
             "auto.offset.reset": "earliest",
             "isolation.level": self.isolation,
             "reconnect.backoff.ms": 50,
-        }))
+            "reconnect.backoff.max.ms": 1000,
+        }
+        if self.check_group:
+            # group-heavy storms: heartbeat well inside the mock's
+            # rebalance window (3 s) or halves of a churning group keep
+            # missing each other's rebalances and the group oscillates
+            # between two stable sub-covers instead of converging
+            conf["heartbeat.interval.ms"] = 400
+            conf["session.timeout.ms"] = 6000
+        return Consumer(self._conf(conf))
 
     # -- loops ------------------------------------------------------------
-    def _consume_loop(self, i: int, delay: float):
-        if delay > 0:
-            time.sleep(delay)
+    def _consume_loop(self, i: int, delay: float,
+                      lifetime: Optional[float] = None):
+        """One group member. ``lifetime`` makes it a churner: it polls
+        for that long, then leaves the group deliberately — overlapping
+        churner lifetimes ARE the join/leave storm."""
+        member = f"c{i}"
+        if delay > 0 and self._stop_consumers.wait(delay):
+            return
         c = self._make_consumer(i)
+        oracle = self.oracle
         try:
-            c.subscribe([self.topic])
+            if self.check_group:
+                def _on_assign(cons, parts, _m=member):
+                    oracle.record_assign(
+                        _m, [(tp.topic, tp.partition) for tp in parts])
+                    cons.assign(parts)
+
+                def _on_revoke(cons, parts, _m=member):
+                    oracle.record_revoke(_m)
+                    cons.unassign()
+
+                c.subscribe([self.topic], on_assign=_on_assign,
+                            on_revoke=_on_revoke)
+            else:
+                c.subscribe([self.topic])
+            deadline = (time.monotonic() + lifetime
+                        if lifetime is not None else None)
             while not self._stop_consumers.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    break
                 m = c.poll(0.2)
+                if self.check_group:
+                    oracle.record_poll(member)
                 if m is not None and m.error is None:
-                    self.oracle.record_consumed(m)
+                    oracle.record_consumed(m)
         except Exception as e:
             self.errors.append(f"consumer{i}: {e!r}")
         finally:
+            if self.check_group and lifetime is not None:
+                oracle.record_member_closed(member)
             c.close()
 
     def _produce_plain(self, p: Producer, deadline: float):
@@ -177,6 +250,44 @@ class Storm:
                 time.sleep(self.pace_ms / 1000.0)
         self.produced = seq
 
+    # -- metrics ----------------------------------------------------------
+    def _storm_metrics(self, timeline: list[dict]) -> Optional[dict]:
+        """Robustness-as-numbers (BENCH_r* trajectory): throughput
+        sustained while faults fired, and time-to-first-ack after each
+        process/broker kill — the client's measured recovery latency."""
+        fired = [e for e in timeline
+                 if (e.get("resolved") or {}).get("broker") is not None
+                 and "mono" in e]
+        if not fired:
+            return None
+        with self.oracle._lock:
+            acked_ts = list(self.oracle.acked_ts)
+        t0, t1 = fired[0]["mono"], fired[-1]["mono"]
+        window = max(t1 - t0, 1e-3)
+        in_window = sum(1 for t in acked_ts if t0 <= t <= t1)
+        recovery, unrecovered = [], 0
+        kills = [e["mono"] for e in fired
+                 if e["action"] in ("broker_kill", "proc_kill9")]
+        for k in kills:
+            nxt = next((t for t in acked_ts if t > k), None)
+            if nxt is None:
+                unrecovered += 1
+            else:
+                recovery.append((nxt - k) * 1000.0)
+        return {
+            "storm_window_s": round(window, 2),
+            "storm_acks": in_window,
+            "storm_msgs_s": round(in_window / window, 1),
+            "kills": len(kills),
+            "recovery_ms": {
+                "per_kill": [round(r, 1) for r in recovery],
+                "p50": _pct(recovery, 0.50),
+                "p99": _pct(recovery, 0.99),
+                "max": _pct(recovery, 1.0),
+                "unrecovered": unrecovered,
+            },
+        }
+
     # -- run --------------------------------------------------------------
     def run(self, schedule: Schedule, *, tamper: Optional[Callable] = None,
             raise_on_violation: bool = True) -> dict:
@@ -191,6 +302,18 @@ class Storm:
                 th = threading.Thread(target=self._consume_loop,
                                       args=(i, delay),
                                       name=f"chaos-consumer-{i}",
+                                      daemon=True)
+                th.start()
+                consumers.append(th)
+            # churners: staggered joins, bounded lifetimes — their
+            # overlap is the group join/leave storm
+            for j in range(self.churn_consumers):
+                idx = self.n_consumers + j
+                delay = self.churn_start_s + j * self.churn_period_s
+                th = threading.Thread(target=self._consume_loop,
+                                      args=(idx, delay,
+                                            self.churn_lifetime_s),
+                                      name=f"chaos-consumer-{idx}",
                                       daemon=True)
                 th.start()
                 consumers.append(th)
@@ -223,22 +346,55 @@ class Storm:
             # one extra grace round so trailing duplicates/reorders
             # land in the ledger too, not just the last missing ack
             time.sleep(0.5)
+
+            # group-invariant storms: the still-live members must
+            # settle into one exact cover of the partitions; the time
+            # that takes (from storm end) is the convergence metric
+            group_snapshot = None
+            if self.check_group:
+                conv_t0 = time.monotonic()
+                conv_end = conv_t0 + self.converge_s
+                while time.monotonic() < conv_end:
+                    if self.oracle.group_coverage(
+                            self.topic, self.partitions)["converged"]:
+                        self._converged_s = round(
+                            time.monotonic() - conv_t0, 2)
+                        break
+                    time.sleep(0.2)
+                # freeze the verdict BEFORE teardown: stopping the
+                # consumers is a deliberate LeaveGroup cascade that a
+                # live recompute would misread as lost coverage
+                group_snapshot = {
+                    "coverage": self.oracle.group_coverage(
+                        self.topic, self.partitions),
+                    "now": time.monotonic()}
+
             self._stop_consumers.set()
             for th in consumers:
                 th.join(15)
 
             if tamper is not None:
                 tamper(self.oracle)
+            group_kwargs = {}
+            if self.check_group:
+                group_kwargs = {"check_group": True,
+                                "group_topic": self.topic,
+                                "group_partitions": self.partitions,
+                                "converged_s": self._converged_s,
+                                "coverage": group_snapshot["coverage"],
+                                "now": group_snapshot["now"]}
             try:
                 report = self.oracle.verify(
                     check_duplicates=self.check_duplicates,
                     check_order=self.check_order,
-                    raise_on_violation=raise_on_violation)
+                    raise_on_violation=raise_on_violation,
+                    **group_kwargs)
             except OracleViolation as v:
                 violation = v
                 report = v.report
             report.update({
                 "seed": self.seed,
+                "external": self.external,
                 "produced": self.produced,
                 "wall_s": round(time.monotonic() - t0, 2),
                 "timeline": self.chaos.timeline,
@@ -246,6 +402,11 @@ class Storm:
                 "schedule_errors": self.chaos.errors,
                 "errors": self.errors,
             })
+            metrics = self._storm_metrics(self.chaos.timeline)
+            if metrics is not None:
+                report["storm_metrics"] = metrics
+            if self.external:
+                report["proc_events"] = list(self.cluster.proc_events)
             if violation is not None:
                 raise violation
             return report
@@ -263,10 +424,8 @@ class Storm:
 # ------------------------------------------------------------ scenarios --
 def rolling_restart_eos(seed: int = 1, *, kills: int = 5,
                         raise_on_violation: bool = True) -> dict:
-    """FLAGSHIP: >=5 rolling broker kill/restarts under sustained
-    transactional produce + read_committed consume; the oracle asserts
-    zero loss / zero duplication / per-partition order / txn atomicity
-    (ISSUE 7 acceptance storm)."""
+    """In-process flagship (ISSUE 7): >=5 rolling broker kill/restarts
+    under sustained transactional produce + read_committed consume."""
     interval = 1.2
     storm = Storm(seed=seed, brokers=3, partitions=4, min_alive=2,
                   transactional=True, txn_size=5, abort_every=7,
@@ -282,6 +441,39 @@ def rolling_restart_eos(seed: int = 1, *, kills: int = 5,
                       if e["action"] == "broker_kill"
                       and (e.get("resolved") or {}).get("broker"))
     report["kills_fired"] = kills_fired
+    return report
+
+
+def external_kill9_eos(seed: int = 21, *, kills: int = 3,
+                       raise_on_violation: bool = True) -> dict:
+    """FLAGSHIP (ISSUE 9): >=3 ``SIGKILL``s of real broker OS
+    processes — pid-verified dead — under sustained EOS produce +
+    read_committed consume; the oracle asserts all four delivery
+    invariants PLUS the group invariants (the consumer must re-acquire
+    full coverage after every kill, converge, and never wedge).
+
+    The EOS consumer is a single-member group: zero-duplication across
+    partition OWNERSHIP TRANSFER would require transactional offset
+    commits (a consume-transform-produce loop), which this storm does
+    not run — multi-member assignment churn is covered at-least-once
+    by ``group_churn_coordinator_storm``/``fast_group_churn``."""
+    interval = 1.8
+    storm = Storm(seed=seed, brokers=3, partitions=4, min_alive=2,
+                  external=True, transactional=True, txn_size=4,
+                  abort_every=6, consumers=1, check_group=True,
+                  duration_s=1.0 + kills * interval + 0.5, pace_ms=2,
+                  drain_s=40.0)
+    sched = Schedule(seed=seed)
+    for i in range(kills):
+        t = 1.0 + i * interval
+        sched.at(t, proc_kill9("any"))
+        sched.at(t + 1.0, proc_restart())      # respawn in kill order
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["kills_fired"] = sum(
+        1 for e in report["timeline"] if e["action"] == "proc_kill9"
+        and (e.get("resolved") or {}).get("broker"))
+    report["pids_killed"] = [e for e in report.get("proc_events", [])
+                             if e["verb"] == "kill9"]
     return report
 
 
@@ -335,6 +527,37 @@ def slow_network_rebalance(seed: int = 4, *,
     return storm.run(sched, raise_on_violation=raise_on_violation)
 
 
+def group_churn_coordinator_storm(seed: int = 31, *, consumers: int = 12,
+                                  churners: int = 8,
+                                  raise_on_violation: bool = True) -> dict:
+    """Consumer-group-heavy storm: a large group (``consumers`` stable
+    members + ``churners`` joining/leaving on overlapping lifetimes)
+    rebalances continuously while the GROUP coordinator broker is
+    killed twice mid-churn.  At-least-once delivery (duplicates across
+    handoffs are legal) but zero loss — and the group invariants must
+    hold: the survivors converge to one exact cover of the partitions
+    and nobody ends up permanently stuck."""
+    gid = f"chaos-g-{seed}"
+    storm = Storm(seed=seed, brokers=3, partitions=8, min_alive=2,
+                  consumers=consumers,
+                  consumer_start_delays=tuple(0.05 * i
+                                              for i in range(consumers)),
+                  check_group=True, converge_s=25.0,
+                  churn_consumers=churners, churn_start_s=1.0,
+                  churn_period_s=0.45, churn_lifetime_s=2.2,
+                  isolation="read_uncommitted",
+                  check_duplicates=False, check_order=False,
+                  duration_s=6.0, pace_ms=2, drain_s=30.0)
+    sched = (Schedule(seed=seed)
+             .at(1.6, broker_kill(f"coordinator:{gid}"))
+             .at(2.8, broker_restart())
+             .at(3.8, broker_kill(f"coordinator:{gid}"))
+             .at(5.0, broker_restart()))
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["converged_s"] = storm._converged_s
+    return report
+
+
 def fast_kill_restart(seed: int = 7, *,
                       raise_on_violation: bool = True) -> dict:
     """Tier-1 deterministic smoke (<10 s): one broker kill + restart
@@ -345,6 +568,47 @@ def fast_kill_restart(seed: int = 7, *,
              .at(0.7, broker_kill("any"))
              .at(1.5, broker_restart()))
     return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def fast_external_kill9(seed: int = 23, *,
+                        raise_on_violation: bool = True) -> dict:
+    """Tier-1 out-of-process smoke (<15 s): one real ``SIGKILL`` of a
+    broker OS process (pid-verified) + one SIGSTOP/SIGCONT brownout
+    under idempotent produce/consume, full invariant check.  Also the
+    source of the bench ``storm_msgs_s``/recovery metrics."""
+    storm = Storm(seed=seed, brokers=2, partitions=2, min_alive=1,
+                  external=True, duration_s=3.0, pace_ms=2, drain_s=20.0)
+    sched = (Schedule(seed=seed)
+             .at(0.6, proc_pause("any"))
+             .at(1.2, proc_cont())
+             .at(1.6, proc_kill9("any"))
+             .at(2.4, proc_restart()))
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["pids_killed"] = [e for e in report.get("proc_events", [])
+                             if e["verb"] == "kill9"]
+    return report
+
+
+def fast_group_churn(seed: int = 33, *,
+                     raise_on_violation: bool = True) -> dict:
+    """Tier-1 group smoke (<12 s): 4 stable members + 2 churners, one
+    coordinator kill mid-rebalance, zero-loss + group invariants."""
+    gid = f"chaos-g-{seed}"
+    storm = Storm(seed=seed, brokers=2, partitions=4, min_alive=1,
+                  consumers=4,
+                  consumer_start_delays=(0.0, 0.1, 0.2, 0.3),
+                  check_group=True, converge_s=20.0,
+                  churn_consumers=2, churn_start_s=0.8,
+                  churn_period_s=0.5, churn_lifetime_s=1.2,
+                  isolation="read_uncommitted",
+                  check_duplicates=False, check_order=False,
+                  duration_s=3.0, pace_ms=2, drain_s=20.0)
+    sched = (Schedule(seed=seed)
+             .at(1.2, broker_kill(f"coordinator:{gid}"))
+             .at(2.2, broker_restart()))
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["converged_s"] = storm._converged_s
+    return report
 
 
 def fast_net_flap(seed: int = 11, *,
@@ -360,6 +624,31 @@ def fast_net_flap(seed: int = 11, *,
              .at(1.3, conn_kill())
              .at(1.7, net(delay_ms=0, jitter_ms=0)))
     return storm.run(sched, raise_on_violation=raise_on_violation)
+
+
+def soak_kill9_txn_storm(seed: int = 41, *, minutes: float = 2.5,
+                         raise_on_violation: bool = True) -> dict:
+    """LONG SOAK (``scripts/chaos.sh --soak``): minutes of unpaced EOS
+    transactions against the external cluster under repeated
+    ``SIGKILL``/respawn cycles — the endurance tier: thousands of
+    txns, a kill every ~4 s, every invariant checked at the end."""
+    duration = minutes * 60.0
+    cycle = 4.0
+    cycles = max(1, int((duration - 3.0) / cycle))
+    storm = Storm(seed=seed, brokers=3, partitions=4, min_alive=2,
+                  external=True, transactional=True, txn_size=3,
+                  abort_every=9, consumers=1, check_group=True,
+                  duration_s=duration, pace_ms=0, drain_s=60.0)
+    sched = Schedule(seed=seed)
+    for i in range(cycles):
+        t = 2.0 + i * cycle
+        sched.at(t, proc_kill9("any"))
+        sched.at(t + 2.0, proc_restart())
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["kills_fired"] = sum(
+        1 for e in report["timeline"] if e["action"] == "proc_kill9"
+        and (e.get("resolved") or {}).get("broker"))
+    return report
 
 
 def oracle_selftest(seed: int = 13) -> dict:
@@ -382,32 +671,66 @@ def oracle_selftest(seed: int = 13) -> dict:
                          "flagged — the oracle is blind")
 
 
-#: name -> (callable(seed=..), description, runs-in-tier-1)
-SCENARIOS = {
-    "rolling_restart_eos": (
+class Scenario(NamedTuple):
+    fn: Callable
+    desc: str
+    tier: str          # "fast" (tier-1) | "slow" | "soak"
+    seed: int          # default seed (CLI --seed overrides = replay)
+    invariants: str    # what the oracle asserts for this storm
+
+
+SCENARIOS: dict[str, Scenario] = {
+    "rolling_restart_eos": Scenario(
         rolling_restart_eos,
-        "flagship: >=5 rolling broker kill/restarts under EOS "
-        "produce + read_committed consume", False),
-    "coordinator_death_midcommit": (
+        "in-process flagship: >=5 rolling broker kill/restarts under "
+        "EOS produce + read_committed consume", "slow", 1,
+        "loss,dup,order,atomicity"),
+    "external_kill9_eos": Scenario(
+        external_kill9_eos,
+        "OUT-OF-PROCESS flagship: >=3 SIGKILLs of real broker OS "
+        "processes (pid-verified) under EOS + read_committed, "
+        "2-member group", "slow", 21,
+        "loss,dup,order,atomicity,group"),
+    "coordinator_death_midcommit": Scenario(
         coordinator_death_midcommit,
         "kill the txn coordinator mid-commit; EndTxn retry must stay "
-        "idempotent across failover", False),
-    "leader_migration_midbatch": (
+        "idempotent across failover", "slow", 2,
+        "loss,dup,order,atomicity"),
+    "leader_migration_midbatch": Scenario(
         leader_migration_midbatch,
         "migrate partition leaders every 400ms under idempotent "
-        "produce", False),
-    "slow_network_rebalance": (
+        "produce", "slow", 3, "loss,dup,order"),
+    "slow_network_rebalance": Scenario(
         slow_network_rebalance,
         "slow/jittery/half-partitioned network during a consumer-group "
-        "rebalance (zero-loss)", False),
-    "fast_kill_restart": (
+        "rebalance (zero-loss)", "slow", 4, "loss"),
+    "group_churn_coordinator_storm": Scenario(
+        group_churn_coordinator_storm,
+        "12 stable + 8 churning consumers rebalance while the group "
+        "coordinator dies twice", "slow", 31, "loss,group"),
+    "fast_kill_restart": Scenario(
         fast_kill_restart,
-        "tier-1 smoke: one kill/restart, full invariants, <10s", True),
-    "fast_net_flap": (
+        "tier-1 smoke: one kill/restart, full invariants, <10s",
+        "fast", 7, "loss,dup,order"),
+    "fast_external_kill9": Scenario(
+        fast_external_kill9,
+        "tier-1 smoke: real SIGKILL + SIGSTOP brownout of broker OS "
+        "processes, <15s", "fast", 23, "loss,dup,order"),
+    "fast_group_churn": Scenario(
+        fast_group_churn,
+        "tier-1 smoke: 4+2-member group churn across a coordinator "
+        "kill, <12s", "fast", 33, "loss,group"),
+    "fast_net_flap": Scenario(
         fast_net_flap,
-        "tier-1 smoke: partial writes + jitter + conn kill, <10s", True),
-    "oracle_selftest": (
+        "tier-1 smoke: partial writes + jitter + conn kill, <10s",
+        "fast", 11, "loss,dup,order"),
+    "soak_kill9_txn_storm": Scenario(
+        soak_kill9_txn_storm,
+        "SOAK: minutes of unpaced EOS txns under repeated SIGKILL "
+        "cycles of real broker processes", "soak", 41,
+        "loss,dup,order,atomicity,group"),
+    "oracle_selftest": Scenario(
         oracle_selftest,
         "intentionally broken ledger proves violations dump flight + "
-        "diff", True),
+        "diff", "fast", 13, "selftest"),
 }

@@ -119,6 +119,102 @@ class _BrokerRestart(Action):
             ctx.killed.remove(b)
 
 
+class _ProcKill9(_BrokerKill):
+    """SIGKILL the broker's OS process (out-of-process tier:
+    ``ClusterHandle.kill9`` really ``kill -9``s the relay; in-process
+    ``MockCluster.kill9`` applies the same controller reaction).
+    Target grammar and min_alive quorum floor are _BrokerKill's."""
+
+    name = "proc_kill9"
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        info = ctx.cluster.kill9(b)
+        ctx.killed.append(b)
+        if isinstance(info, dict):
+            resolved["migrated"] = len(info.get("migrated") or [])
+
+
+class _ProcRestart(_BrokerRestart):
+    """Respawn a killed broker process (same public port, fresh pid);
+    in-process this is ``restart_broker``. Distinct timeline name so
+    storms read honestly in either tier."""
+
+    name = "proc_restart"
+
+
+class _ProcPause(Action):
+    """SIGSTOP the broker's process — the GC-pause/VM-freeze brownout:
+    connects still succeed (kernel backlog) but nothing is served, so
+    clients walk the request-timeout path instead of connect-refused.
+    Resolution mirrors broker_kill's target grammar; a broker already
+    paused or down is skipped, and ``min_alive`` counts only brokers
+    that are both alive AND unpaused (a fully-frozen cluster would
+    stall the storm clock itself)."""
+
+    name = "proc_pause"
+
+    def __init__(self, target: int | str = "any"):
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        t = self.target
+        responsive = [b for b in ctx.cluster.alive_brokers()
+                      if b not in ctx.paused]
+        if isinstance(t, int):
+            b = t
+        elif t == "any":
+            if len(responsive) <= ctx.min_alive:
+                return {"broker": None, "skipped": "min_alive"}
+            b = rng.choice(sorted(responsive))
+        elif t == "controller":
+            b = ctx.cluster.controller_id
+        elif t.startswith("coordinator:"):
+            b = ctx.cluster.coordinator_for(t.split(":", 1)[1])
+        elif t.startswith("leader:"):
+            _, topic, part = t.split(":")
+            b = ctx.cluster.partition(topic, int(part)).leader
+        else:
+            raise ValueError(f"proc_pause target {t!r}")
+        if b in ctx.paused or b in ctx.killed:
+            return {"broker": None, "skipped": "unavailable"}
+        return {"broker": b}
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.pause_broker(b)
+        ctx.paused.append(b)
+
+
+class _ProcCont(Action):
+    """SIGCONT — thaw a paused broker process. ``"paused"`` resumes in
+    pause order (FIFO, the brownout-ends shape)."""
+
+    name = "proc_cont"
+
+    def __init__(self, target: int | str = "paused"):
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        if isinstance(self.target, int):
+            return {"broker": self.target}
+        if not ctx.paused:
+            return {"broker": None, "skipped": "none_paused"}
+        return {"broker": ctx.paused[0]}
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.resume_broker(b)
+        if b in ctx.paused:
+            ctx.paused.remove(b)
+
+
 class _LeaderMigrate(Action):
     name = "leader_migrate"
 
@@ -214,6 +310,22 @@ def broker_restart(target: int | str = "killed") -> Action:
     return _BrokerRestart(target)
 
 
+def proc_kill9(target: int | str = "any") -> Action:
+    return _ProcKill9(target)
+
+
+def proc_restart(target: int | str = "killed") -> Action:
+    return _ProcRestart(target)
+
+
+def proc_pause(target: int | str = "any") -> Action:
+    return _ProcPause(target)
+
+
+def proc_cont(target: int | str = "paused") -> Action:
+    return _ProcCont(target)
+
+
 def leader_migrate(topic: str, partition: int | str = "any",
                    to: int | str = "any_other") -> Action:
     return _LeaderMigrate(topic, partition, to)
@@ -281,6 +393,8 @@ class ChaosContext:
     min_alive: int = 1
     #: brokers currently down, in kill order (broker_restart FIFO)
     killed: list = field(default_factory=list)
+    #: brokers currently SIGSTOPped, in pause order (proc_cont FIFO)
+    paused: list = field(default_factory=list)
 
 
 class ChaosScheduler:
@@ -334,7 +448,11 @@ class ChaosScheduler:
                 break
             entry = {"idx": step.idx, "t": step.t,
                      "action": step.action.name,
-                     "wall": round(time.monotonic() - t0, 4)}
+                     "wall": round(time.monotonic() - t0, 4),
+                     # absolute monotonic stamp: recovery-latency
+                     # metrics correlate kills with the oracle's ack
+                     # timestamps (excluded from the replay key)
+                     "mono": time.monotonic()}
             try:
                 resolved = step.action.resolve(self.ctx, rng)
                 entry["resolved"] = resolved
@@ -359,9 +477,13 @@ class ChaosScheduler:
             self._thread = None
 
     def heal(self) -> None:
-        """Restore a healthy cluster after the storm: restart every
-        broker the schedule left down and clear sockem shaping — the
-        drain phase must measure delivery, not leftover faults."""
+        """Restore a healthy cluster after the storm: thaw every
+        paused broker, restart every broker the schedule left down,
+        and clear sockem shaping — the drain phase must measure
+        delivery, not leftover faults."""
+        for b in list(self.ctx.paused):
+            self.ctx.cluster.resume_broker(b)
+            self.ctx.paused.remove(b)
         for b in list(self.ctx.killed):
             self.ctx.cluster.restart_broker(b)
             self.ctx.killed.remove(b)

@@ -509,6 +509,9 @@ class Broker:
         self._fallback_until = 0.0        # api.version.fallback.ms window
         self.reconnect_backoff = rk.conf.get("reconnect.backoff.ms") / 1000.0
         self._next_connect = 0.0
+        # (monotonic, applied_delay_s) per backoff decision, newest
+        # last — observability for the chaos retry-shape tests
+        self.reconnect_history: deque = deque(maxlen=64)
         self._connect_wanted = False    # sparse-connections override
         self.terminate = False
         self.fetch_inflight_cnt = 0     # outstanding FetchRequests
@@ -881,18 +884,27 @@ class Broker:
             self._xmit(req)
         self.rk.broker_state_change(self)
 
-    def _connect_failed(self, reason: str):
-        self._set_state(BrokerState.DOWN)
-        # -25%..+50% jitter, capped at reconnect.backoff.max.ms — the
-        # reference's exact scheme (rd_kafka_broker_update_reconnect_
-        # backoff, rdkafka_broker.c:1708; reconnect.backoff.jitter.ms
-        # is a deprecated no-op there too)
+    def _update_reconnect_backoff(self) -> float:
+        """Schedule the next connect attempt: -25%..+50% jitter on the
+        current backoff, capped at reconnect.backoff.max.ms, base
+        doubled for the next round — the reference's exact scheme
+        (rd_kafka_broker_update_reconnect_backoff, rdkafka_broker.c:
+        1708; reconnect.backoff.jitter.ms is a deprecated no-op there
+        too).  Returns the applied delay; every (when, delay) lands in
+        ``reconnect_history`` so the chaos kill9 retry-shape test can
+        assert the schedule was honored against a real dead process."""
         backoff_max = self.rk.conf.get("reconnect.backoff.max.ms") / 1000.0
         backoff = min(self.reconnect_backoff * random.uniform(0.75, 1.5),
                       backoff_max)
         self._next_connect = time.monotonic() + backoff
         self.reconnect_backoff = min(self.reconnect_backoff * 2,
                                      backoff_max)
+        self.reconnect_history.append((time.monotonic(), backoff))
+        return backoff
+
+    def _connect_failed(self, reason: str):
+        self._set_state(BrokerState.DOWN)
+        self._update_reconnect_backoff()
         self.rk.broker_down(self, KafkaError(Err._TRANSPORT, reason))
 
     def _disconnect(self, err: KafkaError, quiet: bool = False):

@@ -1,1 +1,9 @@
-"""librdkafka_tpu.mock"""
+"""librdkafka_tpu.mock — in-process mock cluster (`cluster.py`), the
+sockem network-shaping shim (`sockem.py`), and the out-of-process tier
+(`standalone.py --supervise` supervisor + `_relay.py` broker processes
++ `external.py` ClusterHandle).  See CHAOS.md for the tier comparison.
+
+Submodules import lazily on purpose: pulling ClusterHandle in here
+eagerly would make every client import pay for the subprocess
+machinery.
+"""

@@ -1,0 +1,183 @@
+"""Broker serving-plane relay: one OS process per mock broker.
+
+Executed BY PATH (``python .../mock/_relay.py``) from the standalone
+supervisor — deliberately not ``-m``: the relay must stay pure-stdlib
+and never import the package (or JAX), so a broker process costs
+milliseconds to spawn and dies instantly under SIGKILL.
+
+The relay binds the broker's PUBLIC port and shuttles bytes to the
+supervisor's internal MockCluster listener for that broker.  The split
+mirrors a replicated deployment: the supervisor holds the storage/
+controller plane (what an acks=all quorum would preserve), the relay
+IS the broker process clients talk to — ``kill -9`` takes the port
+down mid-write (half-written frames lost, connects refused),
+``SIGSTOP``/``SIGCONT`` freeze it like a GC pause or VM migration,
+and the client must survive with the delivery contract intact.
+
+Handshake: one JSON line on stdout — ``{"broker", "port", "pid"}``.
+Exits when stdin reaches EOF (supervisor died or closed the pipe), so
+an orphaned relay can never linger eating the host.
+"""
+import argparse
+import json
+import os
+import selectors
+import socket
+import sys
+
+RECV_CHUNK = 65536
+#: per-direction backpressure cap: stop reading a side whose peer is
+#: this far behind (a slow client must not balloon the relay)
+BUF_MAX = 1 << 20
+
+
+class _Half:
+    """One direction's state: bytes waiting to be written to ``sock``."""
+
+    __slots__ = ("sock", "peer", "buf", "reading")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.peer = None
+        self.buf = bytearray()
+        self.reading = True
+
+
+def _events(h: _Half) -> int:
+    ev = 0
+    if h.reading:
+        ev |= selectors.EVENT_READ
+    if h.buf:
+        ev |= selectors.EVENT_WRITE
+    return ev
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--broker-id", type=int, required=True)
+    ap.add_argument("--port", type=int, default=0,
+                    help="public port to bind (0 = ephemeral; restarts "
+                         "pass the original port back in)")
+    ap.add_argument("--upstream", required=True, metavar="HOST:PORT",
+                    help="the supervisor's internal listener for this "
+                         "broker")
+    args = ap.parse_args(argv)
+    uhost, _, uport = args.upstream.rpartition(":")
+
+    ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls.bind(("127.0.0.1", args.port))
+    ls.listen(64)
+    ls.setblocking(False)
+
+    print(json.dumps({"broker": args.broker_id,
+                      "port": ls.getsockname()[1],
+                      "pid": os.getpid()}), flush=True)
+
+    sel = selectors.DefaultSelector()
+    sel.register(ls, selectors.EVENT_READ, "accept")
+    # parent-death watch: stdin is a pipe from the supervisor; EOF
+    # means it is gone (or told us to exit) — no polling anywhere
+    sel.register(sys.stdin.fileno(), selectors.EVENT_READ, "stdin")
+
+    halves: dict[socket.socket, _Half] = {}
+
+    def close_pair(h: _Half):
+        for side in (h, h.peer):
+            if side is None or side.sock not in halves:
+                continue
+            try:
+                sel.unregister(side.sock)
+            except (KeyError, ValueError):
+                pass
+            try:
+                side.sock.close()
+            except OSError:
+                pass
+            del halves[side.sock]
+
+    def update(h: _Half):
+        try:
+            sel.modify(h.sock, _events(h), "conn")
+        except (KeyError, ValueError):
+            pass
+
+    while True:
+        for key, mask in sel.select():
+            if key.data == "stdin":
+                if not os.read(sys.stdin.fileno(), 4096):
+                    return 0
+                continue
+            if key.data == "accept":
+                try:
+                    cs, _ = ls.accept()
+                except OSError:
+                    continue
+                us = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                try:
+                    us.settimeout(5.0)
+                    us.connect((uhost or "127.0.0.1", int(uport)))
+                except OSError:
+                    # storage plane unreachable (broker marked down but
+                    # relay still alive — restart race): drop the client
+                    cs.close()
+                    us.close()
+                    continue
+                cs.setblocking(False)
+                us.setblocking(False)
+                ch, uh = _Half(cs), _Half(us)
+                ch.peer, uh.peer = uh, ch
+                halves[cs] = ch
+                halves[us] = uh
+                sel.register(cs, _events(ch), "conn")
+                sel.register(us, _events(uh), "conn")
+                continue
+
+            h = halves.get(key.fileobj)
+            if h is None:
+                continue
+            if mask & selectors.EVENT_READ:
+                try:
+                    data = h.sock.recv(RECV_CHUNK)
+                except BlockingIOError:
+                    data = None
+                except OSError:
+                    close_pair(h)
+                    continue
+                if data == b"":
+                    close_pair(h)
+                    continue
+                if data:
+                    dst = h.peer
+                    dst.buf += data
+                    try:
+                        sent = dst.sock.send(dst.buf)
+                        del dst.buf[:sent]
+                    except BlockingIOError:
+                        pass
+                    except OSError:
+                        close_pair(h)
+                        continue
+                    if len(dst.buf) > BUF_MAX:
+                        h.reading = False
+                    update(dst)
+                    update(h)
+            if mask & selectors.EVENT_WRITE and h.sock in halves:
+                try:
+                    if h.buf:
+                        sent = h.sock.send(h.buf)
+                        del h.buf[:sent]
+                except BlockingIOError:
+                    pass
+                except OSError:
+                    close_pair(h)
+                    continue
+                if len(h.buf) <= BUF_MAX and h.peer is not None \
+                        and not h.peer.reading:
+                    h.peer.reading = True
+                    update(h.peer)
+                update(h)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,7 @@ import ssl as _ssl
 import struct
 import threading
 import time
+import zlib
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -251,6 +252,16 @@ class MockCluster:
         self._rtt_ms: dict[int, float] = {}           # broker_id -> delay
         self._throttle_ms: dict[int, int] = {}        # broker_id -> report
         self._down: set[int] = set()
+        # SIGSTOP analog (chaos proc_pause): a paused broker stops
+        # reading and writing but its listener stays bound — connects
+        # succeed (kernel backlog) and then freeze, exactly what a
+        # GC-paused/VM-frozen broker looks like from the client
+        self._paused: set[int] = set()
+        # out-of-process tier: the standalone supervisor fronts each
+        # internal listener with a relay OS process on a public port;
+        # metadata/FindCoordinator must advertise THAT port or clients
+        # would bypass the killable process entirely
+        self._advertised: dict[int, int] = {}
         self.request_log: list[tuple[int, int]] = []  # (broker_id, api_key)
         # AlterConfigs store: (resource_type, name) -> {conf: value}
         self._resource_configs: dict[tuple, dict] = {}
@@ -310,7 +321,17 @@ class MockCluster:
 
     # ------------------------------------------------------------- public --
     def bootstrap_servers(self) -> str:
-        return ",".join(f"127.0.0.1:{p}" for p in self._ports.values())
+        return ",".join(f"127.0.0.1:{self.advertised_port(b)}"
+                        for b in self._ports)
+
+    def advertised_port(self, broker_id: int) -> int:
+        """The port clients should be told about: the broker's relay
+        process port in the out-of-process tier, else its own."""
+        return self._advertised.get(broker_id, self._ports[broker_id])
+
+    def set_advertised_port(self, broker_id: int, port: int) -> None:
+        with self._lock:
+            self._advertised[broker_id] = port
 
     def create_topic(self, name: str, partitions: int = None,
                      replication: int = 1) -> None:
@@ -385,6 +406,7 @@ class MockCluster:
             if down:
                 if broker_id in self._down:
                     return
+                self._paused.discard(broker_id)     # SIGKILL beats SIGSTOP
                 self._down.add(broker_id)
                 self._close_listener(broker_id)
                 for c in list(self._conns):
@@ -434,6 +456,71 @@ class MockCluster:
         self.set_broker_down(broker_id, False)
         return {"broker": broker_id}
 
+    def kill9(self, broker_id: int) -> dict:
+        """In-process stand-in for the chaos ``proc_kill9`` verb: same
+        controller reaction as ``kill_broker``.  The out-of-process
+        tier (``mock/external.py`` ClusterHandle) implements the same
+        method with a real ``SIGKILL`` of the broker's relay process —
+        the schedule DSL targets whichever cluster object it was given
+        through this one name."""
+        return self.kill_broker(broker_id)
+
+    def pause_broker(self, broker_id: int) -> dict:
+        """SIGSTOP analog (chaos ``proc_pause``): freeze the broker —
+        stop reading its connections and flushing its responses, stop
+        accepting (pending connects sit in the kernel backlog exactly
+        as they would against a SIGSTOPped process).  Metadata still
+        advertises it: a GC-paused broker is alive, just unresponsive,
+        so clients walk the request-timeout path, not connect-refused.
+        The out-of-process tier sends a real ``SIGSTOP``."""
+        with self._lock:
+            if broker_id in self._paused or broker_id in self._down:
+                return {"broker": broker_id, "skipped": True}
+            self._paused.add(broker_id)
+            ls = self._listeners.get(broker_id)
+            if ls is not None:
+                try:
+                    self._sel.unregister(ls)
+                except (KeyError, ValueError):
+                    pass
+            for c in self._conns:
+                if c.broker_id == broker_id and not c.closed:
+                    try:
+                        self._sel.unregister(c.sock)
+                    except (KeyError, ValueError):
+                        pass
+        return {"broker": broker_id}
+
+    def resume_broker(self, broker_id: int) -> dict:
+        """SIGCONT analog: thaw a paused broker — re-register listener
+        and connections and flush whatever queued while frozen."""
+        with self._lock:
+            if broker_id not in self._paused:
+                return {"broker": broker_id, "skipped": True}
+            self._paused.discard(broker_id)
+            ls = self._listeners.get(broker_id)
+            if ls is not None:
+                try:
+                    self._sel.register(ls, selectors.EVENT_READ,
+                                       ("accept", broker_id))
+                except (KeyError, ValueError):
+                    pass
+            thaw = [c for c in self._conns
+                    if c.broker_id == broker_id and not c.closed]
+            for c in thaw:
+                try:
+                    self._sel.register(c.sock, selectors.EVENT_READ,
+                                       ("conn", c))
+                except (KeyError, ValueError):
+                    pass
+        for c in thaw:
+            self._flush(c)
+        return {"broker": broker_id}
+
+    def paused_brokers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._paused)
+
     def rolling_restart(self, pause_s: float = 0.5) -> None:
         """Kill + restart every broker in id order, one at a time,
         waiting ``pause_s`` between steps (blocking convenience; chaos
@@ -458,7 +545,11 @@ class MockCluster:
         names the next alive broker (state is cluster-global here, so
         the successor serves seamlessly, like a real coordinator
         failover after __consumer_offsets replay)."""
-        base = (hash(group) % self.num_brokers) + 1
+        # stable hash (NOT builtin hash(): PYTHONHASHSEED randomizes it
+        # per interpreter, and the out-of-process replay contract needs
+        # the same key to land on the same broker across supervisor
+        # launches — same seed => identical replay_key, ISSUE 9)
+        base = (zlib.crc32(group.encode()) % self.num_brokers) + 1
         if base not in self._down:
             return base
         return self._next_alive(base) or base
@@ -539,6 +630,8 @@ class MockCluster:
         return True
 
     def _read(self, conn: _Conn):
+        if conn.broker_id in self._paused:
+            return              # race: event dequeued as the freeze hit
         if conn.handshaking:
             self._hs_serve(conn)
             return
@@ -597,7 +690,9 @@ class MockCluster:
         self._flush(conn)
 
     def _flush(self, conn: _Conn):
-        if conn.closed:
+        if conn.closed or conn.broker_id in self._paused:
+            # frozen broker (pause_broker): responses queue in wbuf and
+            # flush on resume — nothing leaves a SIGSTOPped process
             return
         if conn.handshaking:
             self._hs_serve(conn)
@@ -720,7 +815,7 @@ class MockCluster:
                                "topic": t, "is_internal": False,
                                "partitions": parts})
             brokers = [{"node_id": b, "host": "127.0.0.1",
-                        "port": self._ports[b], "rack": None}
+                        "port": self.advertised_port(b), "rack": None}
                        for b in self._ports if b not in self._down]
         return {"throttle_time_ms": 0,   # serialized for v3+ only
                 "brokers": brokers, "cluster_id": self.cluster_id,
@@ -968,7 +1063,8 @@ class MockCluster:
                     "port": -1}
         b = self.coordinator_for(body["key"])
         return {"throttle_time_ms": 0, "error_code": 0, "error_message": None,
-                "node_id": b, "host": "127.0.0.1", "port": self._ports[b]}
+                "node_id": b, "host": "127.0.0.1",
+                "port": self.advertised_port(b)}
 
     def _group(self, gid: str) -> MockGroup:
         with self._lock:
@@ -1099,11 +1195,19 @@ class MockCluster:
                     if a["member_id"] in g.members:
                         g.members[a["member_id"]].assignment = a["assignment"]
                 g.state = "Stable"
-                # flush parked syncs
+                # flush parked syncs; a parked member that was dropped
+                # meanwhile (never rejoined before the rebalance window
+                # closed — heavy churn does this constantly) gets
+                # UNKNOWN_MEMBER_ID so it re-joins, never a KeyError
                 for (pconn, pcorrid, pmid, pver) in g.pending_syncs:
-                    self._respond(pconn, pcorrid, ApiKey.SyncGroup,
-                                  {"throttle_time_ms": 0, "error_code": 0,
-                                   "assignment": g.members[pmid].assignment},
+                    if pmid in g.members:
+                        body = {"throttle_time_ms": 0, "error_code": 0,
+                                "assignment": g.members[pmid].assignment}
+                    else:
+                        body = {"throttle_time_ms": 0,
+                                "error_code": Err.UNKNOWN_MEMBER_ID.wire,
+                                "assignment": b""}
+                    self._respond(pconn, pcorrid, ApiKey.SyncGroup, body,
                                   version=pver)
                 g.pending_syncs.clear()
                 return {"throttle_time_ms": 0, "error_code": 0,

@@ -1,21 +1,365 @@
-"""Run the mock cluster as a standalone process.
+"""Run the mock cluster as a standalone process — one-process mode and
+the ISSUE-9 supervised **multi-process** mode.
+
+One-process mode (the PR-1 interop/bench shape)::
 
     python -m librdkafka_tpu.mock.standalone [--brokers N]
         [--partitions N] [--topic NAME:PARTS ...]
 
-Prints ``bootstrap.servers`` on the first stdout line, then serves
-until killed (or until --seconds elapses). This is how external
-processes — the reference's rdkafka_performance in the interop tier,
-the benchmark's producer, or any client under test — get a broker that
-does NOT share the client's GIL/process (the role a real Kafka broker
-plays for the reference's test rig)."""
+prints ``bootstrap.servers`` on the first stdout line and serves until
+killed: an external client gets brokers that do not share its
+GIL/process, but all N brokers still live in THIS one interpreter.
+
+Supervised mode (``--supervise``) is the out-of-process chaos tier::
+
+    python -m librdkafka_tpu.mock.standalone --supervise --brokers 3
+
+The parent becomes a **supervisor**: it holds the storage/controller
+plane (a MockCluster on internal ports — the state an acks=all quorum
+would preserve) and spawns one OS process per broker (`_relay.py`,
+pure stdlib) binding that broker's PUBLIC port.  Faults then hit real
+processes: ``kill -9`` loses half-written frames and refuses connects,
+``SIGSTOP``/``SIGCONT`` model GC-pause/VM-freeze brownouts — none of
+which the in-process tier can express (see CHAOS.md).
+
+Handshake: the first stdout line is one JSON object::
+
+    {"bootstrap": "127.0.0.1:p1,...", "control": <port>,
+     "pid": <supervisor pid>, "brokers": {"1": {"port": p, "pid": pid}}}
+
+Control plane: a line protocol on the control port — one command line
+in, one JSON line out::
+
+    kill9 <id>       SIGKILL broker <id>'s process, reap it, migrate
+                     leadership+coordinator off it (reply carries pid,
+                     exit status and the migration summary)
+    stop <id>        SIGSTOP (freeze); cont <id> thaws
+    restart <id>     respawn a killed broker on the SAME public port
+    status           liveness/pids/ports/leaders/metadata_version
+    coordinator <k>  coordinator broker for group/txn key <k>
+    leader <t> <p> <b>   migrate partition leadership
+    shutdown         kill every broker process and exit
+
+The supervisor exits on ``shutdown`` or when its stdin reaches EOF
+(the launching ClusterHandle died) — and each relay watches ITS stdin
+the same way, so no broker process can outlive the rig.
+"""
 from __future__ import annotations
 
 import argparse
+import json
+import os
+import selectors
+import signal
+import socket
+import subprocess
 import sys
+import threading
 import time
 
+from ..analysis.locks import new_cond
 from .cluster import MockCluster
+
+_RELAY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "_relay.py")
+
+
+class Supervisor:
+    """Parent of one relay OS process per broker; owns the MockCluster
+    storage/controller plane and the line-protocol control socket.
+
+    All child waits go through ``Popen.wait`` (reaper threads) or
+    condvar waits — no sleep-polling anywhere in the wait paths."""
+
+    def __init__(self, num_brokers: int, topics=None,
+                 default_partitions: int = 4, retention_bytes: int = 0):
+        self.cluster = MockCluster(num_brokers=num_brokers, topics=topics,
+                                   default_partitions=default_partitions,
+                                   retention_bytes=retention_bytes)
+        self.num_brokers = num_brokers
+        self._cond = new_cond("mock.supervisor")
+        self.procs: dict[int, subprocess.Popen] = {}
+        self.public_ports: dict[int, int] = {}
+        self.pids: dict[int, int] = {}
+        self.exited: dict[int, int] = {}      # broker -> last exit status
+        self.migrated: dict[int, list] = {}   # broker -> last kill summary
+        self.down: set[int] = set()
+        self.paused: set[int] = set()
+        self.shutdown = threading.Event()
+
+        for b in range(1, num_brokers + 1):
+            self._spawn(b, 0)
+        self._ctl_ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._ctl_ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._ctl_ls.bind(("127.0.0.1", 0))
+        self._ctl_ls.listen(8)
+        self._ctl_ls.setblocking(False)
+        self.control_port = self._ctl_ls.getsockname()[1]
+        self._ctl_thread = threading.Thread(target=self._ctl_loop,
+                                            name="standalone-ctl",
+                                            daemon=True)
+        self._ctl_thread.start()
+
+    # ------------------------------------------------------- lifecycle --
+    def _spawn(self, b: int, port: int) -> dict:
+        """Start broker ``b``'s relay process on ``port`` (0 =
+        ephemeral) and register it; returns the relay handshake."""
+        proc = subprocess.Popen(
+            [sys.executable, _RELAY, "--broker-id", str(b),
+             "--port", str(port),
+             "--upstream", f"127.0.0.1:{self.cluster._ports[b]}"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        line = proc.stdout.readline()
+        if not line:
+            rc = proc.wait()
+            raise RuntimeError(f"broker {b} relay died at startup "
+                               f"(exit {rc}, port {port})")
+        hs = json.loads(line)
+        with self._cond:
+            self.procs[b] = proc
+            self.public_ports[b] = hs["port"]
+            self.pids[b] = hs["pid"]
+            self.down.discard(b)
+            self.exited.pop(b, None)
+        self.cluster.set_advertised_port(b, hs["port"])
+        threading.Thread(target=self._reap, args=(b, proc),
+                         name=f"standalone-reap-{b}-{hs['pid']}",
+                         daemon=True).start()
+        return hs
+
+    def _reap(self, b: int, proc: subprocess.Popen) -> None:
+        """Blocks in ``Popen.wait`` until broker ``b``'s process dies
+        (kill9 command or an outside ``kill -9 <pid>``), then runs the
+        controller reaction: mark down, migrate leadership."""
+        rc = proc.wait()
+        with self._cond:
+            if self.procs.get(b) is not proc:
+                return          # already superseded by a restart
+            self.exited[b] = rc if rc is not None else -1
+            self.down.add(b)
+            self.paused.discard(b)
+        info = self.cluster.kill_broker(b)
+        with self._cond:
+            self.migrated[b] = [list(m) for m in info["migrated"]]
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        self.shutdown.set()
+        with self._cond:
+            procs = dict(self.procs)
+        for proc in procs.values():
+            try:
+                proc.kill()     # SIGKILL terminates stopped children too
+            except (ProcessLookupError, OSError):
+                pass
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        self.cluster.stop()
+        try:
+            self._ctl_ls.close()
+        except OSError:
+            pass
+
+    # --------------------------------------------------------- control --
+    def handshake(self) -> dict:
+        with self._cond:
+            return {
+                "bootstrap": ",".join(
+                    f"127.0.0.1:{self.public_ports[b]}"
+                    for b in sorted(self.public_ports)),
+                "control": self.control_port,
+                "pid": os.getpid(),
+                "brokers": {str(b): {"port": self.public_ports[b],
+                                     "pid": self.pids[b]}
+                            for b in sorted(self.public_ports)},
+            }
+
+    def _cmd_kill9(self, b: int) -> dict:
+        with self._cond:
+            proc = self.procs.get(b)
+            if proc is None or b in self.down:
+                return {"error": f"broker {b} is not running"}
+            pid = self.pids[b]
+        try:
+            proc.send_signal(signal.SIGKILL)    # kills SIGSTOPped ones too
+        except (ProcessLookupError, OSError):
+            pass
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self.exited.get(b) is not None, timeout=15)
+            if not ok:
+                return {"error": f"broker {b} did not reap within 15s"}
+            return {"ok": True, "broker": b, "pid": pid,
+                    "exit": self.exited.get(b),
+                    "migrated": self.migrated.get(b, [])}
+
+    def _cmd_restart(self, b: int) -> dict:
+        with self._cond:
+            if b not in self.down:
+                return {"error": f"broker {b} is not down"}
+            port = self.public_ports[b]
+        # storage plane first: the relay must find its upstream alive
+        self.cluster.restart_broker(b)
+        try:
+            hs = self._spawn(b, port)
+        except (RuntimeError, OSError) as e:
+            self.cluster.set_broker_down(b, True)
+            return {"error": f"restart failed: {e}"}
+        return {"ok": True, "broker": b, "pid": hs["pid"],
+                "port": hs["port"]}
+
+    def _cmd_pause(self, b: int) -> dict:
+        with self._cond:
+            if self.procs.get(b) is None or b in self.down:
+                return {"error": f"broker {b} is not running"}
+            if b in self.paused:
+                return {"ok": True, "broker": b, "skipped": "paused"}
+            pid = self.pids[b]
+            self.paused.add(b)
+        try:
+            os.kill(pid, signal.SIGSTOP)
+        except (ProcessLookupError, OSError) as e:
+            return {"error": f"SIGSTOP failed: {e}"}
+        return {"ok": True, "broker": b, "pid": pid}
+
+    def _cmd_cont(self, b: int) -> dict:
+        with self._cond:
+            if b not in self.paused:
+                return {"ok": True, "broker": b, "skipped": "not_paused"}
+            pid = self.pids[b]
+            self.paused.discard(b)
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except (ProcessLookupError, OSError) as e:
+            return {"error": f"SIGCONT failed: {e}"}
+        return {"ok": True, "broker": b, "pid": pid}
+
+    def _cmd_status(self) -> dict:
+        with self._cond:
+            snap = {
+                "ok": True,
+                "alive": sorted(set(range(1, self.num_brokers + 1))
+                                - self.down),
+                "down": sorted(self.down),
+                "paused": sorted(self.paused),
+                "brokers": {str(b): {"port": self.public_ports.get(b),
+                                     "pid": self.pids.get(b)}
+                            for b in range(1, self.num_brokers + 1)},
+            }
+        with self.cluster._lock:
+            snap["controller"] = self.cluster.controller_id
+            snap["metadata_version"] = self.cluster.metadata_version
+            snap["topics"] = {t: [p.leader for p in parts]
+                              for t, parts in self.cluster.topics.items()}
+        return snap
+
+    def _dispatch(self, line: str) -> dict:
+        parts = line.split()
+        if not parts:
+            return {"error": "empty command"}
+        cmd, args = parts[0], parts[1:]
+        try:
+            if cmd == "kill9":
+                return self._cmd_kill9(int(args[0]))
+            if cmd == "stop":
+                return self._cmd_pause(int(args[0]))
+            if cmd == "cont":
+                return self._cmd_cont(int(args[0]))
+            if cmd == "restart":
+                return self._cmd_restart(int(args[0]))
+            if cmd == "status":
+                return self._cmd_status()
+            if cmd == "coordinator":
+                return {"ok": True,
+                        "broker": self.cluster.coordinator_for(args[0])}
+            if cmd == "leader":
+                self.cluster.set_partition_leader(
+                    args[0], int(args[1]), int(args[2]))
+                return {"ok": True}
+            if cmd == "create_topic":
+                self.cluster.create_topic(args[0], int(args[1]))
+                return {"ok": True}
+            if cmd == "shutdown":
+                self.shutdown.set()
+                return {"ok": True, "bye": True}
+        except (ValueError, IndexError, KeyError) as e:
+            return {"error": f"{cmd}: {e!r}"}
+        return {"error": f"unknown command {cmd!r}"}
+
+    def _ctl_loop(self) -> None:
+        sel = selectors.DefaultSelector()
+        sel.register(self._ctl_ls, selectors.EVENT_READ, "accept")
+        bufs: dict[socket.socket, bytearray] = {}
+        while not self.shutdown.is_set():
+            try:
+                events = sel.select(timeout=0.2)
+            except OSError:
+                break
+            for key, _mask in events:
+                if key.data == "accept":
+                    try:
+                        s, _ = self._ctl_ls.accept()
+                    except OSError:
+                        continue
+                    bufs[s] = bytearray()
+                    sel.register(s, selectors.EVENT_READ, "conn")
+                    continue
+                s = key.fileobj
+                try:
+                    data = s.recv(4096)
+                except OSError:
+                    data = b""
+                if not data:
+                    try:
+                        sel.unregister(s)
+                    except (KeyError, ValueError):
+                        pass
+                    s.close()
+                    bufs.pop(s, None)
+                    continue
+                bufs[s] += data
+                while b"\n" in bufs[s]:
+                    raw, _, rest = bytes(bufs[s]).partition(b"\n")
+                    bufs[s] = bytearray(rest)
+                    resp = self._dispatch(raw.decode(errors="replace")
+                                          .strip())
+                    try:
+                        s.sendall(json.dumps(resp).encode() + b"\n")
+                    except OSError:
+                        pass
+
+
+def _supervise_main(args) -> int:
+    topics = {}
+    for spec in args.topic:
+        name, _, parts = spec.partition(":")
+        topics[name] = int(parts or args.partitions)
+    sup = Supervisor(num_brokers=args.brokers, topics=topics or None,
+                     default_partitions=args.partitions,
+                     retention_bytes=args.retention_mb << 20)
+    print(json.dumps(sup.handshake()), flush=True)
+
+    def _stdin_watch():
+        try:
+            while sys.stdin.buffer.read(4096):
+                pass
+        except (OSError, ValueError):
+            pass
+        sup.shutdown.set()
+
+    threading.Thread(target=_stdin_watch, name="standalone-stdin",
+                     daemon=True).start()
+    try:
+        sup.shutdown.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        sup.close()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -25,11 +369,19 @@ def main(argv=None) -> int:
     ap.add_argument("--topic", action="append", default=[],
                     metavar="NAME:PARTS")
     ap.add_argument("--seconds", type=float, default=0,
-                    help="exit after this long (0 = run until killed)")
+                    help="exit after this long (0 = run until killed; "
+                         "one-process mode only)")
     ap.add_argument("--retention-mb", type=int, default=0,
                     help="per-partition log retention cap in MB "
                          "(0 = unbounded)")
+    ap.add_argument("--supervise", action="store_true",
+                    help="multi-process mode: one OS process per broker "
+                         "+ a control socket (the out-of-process chaos "
+                         "tier; see CHAOS.md)")
     args = ap.parse_args(argv)
+
+    if args.supervise:
+        return _supervise_main(args)
 
     topics = {}
     for spec in args.topic:
@@ -42,7 +394,6 @@ def main(argv=None) -> int:
                           retention_bytes=args.retention_mb << 20)
     print(cluster.bootstrap_servers(), flush=True)
     try:
-        import os
         parent = os.getppid()
         deadline = time.monotonic() + args.seconds if args.seconds else None
         while deadline is None or time.monotonic() < deadline:

@@ -121,7 +121,22 @@ def _no_leaked_engine_threads():
     # cache; tests driving parallel/mesh.py directly must call
     # release_step_cache() themselves.  sys.modules guard: most tests
     # never import the mesh module and should not pay for it here.
+    # ISSUE 9: no standalone broker SUBPROCESS may outlive its test —
+    # a ClusterHandle registers every pid it spawns (supervisor +
+    # per-broker relays) and stop() reaps + deregisters them all.  A
+    # leaked rig would keep real OS processes (and their ports) alive
+    # under every later test; reap first so one failure can't cascade,
+    # then fail the leaking test here.
     import sys
+    ext_mod = sys.modules.get("librdkafka_tpu.mock.external")
+    if ext_mod is not None:
+        leaked_pids = ext_mod.active_subprocess_pids()
+        if leaked_pids:
+            ext_mod.reap_leaked()
+        assert not leaked_pids, (
+            f"leaked standalone broker subprocess(es): {leaked_pids} — "
+            f"a ClusterHandle was not stopped (now SIGKILLed)")
+
     mesh_mod = sys.modules.get("librdkafka_tpu.parallel.mesh")
     if mesh_mod is not None:
         n = mesh_mod.step_cache_count()

@@ -142,6 +142,86 @@ def test_request_timeout_retry_no_duplicate(cluster, backend):
     p.close()
 
 
+@pytest.mark.chaos
+def test_kill9_during_produce_backoff_and_dedup():
+    """ISSUE 9 retry shape, out-of-process: SIGKILL the REAL broker
+    process mid-produce.  While the port is unbound the client must
+    walk the jittered reconnect.backoff.ms schedule
+    (client/broker.py _update_reconnect_backoff: -25%..+50% jitter,
+    base doubling, reconnect.backoff.max.ms cap) — and once the
+    process respawns, exactly one copy of every message survives
+    broker-side idempotent (pid, epoch, seq) dedup."""
+    from librdkafka_tpu.mock.external import ClusterHandle, pid_alive
+
+    base_ms, max_ms = 200, 1500
+    h = ClusterHandle(brokers=1, topics={"net": 1})
+    p = None
+    c = None
+    try:
+        p = Producer({"bootstrap.servers": h.bootstrap_servers(),
+                      "enable.idempotence": True, "linger.ms": 2,
+                      "reconnect.backoff.ms": base_ms,
+                      "reconnect.backoff.max.ms": max_ms,
+                      "socket.timeout.ms": 2000, "socket.max.fails": 0,
+                      "retry.backoff.ms": 50,
+                      "message.send.max.retries": 200,
+                      "message.timeout.ms": 60000})
+        # warm connection + PID assignment
+        p.produce("net", value=b"warm", partition=0)
+        assert p.flush(15.0) == 0
+
+        n = 40
+        for i in range(n):
+            p.produce("net", value=b"k%03d" % i, partition=0)
+        p.poll(0)                       # some batches now in flight
+        pid = h.broker_pids[1]
+        r = h.kill9(1)
+        assert r["exit"] == -9 and not pid_alive(pid), \
+            "broker process must be SIGKILLed dead"
+
+        # dead window: connects hit ECONNREFUSED and every failure
+        # re-arms the jittered backoff schedule
+        time.sleep(2.2)
+        h.restart_broker(1)
+        assert p.flush(60.0) == 0
+
+        brokers = [b for b in p.rk.brokers.values() if b.nodeid >= 0]
+        hist = [d for _ts, d in brokers[0].reconnect_history]
+        assert len(hist) >= 2, \
+            f"expected repeated backoff decisions, saw {hist}"
+        lo, hi = 0.75 * base_ms / 1000.0, max_ms / 1000.0
+        assert all(lo <= d <= hi * 1.0001 for d in hist), \
+            f"backoff outside jitter/cap bounds: {hist}"
+        # the base doubles under consecutive failures, so later
+        # delays must grow beyond the first round's jitter ceiling
+        assert max(hist) > base_ms / 1000.0 * 1.5001 or \
+            max(hist) == pytest.approx(hi, rel=1e-6), \
+            f"no backoff growth across the dead window: {hist}"
+
+        # exactly one copy of each message (broker-side dedup), read
+        # back through a real consumer — the external log is in
+        # another process
+        c = Consumer({"bootstrap.servers": h.bootstrap_servers(),
+                      "group.id": "g-kill9",
+                      "auto.offset.reset": "earliest"})
+        c.subscribe(["net"])
+        got = []
+        deadline = time.monotonic() + 30
+        while len(got) < n + 1 and time.monotonic() < deadline:
+            m = c.poll(0.3)
+            if m is not None and m.error is None:
+                got.append(bytes(m.value))
+        body = [v for v in got if v != b"warm"]
+        assert sorted(body) == sorted(b"k%03d" % i for i in range(n)), \
+            f"loss or duplication across kill9: {len(body)}/{n}"
+    finally:
+        if p is not None:
+            p.close()
+        if c is not None:
+            c.close()
+        h.stop()
+
+
 def test_connection_kill_recovery_consumer(cluster):
     """Consumer side: kill the connection between fetches; the consumer
     reconnects and resumes from its offsets without message loss."""

@@ -1,0 +1,414 @@
+"""Out-of-process chaos tier (ISSUE 9): supervised multi-process mock
+cluster, process-fault schedule verbs (SIGKILL / SIGSTOP brownouts),
+consumer-group oracle invariants, and the pid-leak contract.
+
+Tier structure: unit tests + the in-process verb mapping run plain in
+tier-1; everything that launches real broker subprocesses is ``chaos``
+-marked (fast ones stay tier-1); the flagship SIGKILL-EOS storm and
+the big group-churn storm are ``slow``; the multi-minute endurance
+storm is ``soak`` (scripts/chaos.sh --soak)."""
+import io
+import json
+import socket
+import time
+from contextlib import redirect_stdout
+
+import pytest
+
+from librdkafka_tpu import Producer
+from librdkafka_tpu.chaos import (ChaosScheduler, DeliveryOracle,
+                                  OracleViolation, Schedule, proc_cont,
+                                  proc_kill9, proc_pause, proc_restart)
+from librdkafka_tpu.chaos.scenarios import (SCENARIOS,
+                                            external_kill9_eos,
+                                            fast_external_kill9,
+                                            fast_group_churn,
+                                            group_churn_coordinator_storm,
+                                            soak_kill9_txn_storm)
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.mock.external import (ClusterHandle,
+                                          active_subprocess_pids,
+                                          pid_alive)
+
+
+# ========================================== in-process proc-fault verbs ==
+class TestInProcessProcVerbs:
+    def test_pause_freezes_and_resume_heals(self):
+        """pause_broker is the SIGSTOP analog: connects still succeed
+        (no ECONNREFUSED — the listener stays bound) but nothing is
+        served; resume flushes what queued."""
+        c = MockCluster(num_brokers=1, topics={"t": 1})
+        p = None
+        try:
+            p = Producer({"bootstrap.servers": c.bootstrap_servers(),
+                          "linger.ms": 2, "enable.idempotence": True,
+                          "socket.timeout.ms": 2000,
+                          "socket.max.fails": 0,
+                          "retry.backoff.ms": 50,
+                          "message.send.max.retries": 100,
+                          "message.timeout.ms": 30000})
+            p.produce("t", b"warm", partition=0)
+            assert p.flush(10.0) == 0
+            c.pause_broker(1)
+            assert c.paused_brokers() == [1]
+            # frozen broker still ACCEPTS (kernel backlog), unlike down
+            s = socket.create_connection(
+                ("127.0.0.1", c._ports[1]), timeout=2)
+            s.close()
+            p.produce("t", b"frozen", partition=0)
+            assert p.flush(0.8) == 1, "produce must stall while frozen"
+            c.resume_broker(1)
+            assert c.paused_brokers() == []
+            assert p.flush(20.0) == 0
+            vals = [v for _b, blob in c.partition("t", 0).log
+                    for v in [blob]]
+            assert len(vals) >= 2
+        finally:
+            if p is not None:
+                p.close()
+            c.stop()
+
+    def test_kill9_alias_and_scheduler_heal_resumes_paused(self):
+        c = MockCluster(num_brokers=4, topics={"t": 4})
+        try:
+            info = c.kill9(2)           # same controller reaction
+            assert info["broker"] == 2 and 2 not in c.alive_brokers()
+            c.restart_broker(2)
+
+            chaos = ChaosScheduler(c, min_alive=1)
+            chaos.run(Schedule(seed=5)
+                      .at(0, proc_pause("any"))
+                      .at(0, proc_kill9("any"))
+                      .at(0, proc_pause("any")))
+            assert len(chaos.ctx.paused) == 2
+            assert len(chaos.ctx.killed) == 1
+            chaos.heal()
+            assert not chaos.ctx.paused and not chaos.ctx.killed
+            assert c.paused_brokers() == []
+            assert c.alive_brokers() == [1, 2, 3, 4]
+        finally:
+            c.stop()
+
+    def test_proc_verbs_replay_deterministic_in_process(self):
+        def run_once(seed):
+            c = MockCluster(num_brokers=4, topics={"t": 4})
+            try:
+                chaos = ChaosScheduler(c, min_alive=2)
+                chaos.run(Schedule(seed=seed)
+                          .at(0, proc_pause("any"))
+                          .at(0, proc_kill9("any"))
+                          .at(0, proc_cont())
+                          .at(0, proc_kill9("coordinator:g-x"))
+                          .at(0, proc_restart())
+                          .at(0, proc_restart()))
+                assert not chaos.errors, chaos.errors
+                return chaos.replay_key()
+            finally:
+                c.stop()
+        assert run_once(77) == run_once(77)
+
+    def test_pause_respects_quorum_floor(self):
+        c = MockCluster(num_brokers=2, topics={"t": 2})
+        try:
+            chaos = ChaosScheduler(c, min_alive=1)
+            chaos.run(Schedule(seed=3)
+                      .at(0, proc_pause("any"))
+                      .at(0, proc_pause("any")))
+            fired = [e for e in chaos.timeline
+                     if (e.get("resolved") or {}).get("broker")]
+            assert len(fired) == 1, \
+                "second pause must skip at the responsive-quorum floor"
+            chaos.heal()
+        finally:
+            c.stop()
+
+
+# ====================================================== oracle: groups ==
+class TestOracleGroupInvariants:
+    def _seed_clean_group(self, o: DeliveryOracle):
+        o.record_assign("m0", [("t", 0), ("t", 1)])
+        o.record_assign("m1", [("t", 2), ("t", 3)])
+        o.record_poll("m0")
+        o.record_poll("m1")
+
+    def test_clean_group_passes(self):
+        o = DeliveryOracle()
+        self._seed_clean_group(o)
+        r = o.verify(check_group=True, group_topic="t",
+                     group_partitions=4, converged_s=1.2)
+        assert r["ok"]
+        assert r["group"]["coverage"]["converged"]
+        assert r["group"]["converged_s"] == 1.2
+        assert r["group"]["live"] == 2
+
+    def test_unconverged_and_coverage_trip(self, tmp_path):
+        o = DeliveryOracle(dump_dir=str(tmp_path))
+        o.record_assign("m0", [("t", 0), ("t", 1)])
+        o.record_assign("m1", [("t", 1)])        # overlap; 2,3 unowned
+        o.record_poll("m0")
+        o.record_poll("m1")
+        with pytest.raises(OracleViolation) as ei:
+            o.verify(check_group=True, group_topic="t",
+                     group_partitions=4, converged_s=None)
+        rows = ei.value.report["violations"]["unconverged"]
+        assert rows[0]["reason"] == "no_convergence_within_bound"
+        assert rows[0]["missing"] == [2, 3]
+        assert "t:1" in rows[0]["overlaps"]
+
+    def test_stuck_consumer_trips(self):
+        o = DeliveryOracle()
+        self._seed_clean_group(o)
+        o.record_poll("never-assigned")          # joined, no assignment
+        with pytest.raises(OracleViolation) as ei:
+            o.verify(check_group=True, group_topic="t",
+                     group_partitions=4, converged_s=0.5)
+        stuck = ei.value.report["violations"]["stuck_consumer"]
+        assert [s["member"] for s in stuck] == ["never-assigned"]
+        assert stuck[0]["reason"] == "never_assigned"
+
+    def test_stopped_polling_trips_and_departed_exempt(self):
+        o = DeliveryOracle()
+        self._seed_clean_group(o)
+        o.record_assign("m2", [])
+        with o._lock:       # age m2's poll stamp past the bound
+            o.members["m2"]["last_poll"] = time.monotonic() - 60.0
+        with pytest.raises(OracleViolation) as ei:
+            o.verify(check_group=True, group_topic="t",
+                     group_partitions=4, converged_s=0.5)
+        stuck = ei.value.report["violations"]["stuck_consumer"]
+        assert stuck[0]["reason"] == "stopped_polling"
+        o.record_member_closed("m2")             # deliberate departure
+        r = o.verify(check_group=True, group_topic="t",
+                     group_partitions=4, converged_s=0.5)
+        assert r["ok"] and r["group"]["departed"] == 1
+
+    def test_group_checks_off_by_default(self):
+        o = DeliveryOracle()
+        o.record_poll("stuck-if-checked")
+        assert o.verify()["ok"]
+
+
+# ============================================= scenario library / CLI ==
+class TestScenarioLibrary:
+    def test_every_scenario_has_tier_seed_invariants(self):
+        tiers = {"fast", "slow", "soak"}
+        for name, sc in SCENARIOS.items():
+            assert sc.tier in tiers, name
+            assert isinstance(sc.seed, int), name
+            assert sc.invariants, name
+        assert any(sc.tier == "soak" for sc in SCENARIOS.values())
+
+    def test_cli_list_prints_tier_seed_invariants(self):
+        from librdkafka_tpu.chaos.__main__ import main
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert main(["--list"]) == 0
+        out = buf.getvalue()
+        for name, sc in SCENARIOS.items():
+            assert name in out
+        assert "external_kill9_eos" in out and "soak" in out
+        assert "loss,dup,order,atomicity,group" in out
+
+
+# ================================================ external (subprocess) ==
+@pytest.mark.chaos
+class TestClusterHandle:
+    def test_lifecycle_kill9_restart_pause_and_registry(self):
+        """One launch, the whole control surface: handshake, status,
+        pid-verified SIGKILL, same-port restart with a fresh pid,
+        SIGSTOP/SIGCONT, control queries, and registry hygiene."""
+        h = ClusterHandle(brokers=3, topics={"t": 4})
+        try:
+            hs = h.handshake
+            assert set(hs) >= {"bootstrap", "control", "pid", "brokers"}
+            assert len(h.broker_pids) == 3
+            assert all(pid_alive(p) for p in h.broker_pids.values())
+            # every spawned pid is registered for the leak fixture
+            reg = active_subprocess_pids()
+            assert h._proc.pid in reg
+            assert all(p in reg for p in h.broker_pids.values())
+
+            st = h.status()
+            assert st["alive"] == [1, 2, 3] and st["down"] == []
+            assert st["topics"]["t"] == [1, 2, 3, 1]
+
+            # deterministic coordinator placement (stable hash — the
+            # cross-process replay contract)
+            assert h.coordinator_for("g-x") == h.coordinator_for("g-x")
+
+            old_pid = h.broker_pids[2]
+            old_port = h.broker_ports[2]
+            r = h.kill9(2)
+            assert r["exit"] == -9 and not pid_alive(old_pid)
+            assert h.alive_brokers() == [1, 3]
+            assert h.status()["down"] == [2]
+            # migrated leadership is visible through the handle
+            assert all(pv.leader != 2 for pv in h.topics["t"])
+            with pytest.raises(ConnectionRefusedError):
+                socket.create_connection(("127.0.0.1", old_port),
+                                         timeout=2)
+
+            r = h.restart_broker(2)
+            assert r["port"] == old_port and r["pid"] != old_pid
+            assert pid_alive(r["pid"])
+            assert h.alive_brokers() == [1, 2, 3]
+            s = socket.create_connection(("127.0.0.1", old_port),
+                                         timeout=2)
+            s.close()
+
+            h.pause_broker(1)
+            assert h.status()["paused"] == [1]
+            h.resume_broker(1)
+            assert h.status()["paused"] == []
+
+            h.set_partition_leader("t", 0, 3)
+            assert h.partition("t", 0).leader == 3
+
+            kills = [e for e in h.proc_events if e["verb"] == "kill9"]
+            assert kills and kills[0]["verified_dead"]
+        finally:
+            h.stop()
+        # stop() reaps everything: registry empty, pids gone
+        assert h._proc.pid not in active_subprocess_pids()
+        assert not pid_alive(h._proc.pid)
+        assert all(not pid_alive(p) for p in h.broker_pids.values())
+
+    def test_replay_key_identical_across_supervisor_launches(self):
+        """ACCEPTANCE: same seed => identical replay_key AGAINST THE
+        EXTERNAL CLUSTER — two fresh supervisor processes must resolve
+        every rng-drawn target ("any" broker, coordinator placement)
+        identically."""
+        def run_once(seed):
+            h = ClusterHandle(brokers=3, topics={"t": 3})
+            try:
+                chaos = ChaosScheduler(h, min_alive=1)
+                chaos.run(Schedule(seed=seed)
+                          .at(0, proc_pause("any"))
+                          .at(0, proc_kill9("any"))
+                          .at(0, proc_cont())
+                          .at(0, proc_kill9("coordinator:replay-g"))
+                          .at(0, proc_restart())
+                          .at(0, proc_restart()))
+                assert not chaos.errors, chaos.errors
+                chaos.heal()
+                return chaos.replay_key()
+            finally:
+                h.stop()
+        k1, k2 = run_once(4242), run_once(4242)
+        assert k1 == k2
+        assert any(a == "proc_kill9" for _i, _t, a, _r in k1)
+
+
+# =============================================== fast external storms ==
+@pytest.mark.chaos
+class TestFastExternalScenarios:
+    def test_fast_external_kill9(self):
+        t0 = time.monotonic()
+        r = fast_external_kill9()
+        assert r["ok"], r["violations"]
+        assert r["external"] and not r["errors"]
+        assert not r["schedule_errors"]
+        kills = r["pids_killed"]
+        assert kills and all(e["verified_dead"] for e in kills), \
+            "SIGKILL must be pid-verified"
+        assert r["acked"] > 100 and r["consumed"] == r["acked"]
+        m = r["storm_metrics"]
+        assert m["storm_msgs_s"] > 0 and m["kills"] >= 1
+        assert m["recovery_ms"]["p99"] is not None
+        assert m["recovery_ms"]["unrecovered"] == 0
+        assert time.monotonic() - t0 < 25, "fast-tier budget blown"
+
+    def test_fast_group_churn(self):
+        t0 = time.monotonic()
+        r = fast_group_churn()
+        assert r["ok"], r["violations"]
+        g = r["group"]
+        assert g["members"] == 6 and g["departed"] == 2
+        assert g["coverage"]["converged"]
+        assert r["converged_s"] is not None
+        assert not r["violations"]["lost"]
+        assert time.monotonic() - t0 < 30, "fast-tier budget blown"
+
+
+# ======================================================= full storms ==
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestExternalStorms:
+    def test_flagship_external_kill9_eos(self):
+        """ISSUE 9 acceptance storm: >=3 SIGKILLs of real broker OS
+        processes (pid liveness verified) under sustained EOS produce +
+        read_committed consume by a 2-member group; zero loss / zero
+        dup / per-partition order / txn atomicity / group assignment
+        invariants all clean."""
+        r = external_kill9_eos(seed=21)
+        assert r["ok"], r["violations"]
+        assert r["kills_fired"] >= 3
+        kills = r["pids_killed"]
+        assert len(kills) >= 3
+        assert all(e["verified_dead"] and e["exit"] == -9 for e in kills)
+        assert len({e["pid"] for e in kills}) == len(kills), \
+            "each SIGKILL must hit a distinct live process"
+        assert r["txns"]["committed"] > 10
+        assert r["txns"]["aborted"] > 0          # atomicity exercised
+        assert r["txns"]["unknown"] == 0
+        assert not r["schedule_errors"]
+        assert r["group"]["coverage"]["converged"]
+        assert r["storm_metrics"]["recovery_ms"]["unrecovered"] == 0
+
+    def test_group_churn_coordinator_storm(self):
+        r = group_churn_coordinator_storm(seed=31)
+        assert r["ok"], r["violations"]
+        g = r["group"]
+        assert g["members"] == 20 and g["departed"] == 8
+        # churn + two coordinator deaths force many rebalance rounds
+        assert g["assignments"] > 25
+        assert g["coverage"]["converged"] and r["converged_s"] is not None
+        assert not r["violations"]["lost"]
+        coord_kills = [e for e in r["timeline"]
+                       if e["action"] == "broker_kill"
+                       and (e.get("resolved") or {}).get("broker")]
+        assert len(coord_kills) == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.soak
+class TestSoak:
+    def test_soak_kill9_txn_storm(self):
+        """Endurance tier (scripts/chaos.sh --soak): minutes of
+        unpaced EOS transactions against the external cluster under
+        repeated SIGKILL cycles — thousands of txns, dozens of real
+        process kills, every invariant checked at the end, zero leaked
+        subprocesses (conftest)."""
+        r = soak_kill9_txn_storm(seed=41, minutes=2.5)
+        assert r["ok"], r["violations"]
+        assert r["kills_fired"] >= 20
+        # ~550 txns/min on this 1-core host; generous margin for the
+        # occasional multi-second reconnect wedge under back-to-back
+        # kills of the same broker
+        assert r["txns"]["committed"] >= 800, \
+            f"soak should sustain txn throughput: {r['txns']}"
+        assert r["acked"] >= 2500, \
+            f"soak should push thousands of txn messages: {r['acked']}"
+        assert r["txns"]["unknown"] == 0
+        assert r["group"]["coverage"]["converged"]
+        assert r["storm_metrics"]["recovery_ms"]["p99"] is not None
+
+
+def test_chaos_bench_emits_robustness_metrics_schema():
+    """bench.py --chaos artifact contract (cheap static check — the
+    full bench leg runs the storms): the emitter surfaces storm
+    throughput + recovery latency at top level."""
+    import ast
+    import os
+    src = open(os.path.join(os.path.dirname(__file__), "..",
+                            "bench.py")).read()
+    tree = ast.parse(src)
+    fn = next(n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)
+              and n.name == "chaos_bench")
+    keys = {getattr(k, "value", None)
+            for n in ast.walk(fn) if isinstance(n, ast.Dict)
+            for k in n.keys}
+    for want in ("storm_msgs_s", "recovery_p99_ms", "recovery_p50_ms",
+                 "recovery_max_ms", "storm_kills"):
+        assert want in keys, f"chaos_bench must emit {want!r}"
