@@ -19,11 +19,28 @@ checks:
     its primitives through.  Disabled (the default), it returns PLAIN
     ``threading`` primitives: the production hot path pays exactly
     nothing (the decision happens once, at lock creation).
+  * :mod:`races` — an Eraser-style lockset data-race detector over
+    the DECLARED shared-state surface (``shared()`` class markers,
+    ``register_slots()``, ``shared_dict/list/counter()``): each
+    access refines a candidate lockset from lockdep's held-stack
+    through the virgin→exclusive→shared→shared-modified machine, and
+    an empty-lockset write is reported with both access stacks.
+    Disabled, every declaration resolves to a plain attribute /
+    container at creation time — the same zero-cost contract.
+  * :mod:`interleave` — a seeded schedule explorer: deterministic
+    preemption injection at the lock/queue/descriptor yield points
+    (per-thread streams seeded from (seed, thread name)), so latent
+    interleavings surface in CI and any failing schedule replays
+    exactly via its ``replay_key``.
   * :mod:`lint` — an AST lint encoding the project invariants (rule
-    catalog + rationale in ANALYSIS.md).
+    catalog + rationale in ANALYSIS.md), including ``shared-state``:
+    concurrent classes in the scoped layers must declare their
+    cross-thread mutable attributes (or carry a justified pragma).
 
-Gate: ``scripts/check.sh`` runs the lint over the whole package plus a
-lockdep-enabled stress pass (engine pipeline, a fast chaos storm, txn
-commit/abort) and exits nonzero on any finding.  ``pytest --lockdep``
-runs the whole test suite under instrumented locks.
+Gate: ``scripts/check.sh`` runs the lint over the whole package, a
+lockdep-enabled stress pass (engine pipeline, chaos storms, txn
+commit/abort), and the lockset races pass (same legs + seeded
+schedule reruns) and exits nonzero on any finding.  ``pytest
+--lockdep`` / ``pytest --races`` run the whole test suite under the
+instrumented locks / the lockset detector.
 """

@@ -1,8 +1,11 @@
-"""CLI: ``python -m librdkafka_tpu.analysis [lint|stress|all]``.
+"""CLI: ``python -m librdkafka_tpu.analysis [lint|stress|races|all]``.
 
 ``lint``   — AST project-invariant lint over the package (lint.py)
 ``stress`` — lockdep-enabled stress pass (stress.py)
-``all``    — both (the scripts/check.sh gate); exit 1 on any finding
+``races``  — lockset data-race sweep + seeded schedule explorer
+             (races.py / interleave.py via stress.py legs)
+``all``    — everything (the scripts/check.sh gate); exit 1 on any
+             finding
 """
 import sys
 
@@ -10,7 +13,7 @@ import sys
 def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     cmd = argv[0] if argv else "all"
-    if cmd not in ("lint", "stress", "all"):
+    if cmd not in ("lint", "stress", "races", "all"):
         print(__doc__)
         return 2
     rc = 0
@@ -20,6 +23,9 @@ def main(argv=None) -> int:
     if cmd in ("stress", "all"):
         from .stress import main as stress_main
         rc |= stress_main()
+    if cmd in ("races", "all"):
+        from .stress import races_main
+        rc |= races_main()
     return rc
 
 

@@ -28,6 +28,13 @@ Rules (ids are stable; suppress a line with ``# lint: ok <rule>``):
   lock-factory     lock sites in client/, ops/engine.py, ops/tpu.py,
                    mock/ and chaos/ create primitives through
                    analysis.locks so lockdep can instrument them
+  shared-state     classes in the same scoped layers that start
+                   threads or create factory locks must declare their
+                   cross-thread mutable attributes via
+                   analysis.races (shared()/register_slots()/
+                   shared_dict()/shared_list()/shared_counter()) so
+                   the lockset detector can see them — or carry a
+                   class-line pragma with a written justification
 
 The linter is intentionally lexical where data-flow would be needed
 for perfection (e.g. trace-guard accepts ``if t0:`` when ``t0`` was
@@ -52,11 +59,16 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition"}
 _FACTORY_SCOPE = ("client/", "mock/", "chaos/", "ops/engine.py",
                   "ops/tpu.py")
 
+#: calls that count as a shared-state declaration (analysis/races.py)
+_SHARED_DECLS = {"shared", "shared_dict", "shared_list",
+                 "shared_counter", "register_slots"}
+
 #: files whose job exempts them from specific rules
 _RULE_EXEMPT = {
     "manual-acquire": ("analysis/lockdep.py",),
     "trace-guard": ("obs/trace.py",),
     "lock-factory": ("analysis/",),
+    "shared-state": ("analysis/",),
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*ok\s+([a-z-]+(?:\s*,\s*[a-z-]+)*)")
@@ -305,6 +317,73 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# ------------------------------------------------- shared-state rule --
+def _call_name(node) -> Optional[str]:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _lint_shared_state(tree: ast.AST, relpath: str) -> list[Finding]:
+    """Concurrent classes must declare their cross-thread mutable
+    attributes to the lockset detector (analysis/races.py).  A class
+    in the lockdep-scoped layers "is concurrent" when it starts a
+    thread (threading.Thread/Timer call, or a Thread base) or creates
+    a factory lock; it "declares" when its body calls shared()/
+    shared_dict()/shared_list()/shared_counter(), or a module-level
+    register_slots(ClassName, ...) names it.  Suppress with a
+    ``# lint: ok shared-state`` pragma ON THE CLASS LINE plus a
+    written justification — the pragma is the judged-exception path,
+    exactly like the runtime detector's ``relaxed=True``."""
+    if not any(relpath.startswith(p) for p in _FACTORY_SCOPE):
+        return []
+    # prepass: classes declared via register_slots(Cls, ...)
+    slot_declared: set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and _call_name(node) == "register_slots"
+                and node.args and isinstance(node.args[0], ast.Name)):
+            slot_declared.add(node.args[0].id)
+    out: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        derives_thread = any(
+            (isinstance(b, ast.Attribute) and b.attr == "Thread")
+            or (isinstance(b, ast.Name) and b.id == "Thread")
+            for b in node.bases)
+        starts_thread = derives_thread
+        makes_lock = False
+        declares = node.name in slot_declared
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            cn = _call_name(n)
+            if cn in ("Thread", "Timer") and isinstance(
+                    n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name) and \
+                    n.func.value.id == "threading":
+                starts_thread = True
+            elif cn in ("new_lock", "new_rlock", "new_cond"):
+                makes_lock = True
+            elif cn in _SHARED_DECLS:
+                declares = True
+        if (starts_thread or makes_lock) and not declares:
+            what = ("starts threads" if starts_thread
+                    else "creates factory locks")
+            out.append(Finding(
+                relpath, node.lineno, "shared-state",
+                f"class {node.name} {what} but declares no shared "
+                "state — declare cross-thread mutable attributes via "
+                "analysis.races (shared()/register_slots()/shared_*()) "
+                "so the lockset detector sees them, or pragma the "
+                "class line with a written justification"))
+    return out
+
+
 # --------------------------------------------------- conf-prop rule --
 def _lint_conf_props(tree: ast.AST, relpath: str,
                      doc_names: Optional[set] = None) -> list[Finding]:
@@ -380,6 +459,8 @@ def lint_source(src: str, relpath: str,
     v = _Visitor(relpath, pre.attrs)
     v.visit(tree)
     findings = v.findings
+    if not _exempt("shared-state", relpath):
+        findings += _lint_shared_state(tree, relpath)
     if relpath == "client/conf.py":
         findings += _lint_conf_props(tree, relpath, doc_names)
     pragmas = _pragmas(src)

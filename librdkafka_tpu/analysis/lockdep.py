@@ -51,6 +51,8 @@ import traceback
 from contextlib import contextmanager
 from typing import Optional
 
+from . import interleave as _itl
+
 #: master switch — the locks factory consults this at CREATION time,
 #: instrumented primitives consult it per acquisition (so a disable()
 #: mid-run stops recording without swapping objects out)
@@ -158,6 +160,17 @@ def _note_release(obj) -> None:
             return
 
 
+def held_locks() -> list:
+    """This thread's currently-held instrumented locks as
+    ``[(lock_obj, class_name)]`` — the lockset source for the Eraser-
+    style detector (analysis/races.py): each declared-variable access
+    snapshots this stack and refines its candidate set with it."""
+    held = getattr(_local, "held", None)
+    if not held:
+        return []
+    return [(e[0], e[1]) for e in held]
+
+
 def note_blocking(what: str) -> None:
     """Call-site marker for a blocking operation (socket select or
     connect, device readback, ``queue.get``).  Guard with
@@ -192,6 +205,11 @@ class DepLock:
         self._lk = threading.Lock()
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _itl.active:
+            # schedule-explorer yield point (analysis/interleave.py):
+            # a preemption just before the acquire is how another
+            # thread wins a race for this lock's critical section
+            _itl.maybe_yield(f"lock:{self.name}")
         got = self._lk.acquire(blocking, timeout)
         if got:
             _note_acquire(self, self.name)
@@ -228,6 +246,8 @@ class DepRLock:
         self._count = 0
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _itl.active and self._owner != threading.get_ident():
+            _itl.maybe_yield(f"rlock:{self.name}")
         got = self._rl.acquire(blocking, timeout)
         if got:
             me = threading.get_ident()

@@ -1,4 +1,5 @@
-"""Lockdep-enabled stress pass: the runtime half of scripts/check.sh.
+"""Lockdep/lockset-enabled stress passes: the runtime half of
+scripts/check.sh.
 
 Drives every concurrent layer under instrumented locks and asserts a
 clean lock-order graph:
@@ -16,12 +17,27 @@ clean lock-order graph:
 Any cycle or held-across-blocking finding fails the gate (exit 1) with
 both acquisition stacks printed.  Run: ``python -m
 librdkafka_tpu.analysis stress`` (or ``scripts/check.sh``).
+
+The ``races`` pass (ISSUE 10) reruns the same legs under the Eraser-
+style lockset detector (races.py): every declared shared field's
+accesses refine their candidate locksets across app, rdk:main, broker,
+codec-worker, engine dispatch/warmup, mock-cluster and chaos threads —
+an empty-lockset write fails the gate with both access stacks.  It
+then replays the engine-pipeline and txn legs under N seeded
+schedules (interleave.SchedFuzzer): deterministic preemptions at the
+lock/queue/descriptor yield points surface interleavings the default
+scheduler never produces, each replayable via its ``replay_key``.
+Run: ``python -m librdkafka_tpu.analysis races``.
 """
 from __future__ import annotations
 
 import time
 
-from . import lockdep
+from . import interleave, lockdep, races
+
+#: seeds for the schedule-explorer reruns (one fuzzer per seed; any
+#: failure names its replay_key so the exact schedule re-runs)
+SCHEDULE_SEEDS = (11, 23)
 
 
 def _engine_pipeline_leg() -> int:
@@ -113,6 +129,43 @@ def run_stress() -> dict:
     finally:
         lockdep.disable()
     return lockdep.report()
+
+
+def run_races(seeds=SCHEDULE_SEEDS) -> tuple:
+    """The lockset pass: the same legs under the race detector (which
+    holds a lockdep reference — locksets come from its held-stack),
+    then the engine + txn legs re-run under one seeded schedule per
+    ``seed``.  Returns ``(races_report, schedule_keys)``."""
+    races.reset()
+    lockdep.reset()
+    races.enable()
+    keys = []
+    try:
+        _engine_pipeline_leg()
+        _txn_leg()
+        _chaos_leg()
+        for seed in seeds:
+            fz = interleave.SchedFuzzer(seed)
+            keys.append(fz.replay_key())
+            interleave.install(fz)
+            try:
+                _engine_pipeline_leg()
+                _txn_leg()
+            finally:
+                interleave.uninstall()
+    finally:
+        races.disable()
+    return races.report(), keys
+
+
+def races_main() -> int:
+    t0 = time.perf_counter()
+    rep, keys = run_races()
+    print(races.format_report(rep))
+    print(f"races: lockset sweep (engine pipeline + txn + fast chaos "
+          f"storm) + {len(keys)} seeded schedules "
+          f"{[k for k in keys]} in {time.perf_counter() - t0:.1f}s")
+    return 0 if races.clean(rep) else 1
 
 
 def main() -> int:

@@ -34,6 +34,7 @@ from typing import Optional
 
 from ..obs import trace
 from ..analysis.locks import new_lock
+from ..analysis.races import shared_dict, shared_list
 
 
 class OracleViolation(AssertionError):
@@ -63,26 +64,32 @@ class DeliveryOracle:
     def __init__(self, *, dump_dir: Optional[str] = None):
         self._lock = new_lock("chaos.oracle")
         self.dump_dir = dump_dir
+        # every ledger is declared shared (analysis/races.py): DR
+        # callbacks append from broker/poll threads, consumers from
+        # their own loops, the verdict snapshots from the storm thread
+        # — all under chaos.oracle, and the lockset sweep keeps the
+        # discipline honest (an unlocked append from a new callback
+        # path is an empty-lockset write)
         # acked produces: (topic, partition, offset, key, value, txn)
-        self.acked: list[tuple] = []
+        self.acked: list[tuple] = shared_list("oracle.acked")
         # produce failures: (topic, partition, value, txn, err_str) —
         # not required to be delivered, kept for the report
-        self.failed: list[tuple] = []
+        self.failed: list[tuple] = shared_list("oracle.failed")
         # consumed: (topic, partition, offset, value) in arrival order
-        self.consumed: list[tuple] = []
+        self.consumed: list[tuple] = shared_list("oracle.consumed")
         # txn id -> "open" | "committed" | "aborted" | "unknown"
-        self.txns: dict[str, str] = {}
+        self.txns: dict[str, str] = shared_dict("oracle.txns")
         # monotonic stamp per acked row (parallel to ``acked``): feeds
         # the storm-metrics recovery clock (time-to-first-ack after a
         # process kill), never the delivery verdict
-        self.acked_ts: list[float] = []
+        self.acked_ts: list[float] = shared_list("oracle.acked_ts")
         # ---- consumer-group ledger (ISSUE 9 group invariants) ----
         # member -> {"assigns": n, "current": set[(t,p)] | None,
         #            "last_poll": ts, "last_assign": ts, "closed": bool}
-        self.members: dict[str, dict] = {}
+        self.members: dict[str, dict] = shared_dict("oracle.members")
         # (ts, member, kind) for every membership/assignment change —
         # convergence is judged relative to the LAST of these
-        self.group_events: list[tuple] = []
+        self.group_events: list[tuple] = shared_list("oracle.group_events")
 
     # ---------------------------------------------------- producer side --
     def dr(self, txn: Optional[str] = None):

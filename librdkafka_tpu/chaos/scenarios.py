@@ -41,13 +41,17 @@ def _pct(vals: list, q: float) -> Optional[float]:
 
 
 # ---------------------------------------------------------------- storm --
-class Storm:
+class Storm:  # lint: ok shared-state
     """One storm run: cluster (in-process MockCluster or external
     ClusterHandle) + optional sockem + oracle + scheduler + paced
     producer/consumer loops.  Scenarios configure and run it;
     everything tears down in ``finally`` so a failed storm never leaks
     threads — or broker subprocesses — into the next one (the conftest
-    fixtures police both)."""
+    fixtures police both).
+
+    shared-state pragma: consumer loops communicate exclusively
+    through the oracle's declared ledgers (chaos.oracle lock) and
+    threading.Events; the storm thread reads results after joins."""
 
     def __init__(self, *, seed: int, brokers: int = 3,
                  partitions: int = 4, topic: str = "chaos",

@@ -397,9 +397,14 @@ class ChaosContext:
     paused: list = field(default_factory=list)
 
 
-class ChaosScheduler:
+class ChaosScheduler:  # lint: ok shared-state
     """Executes a Schedule on its own thread ("chaos-sched-*": the
     conftest leak fixture fails any test that leaves one alive).
+
+    shared-state pragma: the timeline and ctx books are written only
+    by the scheduler thread; storms read them after join()/stop() (a
+    happens-before edge), and heal() runs post-join on the storm
+    thread.
 
     ``timeline`` records every step as it fires:
     ``{"idx", "t", "action", "resolved", "wall", "error"}`` — ``idx``/

@@ -47,9 +47,14 @@ def arena_new():
     return m.Arena() if m else None
 
 
-class _PyLane:
+class _PyLane:  # lint: ok shared-state
     """Pure-Python Lane stand-in when the C extension is unavailable:
-    same interface, always routes produce() to the fallback."""
+    same interface, always routes produce() to the fallback.
+
+    shared-state pragma: mirrors the C lane's contract — counter RMWs
+    ride arena.pylane, the enable flags are single-writer rdk:main
+    ints read atomically under the GIL (same contract the native lane
+    documents for its struct fields)."""
 
     def __init__(self):
         self.map: dict = {}

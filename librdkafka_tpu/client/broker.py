@@ -31,6 +31,7 @@ from typing import Callable, Optional, TYPE_CHECKING
 
 from ..obs import trace as _trace
 from ..analysis import lockdep as _lockdep
+from ..analysis.races import shared
 from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..utils import sockbuf
@@ -383,6 +384,10 @@ class CodecWorker(threading.Thread):
     reference — its compression runs inline on each broker thread,
     rdkafka_msgset_writer.c:1129)."""
 
+    # relaxed: written only by the codec worker thread; tests read the
+    # high-water mark after flush/close joins
+    inflight_hwm = shared("codec_worker.inflight_hwm", relaxed=True)
+
     def __init__(self, rk):
         super().__init__(daemon=True, name="rdk:codec")
         import queue as _q
@@ -468,6 +473,31 @@ class CodecWorker(threading.Thread):
 
 class Broker:
     """One broker connection + its serve thread."""
+
+    # lockset declarations (analysis/races.py), all RELAXED with one
+    # justification: the broker is single-writer by design — every
+    # field below is mutated ONLY on this broker's serve thread (ops
+    # from other threads arrive through the locked OpQueue and are
+    # applied here), while the stats emitter and kafka accessors take
+    # lock-free len()/enum/int snapshots.  Those are atomic under the
+    # GIL and a one-emit-stale gauge is acceptable; adding a broker
+    # state lock would put an acquisition on every serve-loop step.
+    # The sweep still tracks these through the state machine, so a
+    # future SECOND writer thread shows up in the relaxed report.
+    state = shared("broker.state", relaxed=True)
+    ts_state = shared("broker.ts_state", relaxed=True)
+    waitresp = shared("broker.waitresp", relaxed=True)
+    toppars = shared("broker.toppars", relaxed=True)
+    _unsent_req_ends = shared("broker.unsent_req_ends", relaxed=True)
+    _fetch_pending = shared("broker.fetch_pending", relaxed=True)
+    _fetch_deferred = shared("broker.fetch_deferred", relaxed=True)
+    reconnect_backoff = shared("broker.reconnect_backoff", relaxed=True)
+    c_tx = shared("broker.c_tx", relaxed=True)
+    c_rx = shared("broker.c_rx", relaxed=True)
+    c_tx_bytes = shared("broker.c_tx_bytes", relaxed=True)
+    c_rx_bytes = shared("broker.c_rx_bytes", relaxed=True)
+    c_connects = shared("broker.c_connects", relaxed=True)
+    c_req_timeouts = shared("broker.c_req_timeouts", relaxed=True)
 
     def __init__(self, rk: "Kafka", nodeid: int, host: str, port: int,
                  name: str = ""):
@@ -1195,6 +1225,13 @@ class Broker:
         t_assembly = _trace.now() if _trace.enabled else 0
         ready: list[tuple] = []   # (toppar, msgs, writer|None-when-legacy)
 
+        # one locked flush-flag snapshot per serve pass (the --races
+        # sweep flagged the per-toppar lock-free reads against flush()'s
+        # kafka.msg_cnt-guarded writes); a pass-stale value only delays
+        # the linger override by one loop turn
+        with rk._msg_cnt_lock:
+            flush_forced = rk.flushing
+
         for tp in list(self.toppars):
             if tp.leader_id != self.nodeid:
                 continue
@@ -1258,7 +1295,7 @@ class Broker:
                             >= rk.conf.get("message.max.bytes"))
                     lingered = (first_us >= 0
                                 and now - first_us / 1e6 >= linger)
-                    if not (full or lingered or rk.flushing):
+                    if not (full or lingered or flush_forced):
                         continue
                     with tp.lock:
                         run = tp.arena.take(
@@ -1286,7 +1323,7 @@ class Broker:
                 continue
             full = len(tp.xmit_msgq) >= batch_max
             lingered = (now - oldest.enq_time) >= linger
-            if not (full or lingered or rk.flushing):
+            if not (full or lingered or flush_forced):
                 continue
             size_max = rk.conf.get("message.max.bytes")
             q = tp.xmit_msgq
@@ -1710,9 +1747,14 @@ class Broker:
                 continue
             if now < tp.fetch_backoff_until:
                 continue
-            if tp.fetchq_cnt >= rk.conf.get("queued.min.messages"):
+            # budget reads under the toppar lock: the app thread's
+            # drain decrements them concurrently (same --races finding
+            # as the kafka/consumer RMW sites)
+            with tp.lock:
+                fq_cnt, fq_bytes = tp.fetchq_cnt, tp.fetchq_bytes
+            if fq_cnt >= rk.conf.get("queued.min.messages"):
                 continue
-            if tp.fetchq_bytes >= rk.conf.get(
+            if fq_bytes >= rk.conf.get(
                     "queued.max.messages.kbytes") * 1024:
                 continue
             if tp.fetch_offset < 0:
@@ -1933,7 +1975,11 @@ class Broker:
         return ok
 
     def _queued_fetch_bytes(self) -> int:
-        return sum(tp.fetchq_bytes for tp in self.toppars)
+        total = 0
+        for tp in list(self.toppars):
+            with tp.lock:
+                total += tp.fetchq_bytes
+        return total
 
     def _serve_deferred_fetch(self) -> None:
         """Process deferred fetch partitions while the app-side queue
@@ -2008,7 +2054,8 @@ class Broker:
             block = False
             pend = self._fetch_pending.popleft()
             tp = pend.entry[0]
-            before = tp.fetchq_bytes
+            with tp.lock:
+                before = tp.fetchq_bytes
             # release-then-process, the sync path's ordering; migrated
             # partitions only release (their parked data is stale — the
             # new broker re-fetches the same offsets)
@@ -2024,7 +2071,9 @@ class Broker:
                 # brokers.fetch_latency, STATISTICS.md)
                 self.fetch_latency_avg.add(
                     (time.monotonic_ns() - pend.t_submit_ns) / 1e3)
-            delta += max(0, tp.fetchq_bytes - before)
+            with tp.lock:
+                after = tp.fetchq_bytes
+            delta += max(0, after - before)
         return delta
 
     @staticmethod

@@ -483,6 +483,16 @@ PROPERTIES: list[Prop] = [
        "(default) the factory returns plain threading primitives and "
        "the hot path pays nothing (bench.py --smoke gates this at "
        "< 1% of the produce budget)."),
+    _p("analysis.races", GLOBAL, "bool", False,
+       "Run this client under the Eraser-style lockset data-race "
+       "detector (analysis/races.py; implies the lockdep checker — "
+       "locksets come from its held-stack): every declared shared "
+       "field access refines a candidate lockset, and an empty-lockset "
+       "write is reported with both access stacks. Inspect with "
+       "analysis.races.report(). Debug/CI tool — disabled (default) "
+       "the shared() declarations resolve to plain attributes and the "
+       "hot path pays nothing (bench.py --smoke races_overhead gate, "
+       "< 1% of the produce budget)."),
     # ---- callbacks / opaque ----
     _p("error_cb", GLOBAL, "ptr", None, "Error callback."),
     _p("throttle_cb", GLOBAL, "ptr", None, "Throttle callback."),
@@ -631,9 +641,11 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "trace.enable"),
     (GLOBAL, "trace.ring.events"),
     (GLOBAL, "trace.dump.on.fatal"),
-    # lockdep concurrency analysis (ISSUE 8; the reference's analog is
+    # concurrency analysis (ISSUE 8 lockdep, ISSUE 10 lockset races;
+    # the reference's analog is
     # build-time helgrind/TSAN CI, not a conf row)
     (GLOBAL, "analysis.lockdep"),
+    (GLOBAL, "analysis.races"),
 })
 
 # Scope-keyed lookup: the reference's table has rows of the same name in

@@ -188,8 +188,9 @@ class Consumer:
                 tp.fetch_state = FetchState.STOPPED
                 tp.version += 1
                 tp.fetchq.forward_to(None)
-                tp.fetchq_cnt = 0
-                tp.fetchq_bytes = 0
+                with tp.lock:
+                    tp.fetchq_cnt = 0
+                    tp.fetchq_bytes = 0
         if rk.cgrp:
             rk.cgrp.assignment = assignment
         if not new_keys:
@@ -278,10 +279,16 @@ class Consumer:
                 if not pending:
                     return None
                 tp, msgs, ver, mbytes = pending.popleft()
-                fc = tp.fetchq_cnt - len(msgs)
-                tp.fetchq_cnt = fc if fc > 0 else 0
-                fb = tp.fetchq_bytes - mbytes
-                tp.fetchq_bytes = fb if fb > 0 else 0
+                # under the toppar lock: the broker thread's enqueue
+                # accounting (kafka._enq_fetched) is a concurrent RMW
+                # on the same counters (--races sweep finding: a GIL
+                # switch between load and store lost an update and the
+                # clamp silently re-zeroed the fetch budget)
+                with tp.lock:
+                    fc = tp.fetchq_cnt - len(msgs)
+                    tp.fetchq_cnt = fc if fc > 0 else 0
+                    fb = tp.fetchq_bytes - mbytes
+                    tp.fetchq_bytes = fb if fb > 0 else 0
                 cur = _new_cursor(tp, msgs, ver, (tp.topic, tp.partition))
                 self._cur = cur
             m = cur.next(self._assignment, self._auto_store)
@@ -559,8 +566,9 @@ class Consumer:
             raise KafkaException(Err._STATE, "partition not assigned")
         tp.version += 1
         tp.fetchq.pop_all()
-        tp.fetchq_cnt = 0
-        tp.fetchq_bytes = 0
+        with tp.lock:
+            tp.fetchq_cnt = 0
+            tp.fetchq_bytes = 0
         if partition.offset in (proto.OFFSET_BEGINNING, proto.OFFSET_END):
             tp.fetch_offset = partition.offset
             tp.fetch_state = FetchState.OFFSET_QUERY

@@ -104,7 +104,9 @@ class Event:
         return f"Event({self.type})"
 
 
-class BackgroundThread:
+class BackgroundThread:  # lint: ok shared-state
+    # shared-state pragma: the only cross-thread surfaces are the
+    # forwarded OpQueue (declared in queue.py) and a threading.Event.
     """The background event-serving thread (rdkafka_background.c:109):
     the reply queue is forwarded to a private queue served by this
     thread, which invokes the app's ``background_event_cb`` for every

@@ -18,6 +18,8 @@ from collections import deque
 from typing import Callable, Optional
 
 from ..analysis import lockdep as _lockdep
+from ..analysis import races as _races
+from ..analysis.races import shared
 from ..analysis.locks import new_cond, new_lock
 from ..obs import trace as _trace
 from ..protocol import apis, proto
@@ -37,8 +39,13 @@ from .queue import Op, OpQueue, OpType, Timers
 PRODUCER, CONSUMER = "producer", "consumer"
 
 
-class Topic:
-    """rd_kafka_itopic_t analog: per-topic state + UA message parking."""
+class Topic:  # lint: ok shared-state
+    """rd_kafka_itopic_t analog: per-topic state + UA message parking.
+
+    shared-state pragma: UA parking and partition_cnt are mutated only
+    on rdk:main (metadata/partitioner paths) under ``self.lock``; the
+    cross-thread surfaces live at the Toppar/OpQueue level, both
+    declared there."""
 
     def __init__(self, name: str, tconf: TopicConf):
         self.name = name
@@ -53,6 +60,14 @@ class IdempotenceManager:
     """EOS v1 producer-id state machine (reference:
     src/rdkafka_idempotence.c — REQ_PID→WAIT_PID→ASSIGNED, drain+epoch-bump
     recovery at :347-440)."""
+
+    # relaxed: the FSM is single-writer (rdk:main serve loop under
+    # kafka.idemp); can_produce() on the produce fast path and the
+    # stats emitter read lock-free — str/int snapshots, atomic under
+    # the GIL, and a stale read only delays a produce by one serve pass
+    state = shared("kafka.idemp.state", relaxed=True)
+    pid = shared("kafka.idemp.pid", relaxed=True)
+    epoch = shared("kafka.idemp.epoch", relaxed=True)
 
     def __init__(self, rk: "Kafka"):
         self.rk = rk
@@ -136,8 +151,21 @@ class IdempotenceManager:
                 self.state = "DRAIN"
 
 
-class Kafka:
-    """Client instance; create via Producer() or Consumer()."""
+class Kafka:  # lint: ok shared-state
+    """Client instance; create via Producer() or Consumer().
+
+    shared-state pragma: the client's cross-thread surfaces are
+    declared at their owning layers (OpQueue, Toppar, Broker,
+    StatsCollector, the offload engine); the handful of fields below
+    that genuinely cross threads are declared individually."""
+
+    # outstanding-count accounting crosses app + broker + codec
+    # threads, all under kafka.msg_cnt (the flush() contract)
+    dr_cnt = shared("kafka.dr_cnt")
+    flushing = shared("kafka.flushing")
+    # metadata cache: mutations happen under kafka.metadata on
+    # rdk:main; declared so the sweep sees its access pattern
+    metadata = shared("kafka.metadata_cache")
 
     def __init__(self, conf: Conf, client_type: str):
         self.conf = conf
@@ -150,6 +178,14 @@ class Kafka:
         if conf.get("analysis.lockdep"):
             _lockdep.enable()
             self._lockdep_ref = True
+        # lockset race detector (analysis/races.py): installs the
+        # Guarded descriptors on every declared class and holds a
+        # lockdep reference (locksets come from its held-stack) — also
+        # before the first lock/container below exists
+        self._races_ref = False
+        if conf.get("analysis.races"):
+            _races.enable()
+            self._races_ref = True
         self.is_producer = client_type == PRODUCER
         self.is_consumer = client_type == CONSUMER
         self.rep = OpQueue("rk_rep")          # app-facing reply queue
@@ -1100,6 +1136,14 @@ class Kafka:
             # (reference: rd_kafka_txn_set_abortable_error from the DR
             # path); purge DRs during abort are exempt inside msg_failed
             self.txnmgr.msg_failed(err)
+        if self.stats and err is None:
+            # stats txmsgs: acked produces (rdkafka.c txmsgs analog;
+            # this counter sat permanently at 0 before ISSUE 10 — no
+            # path ever bumped it).  Counted before the fast-lane
+            # branch so pure-accounting ArenaBatch resolutions (no DR
+            # consumer) are included.
+            self.stats.add_tx(msgs.count if isinstance(msgs, ArenaBatch)
+                              else len(msgs))
         batch_nbytes = None
         if isinstance(msgs, ArenaBatch):
             if self._dr_out_wanted():
@@ -1251,7 +1295,11 @@ class Kafka:
     def flush(self, timeout: float = 10.0) -> int:
         """Wait for all outstanding messages; returns count still queued
         (reference: rd_kafka_flush, rdkafka.c:3905)."""
-        self.flushing = True
+        # under the outq lock: broker threads read the flag (under the
+        # same lock) to decide whether an outstanding-count decrement
+        # must notify — the --races sweep flagged the bare store
+        with self._msg_cnt_lock:
+            self.flushing = True
         # DR-mode split (reference rk_drmode, rd_kafka_flush): with a dr
         # callback, flush serves the reply queue itself; in event mode
         # (enabled_events has "dr", no callback) it must NOT consume DR
@@ -1288,7 +1336,8 @@ class Kafka:
             with self._msg_cnt_lock:
                 return self.msg_cnt + self.dr_cnt
         finally:
-            self.flushing = False
+            with self._msg_cnt_lock:
+                self.flushing = False
 
     def purge(self, in_queue: bool = True, in_flight: bool = False) -> None:
         """Purge messages (reference: rd_kafka_purge):
@@ -1650,16 +1699,22 @@ class Kafka:
             for m in msgs:
                 self.interceptors.on_consume(m)
         # accounting BEFORE the push: the app thread may drain the op
-        # (decrements clamp at 0) the instant it becomes visible
-        tp.fetchq_cnt += len(msgs)
-        tp.fetchq_bytes += msgs_bytes
+        # (decrements clamp at 0) the instant it becomes visible.
+        # Under the toppar lock — the app thread's decrement is a
+        # concurrent read-modify-write, and the --races sweep convicted
+        # the old bare ``+=`` here racing consumer.py's drain (a GIL
+        # switch between the load and the store loses an update, and
+        # the clamp then silently re-zeroes the budget)
+        with tp.lock:
+            tp.fetchq_cnt += len(msgs)
+            tp.fetchq_bytes += msgs_bytes
         if msgs:
             # ONE op per parsed partition response (per-message op
             # push/pop dominated the consume profile)
             tp.fetchq.push(Op(OpType.FETCH,
                               payload=(tp, msgs, ver, msgs_bytes)))
         if self.stats:
-            self.stats.c_rx_msgs += len(msgs)
+            self.stats.add_rx(len(msgs))
         return True
 
     def offset_reset(self, tp: Toppar, reason: str):
@@ -1699,6 +1754,11 @@ class Kafka:
             # recording refcount drops
             self._lockdep_ref = False
             _lockdep.disable()
+        if self._races_ref:
+            # findings survive for races.report(); the last release
+            # uninstalls the Guarded descriptors
+            self._races_ref = False
+            _races.disable()
         with self._brokers_lock:
             brokers = list(self.brokers.values())
         for b in brokers:

@@ -17,6 +17,7 @@ import time
 from typing import Optional, TYPE_CHECKING
 
 from ..analysis.locks import new_lock
+from ..analysis.races import shared
 
 if TYPE_CHECKING:
     from .kafka import Kafka
@@ -97,6 +98,10 @@ class _OffsetFile:
 
 class FileOffsetStore:
     """All file-backed offsets for one client instance."""
+
+    # the file-handle table is touched from store (app) and commit
+    # (rdk:main) paths, always under offset_store.files
+    _files = shared("offset_store.files_map")
 
     def __init__(self, rk: "Kafka"):
         self.rk = rk

@@ -19,6 +19,7 @@ from typing import Optional
 
 from ..protocol import proto
 from ..analysis.locks import new_lock
+from ..analysis.races import register_slots
 from .msg import Message
 from .queue import OpQueue
 
@@ -175,3 +176,22 @@ class Toppar:
 
     def __repr__(self):
         return f"Toppar({self.topic}[{self.partition}])"
+
+
+# lockset declarations (analysis/races.py; slot form — Toppar is
+# __slots__).  Strict set: the producer queues and the fetch-budget
+# counters are RMW'd from app + broker + codec threads and every
+# access holds kafka.toppar (the fetchq counters' bare cross-thread
+# ``+=`` was the headline ISSUE-10 sweep finding).
+register_slots(Toppar, "msgq", "xmit_msgq", "msgq_bytes",
+               "fetchq_cnt", "fetchq_bytes",
+               prefix="toppar")
+# Relaxed: in-flight accounting, msgid assignment and the retry queue
+# are written under kafka.toppar, but the broker serve loop takes
+# lock-free ADVISORY peeks (max-inflight gate, retry/dedup scans) that
+# are re-validated under the lock before acting — the double-checked
+# pattern Eraser classically false-positives on.  Tracked, reported
+# informationally.
+register_slots(Toppar, "inflight", "inflight_msgids", "next_msgid",
+               "retry_batches", "fetch_in_flight", prefix="toppar",
+               relaxed=True)

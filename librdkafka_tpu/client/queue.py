@@ -17,7 +17,9 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from ..analysis import interleave as _itl
 from ..analysis.locks import new_cond, new_lock
+from ..analysis.races import shared
 
 
 class OpType(enum.Enum):
@@ -52,6 +54,16 @@ class Op:
 class OpQueue:
     """MPSC op queue with forwarding and optional wakeup callback."""
 
+    # lockset-checked shared state (analysis/races.py): every field
+    # producers/consumers race over is guarded by ``queue.opq`` —
+    # including the wakeup callback, which is PUBLISHED under the lock
+    # (the --races sweep caught the old unlocked set against push()'s
+    # locked read)
+    _items = shared("queue.opq.items")
+    _fwd = shared("queue.opq.fwd")
+    _wakeup_cb = shared("queue.opq.wakeup_cb")
+    disabled = shared("queue.opq.disabled")
+
     def __init__(self, name: str = "q"):
         self.name = name
         self._lock = new_lock("queue.opq")
@@ -73,7 +85,8 @@ class OpQueue:
             dst.push(op)
 
     def set_wakeup_cb(self, cb: Optional[Callable[[], None]]):
-        self._wakeup_cb = cb
+        with self._lock:
+            self._wakeup_cb = cb
 
     def io_event_enable(self, fd: int, payload: bytes = b"1") -> None:
         """App event-loop integration (reference:
@@ -83,7 +96,8 @@ class OpQueue:
         The write is non-blocking and best-effort — a full pipe means a
         wakeup is already pending."""
         if fd < 0:
-            self._wakeup_cb = None
+            with self._lock:
+                self._wakeup_cb = None
             return
         import os
 
@@ -92,9 +106,12 @@ class OpQueue:
                 os.write(_fd, _payload)
             except (BlockingIOError, OSError):
                 pass
-        self._wakeup_cb = _wake
+        with self._lock:
+            self._wakeup_cb = _wake
 
     def push(self, op: Op) -> None:
+        if _itl.active:
+            _itl.maybe_yield("opq.push")
         with self._lock:
             fwd = self._fwd
             if fwd is None:
@@ -112,6 +129,8 @@ class OpQueue:
             wcb()
 
     def pop(self, timeout: Optional[float] = None) -> Optional[Op]:
+        if _itl.active:
+            _itl.maybe_yield("opq.pop")
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while not self._items:
@@ -180,9 +199,11 @@ class OpQueue:
         return len(fwd)
 
 
-class SyncReply:
+class SyncReply:  # lint: ok shared-state
     """Condvar-blocking reply slot for synchronous request/response
-    calls — the reference's pattern of enqueuing an op with a replyq
+    calls (shared-state pragma: the condvar IS the whole state —
+    callers own the predicate's storage and declare it at their layer)
+    — the reference's pattern of enqueuing an op with a replyq
     and blocking in rd_kafka_q_serve on it (rdkafka_queue.c:431),
     without the op-object overhead: response callbacks call
     :meth:`post` after recording their result; the caller blocks in
@@ -223,6 +244,11 @@ class _Timer:
 class Timers:
     """Monotonic timer wheel served by an owning thread
     (reference: rd_kafka_timers_run, rdkafka_timer.c:226)."""
+
+    # add() runs on app/broker threads, run()/next_timeout on the
+    # owner; both sides hold ``queue.timers``
+    _heap = shared("queue.timers.heap")
+    _seq = shared("queue.timers.seq")
 
     def __init__(self):
         self._heap: list[_Timer] = []

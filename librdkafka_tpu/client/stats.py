@@ -13,6 +13,7 @@ from typing import TYPE_CHECKING
 
 from ..utils.hdrhistogram import HdrHistogram
 from ..analysis.locks import new_lock
+from ..analysis.races import register_slots, shared
 
 if TYPE_CHECKING:
     from .kafka import Kafka
@@ -60,21 +61,48 @@ class Avg:
         return out
 
 
+# every histogram touch — record from app/broker/codec threads,
+# rollover from the stats emitter — holds stats.avg (analysis/races.py
+# verifies the discipline; the slot form because Avg is __slots__)
+register_slots(Avg, "_hist", prefix="stats.avg")
+
+
 class StatsCollector:
     """Aggregates counters from the client and renders the stats JSON."""
+
+    # txmsgs/rxmsgs are bumped from broker ack paths and the consumer
+    # poll loop while the emitter timer reads them — all under
+    # stats.counters since ISSUE 10 (the --races sweep convicted the
+    # old bare ``+=`` against the emitter's read; it also surfaced
+    # that c_tx_msgs was never bumped at all — txmsgs sat at 0)
+    c_tx_msgs = shared("stats.c_tx_msgs")
+    c_rx_msgs = shared("stats.c_rx_msgs")
 
     def __init__(self, rk: "Kafka"):
         self.rk = rk
         self.ts_start = time.time()
+        self._clock = new_lock("stats.counters")
         self.c_tx_msgs = 0
         self.c_rx_msgs = 0
         self.int_latency = Avg()      # produce() -> MessageSet write
         self.codec_latency = Avg()    # batched codec provider call
 
+    def add_tx(self, n: int) -> None:
+        """Count ``n`` successfully produced (acked) messages."""
+        with self._clock:
+            self.c_tx_msgs += n
+
+    def add_rx(self, n: int) -> None:
+        """Count ``n`` messages delivered to the consumer app."""
+        with self._clock:
+            self.c_rx_msgs += n
+
     def emit_json(self) -> str:
         rk = self.rk
         brokers = {}
-        for b in list(rk.brokers.values()):
+        with rk._brokers_lock:
+            rk_brokers = list(rk.brokers.values())
+        for b in rk_brokers:
             brokers[b.name] = {
                 "name": b.name, "nodeid": b.nodeid, "state": b.state.value,
                 "stateage": int((time.monotonic() - b.ts_state) * 1e6),
@@ -96,7 +124,9 @@ class StatsCollector:
                             for tp in list(b.toppars)},
             }
         topics = {}
-        for (t, p), tp in list(rk._toppars.items()):
+        with rk._toppars_lock:
+            toppars = list(rk._toppars.items())
+        for (t, p), tp in toppars:
             topics.setdefault(t, {"topic": t, "partitions": {}})
             # reference lag (rdkafka.c:1283-1297): end_offset (ls under
             # read_committed) minus MAX(app, committed), clamped >= 0
@@ -105,14 +135,23 @@ class StatsCollector:
                    else tp.hi_offset)
             base = max(tp.app_offset, tp.committed_offset)
             lag = max(0, end - base) if end >= 0 and base >= 0 else -1
+            # queue gauges under the toppar lock: the app enqueues and
+            # the broker drains while the emitter reads (the --races
+            # sweep flagged the old lock-free len()/int peeks against
+            # kafka.toppar-guarded writes)
+            with tp.lock:
+                msgq_cnt = (len(tp.msgq)
+                            + (len(tp.arena) if tp.arena is not None
+                               else 0))
+                msgq_bytes = tp.msgq_bytes
+                xmit_cnt = len(tp.xmit_msgq)
+                fetchq_cnt = tp.fetchq_cnt
             topics[t]["partitions"][str(p)] = {
                 "partition": p, "leader": tp.leader_id,
-                "msgq_cnt": (len(tp.msgq)
-                             + (len(tp.arena) if tp.arena is not None
-                                else 0)),
-                "msgq_bytes": tp.msgq_bytes,
-                "xmit_msgq_cnt": len(tp.xmit_msgq),
-                "fetchq_cnt": tp.fetchq_cnt,
+                "msgq_cnt": msgq_cnt,
+                "msgq_bytes": msgq_bytes,
+                "xmit_msgq_cnt": xmit_cnt,
+                "fetchq_cnt": fetchq_cnt,
                 "fetch_state": tp.fetch_state.value,
                 "app_offset": tp.app_offset,
                 "stored_offset": tp.stored_offset,
@@ -121,6 +160,10 @@ class StatsCollector:
                 "ls_offset": tp.ls_offset,
                 "consumer_lag": lag,
             }
+        with rk._metadata_lock:
+            metadata_cache_cnt = len(rk.metadata.get("topics", {}))
+        with self._clock:
+            txmsgs, rxmsgs = self.c_tx_msgs, self.c_rx_msgs
         blob = {
             "name": rk.conf.get("client.id"),
             "client_id": rk.conf.get("client.id"),
@@ -138,8 +181,8 @@ class StatsCollector:
             "tx_bytes": sum(b["txbytes"] for b in brokers.values()),
             "rx": sum(b["rx"] for b in brokers.values()),
             "rx_bytes": sum(b["rxbytes"] for b in brokers.values()),
-            "metadata_cache_cnt": len(rk.metadata.get("topics", {})),
-            "txmsgs": self.c_tx_msgs, "rxmsgs": self.c_rx_msgs,
+            "metadata_cache_cnt": metadata_cache_cnt,
+            "txmsgs": txmsgs, "rxmsgs": rxmsgs,
             "int_latency": self.int_latency.rollover(),
             "codec_latency": self.codec_latency.rollover(),
             "brokers": brokers,

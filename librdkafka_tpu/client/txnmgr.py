@@ -39,6 +39,7 @@ from typing import Optional, TYPE_CHECKING
 
 from ..protocol.proto import ApiKey
 from ..analysis.locks import new_cond, new_rlock
+from ..analysis.races import shared
 from .broker import Request
 from .errors import Err, KafkaError, KafkaException
 from .queue import Op, OpType
@@ -68,6 +69,20 @@ FATAL = frozenset({
 
 class TransactionManager:
     """Owns the txn FSM for one transactional producer instance."""
+
+    # relaxed lockset declarations (analysis/races.py): every FSM
+    # transition and registration mutation happens under the txn.mgr
+    # RLock, but the produce gate (kafka.produce: ``state != IN_TXN``)
+    # and the stats emitter read lock-free — str/int/len snapshots,
+    # atomic under the GIL, and the gate is re-validated by the broker
+    # protocol (PRODUCER_FENCED / INVALID_TXN_STATE) if it races a
+    # transition.  Tracked so a second writer thread would surface.
+    state = shared("txn.state", relaxed=True)
+    pid = shared("txn.pid", relaxed=True)
+    epoch = shared("txn.epoch", relaxed=True)
+    coord_id = shared("txn.coord_id", relaxed=True)
+    _registered = shared("txn.registered", relaxed=True)
+    _pending = shared("txn.pending", relaxed=True)
 
     def __init__(self, rk: "Kafka"):
         self.rk = rk

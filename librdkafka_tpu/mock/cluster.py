@@ -33,6 +33,7 @@ from ..protocol.proto import ApiKey
 from ..utils.buf import Slice
 from ..analysis import lockdep as _lockdep
 from ..analysis.locks import new_rlock
+from ..analysis.races import shared_dict
 
 _TOPIC_CHARS = frozenset(
     "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
@@ -237,15 +238,28 @@ class MockCluster:
         # per-partition size retention for long-running/benchmark use
         # (real brokers: log.retention.bytes); 0 keeps everything
         self.retention_bytes = retention_bytes
-        self.topics: dict[str, list[MockPartition]] = {}
-        self.groups: dict[str, MockGroup] = {}
+        # the cluster tables are declared shared (analysis/races.py),
+        # RELAXED with one justification: every handler and chaos
+        # controller hook (kill/restart/migrate from the scheduler
+        # thread) mutates them under mock.cluster, but tests are the
+        # mock's second client — the driver thread inspects
+        # ``cluster.topics[...]`` / ``cluster.groups[...]`` lock-free
+        # by design (snapshot peeks of a test fixture).  The sweep
+        # still tracks them, so a genuinely unlocked HANDLER mutation
+        # shows up in the relaxed report's stacks.
+        self.topics: dict[str, list[MockPartition]] = \
+            shared_dict("mock.topics", relaxed=True)
+        self.groups: dict[str, MockGroup] = \
+            shared_dict("mock.groups", relaxed=True)
         self.cluster_id = "mockCluster"
         self.controller_id = 1
         self._next_pid = 1
         # transaction-coordinator role: per-transactional.id state +
         # the pid -> tid reverse map the Produce path fences through
-        self.transactions: dict[str, MockTransaction] = {}
-        self._pid_tid: dict[int, str] = {}
+        self.transactions: dict[str, MockTransaction] = \
+            shared_dict("mock.transactions", relaxed=True)
+        self._pid_tid: dict[int, str] = \
+            shared_dict("mock.pid_tid", relaxed=True)
         self._lock = new_rlock("mock.cluster")
         # fault injection
         self._err_stacks: dict[int, deque] = defaultdict(deque)

@@ -82,8 +82,12 @@ def pid_alive(pid: int) -> bool:
     return True
 
 
-class ClusterHandle:
+class ClusterHandle:  # lint: ok shared-state
     """A supervised N-broker-process mock cluster, as one object.
+
+    shared-state pragma: down/paused sets and proc_events are mutated
+    only under ``mock.external.handle``; the control-plane socket is
+    the cross-process boundary (no shared memory).
 
     >>> h = ClusterHandle(brokers=3, topics={"chaos": 4})
     >>> h.bootstrap_servers()

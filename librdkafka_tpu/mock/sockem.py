@@ -38,10 +38,16 @@ import time
 from typing import Optional
 
 from ..analysis.locks import new_lock
+from ..analysis.races import shared
 
 
-class _Pump(threading.Thread):
-    """One direction of one proxied connection."""
+class _Pump(threading.Thread):  # lint: ok shared-state
+    """One direction of one proxied connection.
+
+    shared-state pragma: the pump owns no mutable state of its own —
+    it reads the em's live knobs (declared on Sockem) and the conn's
+    dead flag (single close()-writer, benign stale read of one poll
+    interval)."""
 
     def __init__(self, conn: "SockemConn", src: socket.socket,
                  dst: socket.socket, label: str):
@@ -104,6 +110,11 @@ class _Pump(threading.Thread):
 class SockemConn:
     """A proxied broker connection (reference: sockem_t)."""
 
+    # relaxed: dead is written once by close() under sockem.conn; the
+    # two pump threads poll it lock-free (a stale False costs one 0.1s
+    # poll interval before the socket error surfaces anyway)
+    dead = shared("sockem.conn.dead", relaxed=True)
+
     def __init__(self, em: "Sockem", real: socket.socket):
         self.em = em
         self.real = real
@@ -135,6 +146,17 @@ class SockemConn:
 
 class Sockem:
     """Factory + live control panel for emulated connections."""
+
+    # relaxed: the live shaping knobs are written by the controlling
+    # (test/chaos) thread via set() and read per-chunk by pump threads
+    # — float/int/bool snapshots; applying a setting one chunk late is
+    # within the emulation's contract.  conns mutations hold sockem.em.
+    delay_s = shared("sockem.delay_s", relaxed=True)
+    jitter_s = shared("sockem.jitter_s", relaxed=True)
+    rate = shared("sockem.rate", relaxed=True)
+    max_write = shared("sockem.max_write", relaxed=True)
+    rx_drop = shared("sockem.rx_drop", relaxed=True)
+    tx_drop = shared("sockem.tx_drop", relaxed=True)
 
     def __init__(self, *, delay_ms: float = 0, jitter_ms: float = 0,
                  rate_bps: int = 0, max_write: int = 0,

@@ -64,12 +64,17 @@ _RELAY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "_relay.py")
 
 
-class Supervisor:
+class Supervisor:  # lint: ok shared-state
     """Parent of one relay OS process per broker; owns the MockCluster
     storage/controller plane and the line-protocol control socket.
 
     All child waits go through ``Popen.wait`` (reaper threads) or
-    condvar waits — no sleep-polling anywhere in the wait paths."""
+    condvar waits — no sleep-polling anywhere in the wait paths.
+
+    shared-state pragma: the proc/port/pid tables are mutated only
+    under ``mock.supervisor`` (the condvar's lock serializes the ctl
+    loop against the reaper threads); cross-PROCESS state is the relay
+    handshake, not shared memory."""
 
     def __init__(self, num_brokers: int, topics=None,
                  default_partitions: int = 4, retention_bytes: int = 0):

@@ -104,6 +104,7 @@ import numpy as np
 from ..obs import trace as _trace
 from ..analysis import lockdep as _lockdep
 from ..analysis.locks import new_cond, new_lock
+from ..analysis.races import register_slots, shared, shared_dict
 
 
 class Ticket:
@@ -276,11 +277,19 @@ class _Governor:
 
     __slots__ = ("enabled", "fanin_cap_s", "interarrival_s",
                  "_last_submit", "cpu_ns_per_byte", "dev_launch_s",
-                 "_since_explore")
+                 "_since_explore", "_glock")
 
     def __init__(self, enabled: bool, fanin_cap_s: float):
         self.enabled = bool(enabled)
         self.fanin_cap_s = float(fanin_cap_s)
+        # every EWMA below is mutated under _glock: submitters update
+        # the arrival model (note_submit), the dispatch thread updates
+        # the device/CPU cost models and the explore counter
+        # (note_device/note_cpu/route), and the stats emitter reads
+        # snapshots from ITS thread — the --races sweep convicted the
+        # old lock-free read-modify-writes (an explore-path route()
+        # racing snapshot(), a dropped note_device update)
+        self._glock = new_lock("engine.governor")
         self.interarrival_s: Optional[float] = None
         self._last_submit: Optional[float] = None
         self.cpu_ns_per_byte: Optional[float] = None
@@ -291,12 +300,13 @@ class _Governor:
     def _ewma(self, old: Optional[float], v: float) -> float:
         return v if old is None else old + self.EWMA_ALPHA * (v - old)
 
-    # ---- submitter side (engine lock held) ----
+    # ---- submitter side ----
     def note_submit(self, now: float) -> None:
-        last, self._last_submit = self._last_submit, now
-        if last is not None:
-            self.interarrival_s = self._ewma(self.interarrival_s,
-                                             now - last)
+        with self._glock:
+            last, self._last_submit = self._last_submit, now
+            if last is not None:
+                self.interarrival_s = self._ewma(self.interarrival_s,
+                                                 now - last)
 
     # ---- dispatch-thread side ----
     def fanin_window(self, need: int) -> float:
@@ -305,9 +315,10 @@ class _Governor:
         when the mean inter-arrival already exceeds the cap (nothing
         will merge — dispatch now, don't tax latency)."""
         cap = self.fanin_cap_s
-        if not self.enabled or self.interarrival_s is None:
+        with self._glock:
+            ia = self.interarrival_s
+        if not self.enabled or ia is None:
             return cap
-        ia = self.interarrival_s
         if ia >= cap:
             return 0.0
         return min(cap, 2.0 * max(1, need) * ia)
@@ -316,69 +327,88 @@ class _Governor:
                     dev: int = 0) -> None:
         if bucket is not None:
             key = (dev, bucket)
-            self.dev_launch_s[key] = self._ewma(
-                self.dev_launch_s.get(key), dt)
+            with self._glock:
+                self.dev_launch_s[key] = self._ewma(
+                    self.dev_launch_s.get(key), dt)
 
     def lane_device_s(self, dev: int, bucket: int) -> Optional[float]:
         """The (device, bucket) launch-time estimate — lane selection's
         tie-break (None: the lane hasn't run this bucket yet)."""
-        return self.dev_launch_s.get((dev, bucket))
+        with self._glock:
+            return self.dev_launch_s.get((dev, bucket))
 
     def best_device_s(self, bucket: int) -> Optional[float]:
         """The fastest known device estimate for a bucket — what the
         CPU-vs-device route decision compares against (the engine will
         pick that lane, or a less-loaded one that can only be busy
         because it is also making progress)."""
-        best = None
-        for (d, b), s in self.dev_launch_s.items():
-            if b == bucket and (best is None or s < best):
-                best = s
-        return best
+        with self._glock:
+            best = None
+            for (d, b), s in self.dev_launch_s.items():
+                if b == bucket and (best is None or s < best):
+                    best = s
+            return best
 
     def note_cpu(self, nbytes: int, dt: float) -> None:
         if nbytes > 0:
-            self.cpu_ns_per_byte = self._ewma(self.cpu_ns_per_byte,
-                                              dt * 1e9 / nbytes)
+            with self._glock:
+                self.cpu_ns_per_byte = self._ewma(self.cpu_ns_per_byte,
+                                                  dt * 1e9 / nbytes)
 
     def route(self, bucket: int, nbytes: int) -> tuple[str, bool]:
         """('device'|'cpu', explored) for an at-quorum group.  Unknown
         estimates prefer the device — exactly the static policy — so
         configs without governor history behave identically."""
         dev = self.best_device_s(bucket)
-        cpu = self.cpu_ns_per_byte
-        if dev is None or cpu is None:
-            return "device", False
-        pick = "device" if dev <= nbytes * cpu / 1e9 else "cpu"
-        self._since_explore += 1
-        if self._since_explore >= self.EXPLORE_EVERY:
-            self._since_explore = 0
-            return ("cpu" if pick == "device" else "device"), True
-        return pick, False
+        with self._glock:
+            cpu = self.cpu_ns_per_byte
+            if dev is None or cpu is None:
+                return "device", False
+            pick = "device" if dev <= nbytes * cpu / 1e9 else "cpu"
+            self._since_explore += 1
+            if self._since_explore >= self.EXPLORE_EVERY:
+                self._since_explore = 0
+                return ("cpu" if pick == "device" else "device"), True
+            return pick, False
 
     def snapshot(self) -> dict:
         """JSON-ready gauges for the statistics blob.  dev_launch_ms
         keeps its pre-mesh shape — the best (fastest) device estimate
         per bucket; the full per-device split rides
         codec_engine.devices[]."""
+        with self._glock:
+            dev_launch = dict(self.dev_launch_s)
+            ia = self.interarrival_s
+            cpu = self.cpu_ns_per_byte
         best: dict[int, float] = {}
-        for (d, b), s in self.dev_launch_s.items():
+        for (d, b), s in dev_launch.items():
             if b not in best or s < best[b]:
                 best[b] = s
         return {
             "enabled": self.enabled,
-            "interarrival_us": (None if self.interarrival_s is None
-                                else round(self.interarrival_s * 1e6, 1)),
-            "cpu_ns_per_byte": (None if self.cpu_ns_per_byte is None
-                                else round(self.cpu_ns_per_byte, 3)),
+            "interarrival_us": (None if ia is None
+                                else round(ia * 1e6, 1)),
+            "cpu_ns_per_byte": (None if cpu is None
+                                else round(cpu, 3)),
             "dev_launch_ms": {str(b): round(s * 1e3, 3)
                               for b, s in sorted(best.items())},
         }
 
     def device_launch_ms(self, dev: int) -> dict:
         """One device's {bucket: ms} EWMAs (codec_engine.devices[])."""
+        with self._glock:
+            items = sorted(self.dev_launch_s.items())
         return {str(b): round(s * 1e3, 3)
-                for (d, b), s in sorted(self.dev_launch_s.items())
-                if d == dev}
+                for (d, b), s in items if d == dev}
+
+
+# the governor's online models are cross-thread by design — submitters
+# feed the arrival EWMA, the dispatch thread the cost models, the
+# stats emitter reads snapshots; all serialized under engine.governor
+# since ISSUE 10 (the --races sweep convicted the old lock-free RMWs)
+register_slots(_Governor, "interarrival_s", "_last_submit",
+               "cpu_ns_per_byte", "dev_launch_s", "_since_explore",
+               prefix="engine.governor")
 
 
 class AsyncOffloadEngine:
@@ -394,6 +424,23 @@ class AsyncOffloadEngine:
     #: minimum blocks PER DEVICE before a group splits across the mesh
     #: (below it, whole-to-one-lane beats the scatter/gather overhead)
     SHARD_MIN_ROWS = 8
+
+    # lockset-checked shared state (analysis/races.py): the submit
+    # queue, warm-request queue and closed flag cross submitter /
+    # dispatch / warmup threads under engine.queue.  The lane list and
+    # gauges are relaxed: lanes are written ONCE under engine.lanes
+    # (the pre-ready read outside the lock only ever sees the final
+    # value or triggers the locked double-check), and the gauges are
+    # single-writer dispatch-thread ints read as snapshots by the
+    # stats emitter — atomic under the GIL, torn reads impossible.
+    _queue = shared("engine.queue.jobs")
+    _warm_requests = shared("engine.warm_requests")
+    _closed = shared("engine.closed")
+    _lanes = shared("engine.lanes_list", relaxed=True)
+    _shard_lane = shared("engine.shard_lane", relaxed=True)
+    _lanes_ready = shared("engine.lanes_ready", relaxed=True)
+    _inflight_cnt = shared("engine.gauge.inflight", relaxed=True)
+    _fanin_last = shared("engine.gauge.fanin", relaxed=True)
 
     def __init__(self, *, depth: int = 2, fanin_window_s: float = 0.0005,
                  min_batches: int = 4,
@@ -433,16 +480,23 @@ class AsyncOffloadEngine:
         # compiles these before continuing its sweep; items are
         # ("kernel", B, kind, dev_id) or ("shard", Bs, kind)
         self._warm_requests: deque[tuple] = deque()
-        # observability (PERF.md pipeline section + governor counters)
-        self.stats = {"launches": 0, "blocks": 0, "jobs": 0,
-                      "aggregated": 0, "cpu_fallback_jobs": 0,
-                      "fanin_waits": 0, "host_jobs": 0,
-                      # governor decisions (ISSUE 3)
-                      "fanin_skips": 0, "warmup_miss_jobs": 0,
-                      "warmup_compiled": 0, "routed_cpu_jobs": 0,
-                      "explore_routes": 0, "fused_launches": 0,
-                      # mesh-sharded dispatch (ISSUE 6)
-                      "sharded_launches": 0}
+        # observability (PERF.md pipeline section + governor counters).
+        # Declared relaxed: single-writer (the dispatch thread —
+        # warmup_compiled moved under engine.queue in ISSUE 10, the one
+        # other-thread bump the sweep found) with snapshot readers
+        # (tests, the stats emitter); int cell reads are atomic under
+        # the GIL.
+        self.stats = shared_dict("engine.stats", relaxed=True)
+        self.stats.update(
+            {"launches": 0, "blocks": 0, "jobs": 0,
+             "aggregated": 0, "cpu_fallback_jobs": 0,
+             "fanin_waits": 0, "host_jobs": 0,
+             # governor decisions (ISSUE 3)
+             "fanin_skips": 0, "warmup_miss_jobs": 0,
+             "warmup_compiled": 0, "routed_cpu_jobs": 0,
+             "explore_routes": 0, "fused_launches": 0,
+             # mesh-sharded dispatch (ISSUE 6)
+             "sharded_launches": 0})
         # per-stage latency decomposition (ISSUE 5): windowed
         # HdrHistogram Avgs feeding codec_engine.stage_latency in the
         # stats JSON — submit->launch wait, launch->readback (device),
@@ -558,10 +612,17 @@ class AsyncOffloadEngine:
         from .crc32c_jax import _MXU_BLOCK, kernel_ready
         deadline = time.monotonic() + timeout
         while not kernel_ready(B, _MXU_BLOCK, poly, device=device):
-            if time.monotonic() >= deadline or self._closed:
+            if time.monotonic() >= deadline or self._is_closed():
                 return kernel_ready(B, _MXU_BLOCK, poly, device=device)
             time.sleep(0.02)
         return True
+
+    def _is_closed(self) -> bool:
+        """Locked read of the closed flag for the warmup thread and
+        test hooks (the dispatch loop reads it under the condvar it
+        already holds)."""
+        with self._lock:
+            return self._closed
 
     def governor_snapshot(self) -> dict:
         """Governor gauges for the statistics JSON (client/stats.py).
@@ -712,8 +773,10 @@ class AsyncOffloadEngine:
                           for Bs in self.WARM_BUCKETS
                           for kind in self.WARM_KINDS]
             i = 0
-            while not self._closed:
+            while True:
                 with self._lock:
+                    if self._closed:
+                        return
                     item = (self._warm_requests.popleft()
                             if self._warm_requests else None)
                 if item is None:
@@ -741,7 +804,11 @@ class AsyncOffloadEngine:
                         _mesh.warm_sharded_crc(
                             [ln.device for ln in lanes], Bs,
                             _MXU_BLOCK, kind)
-                    self.stats["warmup_compiled"] += 1
+                    # counted under the engine lock: this is the one
+                    # stats write NOT on the dispatch thread (the
+                    # --races sweep flagged the bare += here)
+                    with self._lock:
+                        self.stats["warmup_compiled"] += 1
                 except Exception:
                     # a failing compile must never kill warmup; the
                     # bucket simply stays CPU-routed

@@ -1,12 +1,14 @@
 #!/usr/bin/env bash
 # Concurrency + invariant gate (ANALYSIS.md): the AST project lint over
-# the whole package, then the lockdep-enabled stress pass (engine
-# pipeline + txn commit/abort + a fast chaos storm) asserting a clean
-# lock-order graph.  Exits nonzero on ANY finding — invoked at the top
-# of scripts/tier1.sh and scripts/chaos.sh; run it alone after touching
-# anything concurrent.  Deeper sweep: pytest --lockdep runs the whole
-# suite under instrumented locks.
+# the whole package, the lockdep-enabled stress pass (engine pipeline +
+# txn commit/abort + chaos storms) asserting a clean lock-order graph,
+# and the lockset races pass (the same legs under the Eraser-style
+# detector, plus seeded schedule-explorer reruns of the engine-pipeline
+# and txn legs).  Exits nonzero on ANY finding — invoked at the top of
+# scripts/tier1.sh and scripts/chaos.sh; run it alone after touching
+# anything concurrent.  Deeper sweeps: pytest --lockdep / --races run
+# the whole suite under the instrumented locks / lockset detector.
 cd "$(dirname "$0")/.."
 set -o pipefail
-timeout -k 10 300 env JAX_PLATFORMS=cpu \
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python -m librdkafka_tpu.analysis all

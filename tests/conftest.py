@@ -38,6 +38,15 @@ def pytest_addoption(parser):
              "summary reports AB/BA inversions, cycles and locks held "
              "across blocking calls, and a finding fails the run "
              "(exit 3). See ANALYSIS.md.")
+    parser.addoption(
+        "--races", action="store_true", default=False,
+        help="run the whole suite under the Eraser-style lockset "
+             "data-race detector (analysis/races.py; implies "
+             "instrumented locks — locksets come from the lockdep "
+             "held-stack): every declared shared-field access refines "
+             "its candidate lockset, and an empty-lockset write fails "
+             "the run (exit 3) with both access stacks. See "
+             "ANALYSIS.md.")
 
 
 def pytest_configure(config):
@@ -46,9 +55,21 @@ def pytest_configure(config):
         lockdep.reset()
         lockdep.enable()
         config._lockdep_session = True
+    if config.getoption("--races"):
+        from librdkafka_tpu.analysis import races
+        races.reset()
+        races.enable()
+        config._races_session = True
 
 
 def pytest_sessionfinish(session, exitstatus):
+    if getattr(session.config, "_races_session", False):
+        from librdkafka_tpu.analysis import races
+        races.disable()
+        rep = races.report()
+        print("\n" + races.format_report(rep))
+        if not races.clean(rep) and session.exitstatus == 0:
+            session.exitstatus = 3
     if not getattr(session.config, "_lockdep_session", False):
         return
     from librdkafka_tpu.analysis import lockdep

@@ -499,7 +499,11 @@ def test_engine_cost_model_routes_and_explores():
                           window=False).result(60).tolist() == want[:1]
         assert eng.stats["cpu_fallback_jobs"] >= 1
         g = eng.governor
-        assert g.dev_launch_s and g.cpu_ns_per_byte is not None
+        # model peeks via the locked snapshot (the lockset sweep
+        # convicts lock-free EWMA reads against the dispatch thread)
+        snap0 = g.snapshot()
+        assert snap0["dev_launch_ms"] and \
+            snap0["cpu_ns_per_byte"] is not None
         # the jax-CPU "device" launch costs ms; the native CPU provider
         # runs 2KB in µs — the model must route these groups to CPU now
         routed = 0
@@ -655,10 +659,12 @@ def test_engine_mesh_governor_explore_and_fanin_skip_bitexact():
         assert eng.submit(bufs[:1], "crc32c",
                           window=False).result(60).tolist() == want[:1]
         g = eng.governor
-        assert g.dev_launch_s and g.cpu_ns_per_byte is not None
-        # per-device EWMAs: >1 (device, bucket) key measured
-        assert len({d for (d, _b) in g.dev_launch_s}) >= 2, \
-            g.dev_launch_s
+        snap0 = g.snapshot()
+        assert snap0["dev_launch_ms"] and \
+            snap0["cpu_ns_per_byte"] is not None
+        # per-device EWMAs: >1 device measured (locked per-device view)
+        assert len([d for d in range(8)
+                    if g.device_launch_ms(d)]) >= 2
         # exploration provably flips some decisions over enough rounds
         for _ in range(2 * g.EXPLORE_EVERY):
             assert eng.submit(bufs, "crc32c",

@@ -18,6 +18,9 @@ class _FakeTp:
         self.topic = name
         self.partition = part
         self.fetch_in_flight = True
+        # the budget reads now snapshot under the toppar lock (ISSUE
+        # 10 fetchq-accounting fix), so the shell needs one
+        self.lock = threading.Lock()
         self.fetchq_bytes = qbytes
 
 

@@ -1,0 +1,647 @@
+"""ISSUE 10: Eraser-style lockset race detector (analysis/races.py) +
+seeded schedule explorer (analysis/interleave.py) + the shared-state
+lint rule.
+
+Covers: the lockset state machine (virgin/exclusive/shared/
+shared-modified, read-shared no-report, write race with both stacks,
+refinement by intersection), the zero-cost-when-disabled descriptor
+contract, the shared container wrappers, SchedFuzzer determinism
+(same seed => same replay_key => same per-thread interleaving trace),
+a planted race a plain run misses but a seeded schedule reproduces,
+lint pos/neg/pragma fixtures, the ``analysis.races`` conf knob, and
+regressions for the unguarded-access fixes the sweep surfaced
+(fetchq accounting, governor EWMAs, stats counters, flush flag).
+"""
+import sys
+import threading
+import time
+
+import pytest
+
+from librdkafka_tpu.analysis import interleave, lockdep, races
+from librdkafka_tpu.analysis.lint import lint_source
+from librdkafka_tpu.analysis.locks import new_lock
+from librdkafka_tpu.analysis.races import (
+    Guarded, shared, shared_counter, shared_dict, shared_list)
+
+
+# ---------------------------------------------------- fixture classes --
+class _Cell:
+    v = shared("t0130.cell.v")
+
+    def __init__(self):
+        self.v = 0
+
+
+class _RelaxedCell:
+    v = shared("t0130.relaxed.v", relaxed=True)
+
+    def __init__(self):
+        self.v = 0
+
+
+class _SlotCell:
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0
+
+
+races.register_slots(_SlotCell, "v", prefix="t0130.slot")
+
+
+class _Plant:
+    counter = shared("t0130.plant.counter")
+
+    def __init__(self):
+        self.counter = 0
+
+
+def _run_threads(*targets):
+    ths = [threading.Thread(target=fn, name=f"t0130-{i}", daemon=True)
+           for i, fn in enumerate(targets)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(30)
+    assert not any(t.is_alive() for t in ths)
+
+
+def _var(st, name):
+    vs = [v for v in st.vars.values() if v.var == name]
+    assert vs, f"variable {name} never tracked"
+    return vs[-1]
+
+
+# ------------------------------------------------- state machine unit --
+def test_disabled_marker_resolves_to_plain_attribute():
+    """The zero-cost contract: disabled, the class carries NO
+    descriptor — the attribute is a plain instance-dict slot."""
+    if races.enabled:
+        pytest.skip("detector enabled for this session (--races)")
+    assert "v" not in _Cell.__dict__
+    c = _Cell()
+    assert c.__dict__["v"] == 0
+    c.v += 1
+    assert c.v == 1
+    # slotted: the original member_descriptor is in place
+    assert not isinstance(_SlotCell.__dict__["v"], Guarded)
+
+
+def test_enable_installs_and_disable_restores():
+    races.enable()
+    try:
+        assert isinstance(_Cell.__dict__["v"], Guarded)
+        assert isinstance(_SlotCell.__dict__["v"], Guarded)
+        c = _Cell()
+        c.v = 7
+        s = _SlotCell()
+        s.v = 9
+        assert c.v == 7 and s.v == 9
+    finally:
+        races.disable()
+    if not races.enabled:
+        assert "v" not in _Cell.__dict__
+        assert not isinstance(_SlotCell.__dict__["v"], Guarded)
+    # values survive the uninstall (state lives in the instance)
+    assert c.v == 7 and s.v == 9
+
+
+def test_exclusive_single_thread_no_report():
+    races.enable()
+    try:
+        with races.scope() as st:
+            c = _Cell()
+            for _ in range(5):
+                c.v += 1
+            vs = _var(st, "t0130.cell.v")
+            assert vs.state == "exclusive"
+            assert races.clean()
+    finally:
+        races.disable()
+
+
+def test_read_shared_no_report():
+    """Owner initializes; a second thread only READS — the classic
+    read-shared pattern stays in 'shared' and never reports."""
+    races.enable()
+    try:
+        with races.scope() as st:
+            c = _Cell()
+            c.v = 41
+            out = []
+            _run_threads(lambda: out.append(c.v),
+                         lambda: out.append(c.v))
+            vs = _var(st, "t0130.cell.v")
+            assert vs.state == "shared"
+            assert races.clean()
+            assert out == [41, 41]
+    finally:
+        races.disable()
+
+
+def test_unguarded_write_race_reported_with_both_stacks():
+    races.enable()
+    try:
+        with races.scope() as st:
+            c = _Cell()
+
+            def a():
+                for _ in range(3):
+                    c.v += 1
+
+            def b():
+                for _ in range(3):
+                    c.v += 1
+
+            _run_threads(a, b)
+            vs = _var(st, "t0130.cell.v")
+            assert vs.state == "shared_modified"
+            rep = races.report()
+            assert not races.clean(rep)
+            r = [x for x in rep["races"]
+                 if x["var"] == "t0130.cell.v"][0]
+            assert r["kind"] == "empty_lockset_write"
+            # both access stacks: the racing write's and the other
+            # thread's first access
+            assert "test_0130_races" in r["stack"]
+            assert r["other_stacks"], r
+            assert any("test_0130_races" in o["stack"]
+                       for o in r["other_stacks"])
+            assert len(r["threads"]) >= 2
+    finally:
+        races.disable()
+
+
+def test_consistently_locked_writes_clean():
+    races.enable()
+    try:
+        with races.scope() as st:
+            lk = new_lock("t0130.lock")
+            c = _Cell()
+
+            def w():
+                for _ in range(10):
+                    with lk:
+                        c.v += 1
+
+            _run_threads(w, w)
+            vs = _var(st, "t0130.cell.v")
+            assert vs.state == "shared_modified"
+            assert vs.lockset, "candidate set must retain the lock"
+            assert races.clean()
+    finally:
+        races.disable()
+
+
+def test_refinement_by_intersection():
+    """A holds L1+L2, B holds only L2: C(v) refines to {L2} (no
+    report); a later write holding neither empties it -> report."""
+    races.enable()
+    try:
+        with races.scope():
+            l1, l2 = new_lock("t0130.l1"), new_lock("t0130.l2")
+            c = _Cell()
+
+            def a():
+                with l1:
+                    with l2:
+                        c.v += 1
+
+            def b():
+                with l2:
+                    c.v += 1
+
+            _run_threads(a, b)
+            assert races.clean(), races.report()["races"]
+
+            def naked():
+                c.v += 1
+
+            _run_threads(naked)
+            rep = races.report()
+            assert not races.clean(rep)
+            assert rep["races"][0]["var"] == "t0130.cell.v"
+    finally:
+        races.disable()
+
+
+def test_relaxed_reported_separately_never_fails():
+    races.enable()
+    try:
+        with races.scope():
+            c = _RelaxedCell()
+
+            def w():
+                for _ in range(3):
+                    c.v += 1
+
+            _run_threads(w, w)
+            rep = races.report()
+            assert races.clean(rep)          # relaxed never fails
+            assert any(r["var"] == "t0130.relaxed.v"
+                       for r in rep["relaxed_races"])
+    finally:
+        races.disable()
+
+
+def test_report_once_per_variable():
+    races.enable()
+    try:
+        with races.scope():
+            c = _Cell()
+
+            def w():
+                for _ in range(50):
+                    c.v += 1
+
+            _run_threads(w, w)
+            rep = races.report()
+            hits = [r for r in rep["races"]
+                    if r["var"] == "t0130.cell.v"]
+            assert len(hits) == 1
+    finally:
+        races.disable()
+
+
+# ----------------------------------------------------- containers -----
+def test_shared_containers_disabled_are_plain():
+    if races.enabled:
+        pytest.skip("detector enabled for this session (--races)")
+    assert type(shared_list("x")) is list
+    assert type(shared_dict("x")) is dict
+    c = shared_counter("x")
+    c.add(2)
+    assert c.value == 2
+
+
+def test_shared_list_append_race_and_locked_clean():
+    races.enable()
+    try:
+        with races.scope():
+            lst = shared_list("t0130.list")
+
+            def w():
+                for i in range(5):
+                    lst.append(i)
+
+            _run_threads(w, w)
+            rep = races.report()
+            assert any(r["var"] == "t0130.list" for r in rep["races"])
+        with races.scope():
+            lk = new_lock("t0130.list_lock")
+            lst2 = shared_list("t0130.list2")
+
+            def w2():
+                for i in range(5):
+                    with lk:
+                        lst2.append(i)
+
+            _run_threads(w2, w2)
+            assert races.clean(), races.report()["races"]
+    finally:
+        races.disable()
+
+
+def test_shared_dict_and_counter_record_writes():
+    races.enable()
+    try:
+        with races.scope():
+            d = shared_dict("t0130.dict")
+            cn = shared_counter("t0130.counter")
+
+            def w():
+                for i in range(5):
+                    d[i] = i
+                    cn.add()
+
+            _run_threads(w, w)
+            rep = races.report()
+            racy = {r["var"] for r in rep["races"]}
+            assert "t0130.dict" in racy and "t0130.counter" in racy
+            assert cn.value <= 10
+    finally:
+        races.disable()
+
+
+# ------------------------------------------------- schedule explorer --
+def _fuzz_workload(fz, name, n=200):
+    def body():
+        for _ in range(n):
+            fz.maybe_yield("p")
+    t = threading.Thread(target=body, name=name, daemon=True)
+    t.start()
+    t.join(30)
+    assert not t.is_alive()
+
+
+def test_schedfuzzer_determinism_same_seed_same_trace():
+    f1 = interleave.SchedFuzzer(1234, preemption_bound=20)
+    f2 = interleave.SchedFuzzer(1234, preemption_bound=20)
+    assert f1.replay_key() == f2.replay_key()
+    for fz in (f1, f2):
+        _fuzz_workload(fz, "fz-a")
+        _fuzz_workload(fz, "fz-b")
+    assert f1.trace_for("fz-a") == f2.trace_for("fz-a")
+    assert f1.trace_for("fz-b") == f2.trace_for("fz-b")
+    assert f1.trace_for("fz-a"), "no preemption ever fired"
+    # a different seed explores a different schedule
+    f3 = interleave.SchedFuzzer(99, preemption_bound=20)
+    assert f3.replay_key() != f1.replay_key()
+    _fuzz_workload(f3, "fz-a")
+    assert f3.trace_for("fz-a") != f1.trace_for("fz-a")
+    # from_key rebuilds the exact fuzzer (the replay contract)
+    f4 = interleave.SchedFuzzer.from_key(f1.replay_key())
+    _fuzz_workload(f4, "fz-a")
+    assert f4.trace_for("fz-a") == f1.trace_for("fz-a")
+
+
+def _plant_run(n, fuzzer=None):
+    """Two named threads each += 1 the planted counter n times.
+    Returns (final value, races_report)."""
+    with races.scope():
+        p = _Plant()
+        ev = threading.Event()
+
+        def body():
+            ev.wait(5)
+            for _ in range(n):
+                p.counter += 1
+
+        ths = [threading.Thread(target=body, name=f"plant-{c}",
+                                daemon=True) for c in "ab"]
+        for t in ths:
+            t.start()
+        if fuzzer is not None:
+            interleave.install(fuzzer)
+        try:
+            ev.set()
+            for t in ths:
+                t.join(60)
+        finally:
+            interleave.uninstall()
+        assert not any(t.is_alive() for t in ths)
+        return p.counter, races.report()
+
+
+def test_planted_race_detected_by_lockset_and_schedule():
+    """The acceptance shape: a straight (plain-scheduler) run leaves
+    the planted lost-update latent — the value stays correct — but the
+    lockset detector still convicts it; a seeded schedule makes the
+    SAME bug manifest as an actually-wrong value, deterministically
+    replayable via its replay_key."""
+    n = 400
+    races.enable()
+    try:
+        # plain run: raise the GIL switch interval so the scheduler
+        # cannot preempt mid-RMW — the bug stays latent
+        old_si = sys.getswitchinterval()
+        sys.setswitchinterval(5.0)
+        try:
+            val, rep = _plant_run(n)
+        finally:
+            sys.setswitchinterval(old_si)
+        assert val == 2 * n, "plain run was supposed to miss the race"
+        assert any(r["var"] == "t0130.plant.counter"
+                   for r in rep["races"]), \
+            "lockset detector must convict the latent race"
+
+        # seeded schedule: preemptions inside the get->set window make
+        # the lost update real, twice, with one replay_key
+        results = []
+        for _ in range(2):
+            fz = interleave.SchedFuzzer(7, preemption_bound=80, p=0.2)
+            val, rep = _plant_run(n, fuzzer=fz)
+            assert any(r["var"] == "t0130.plant.counter"
+                       for r in rep["races"])
+            results.append((val, fz.replay_key(),
+                            fz.trace_for("plant-a")))
+        (v1, k1, tr1), (v2, k2, tr2) = results
+        assert v1 < 2 * n and v2 < 2 * n, \
+            f"seeded schedule failed to reproduce the lost update " \
+            f"({v1}, {v2} vs {2*n})"
+        assert k1 == k2, "same seed must give the same replay_key"
+        assert tr1 == tr2, "same seed must give the same per-thread trace"
+    finally:
+        races.disable()
+
+
+def test_yield_points_quiescent_without_fuzzer():
+    assert not interleave.active
+    interleave.maybe_yield("nothing-installed")   # must be a no-op
+
+
+# ------------------------------------------------------- lint rule ----
+_LINT_POS = '''
+from ..analysis.locks import new_lock
+
+class Racy:
+    def __init__(self):
+        self._lock = new_lock("x.y")
+        self.table = {}
+'''
+
+_LINT_NEG = '''
+from ..analysis.locks import new_lock
+from ..analysis.races import shared
+
+class Fine:
+    table = shared("x.table")
+
+    def __init__(self):
+        self._lock = new_lock("x.y")
+        self.table = {}
+'''
+
+_LINT_SLOTS = '''
+from ..analysis.races import register_slots
+import threading
+
+class SlotFine:
+    __slots__ = ("q",)
+    def __init__(self):
+        self.t = threading.Thread(target=None, name="x")
+
+register_slots(SlotFine, "q")
+'''
+
+_LINT_PRAGMA = '''
+from ..analysis.locks import new_lock
+
+class Judged:  # lint: ok shared-state
+    """why: no mutable state outlives __init__."""
+    def __init__(self):
+        self._lock = new_lock("x.y")
+'''
+
+
+def test_lint_shared_state_positive():
+    fs = lint_source(_LINT_POS, "client/fake.py")
+    assert any(f.rule == "shared-state" and "Racy" in f.msg
+               for f in fs), fs
+
+
+def test_lint_shared_state_negative_decl_and_slots():
+    assert not [f for f in lint_source(_LINT_NEG, "client/fake.py")
+                if f.rule == "shared-state"]
+    assert not [f for f in lint_source(_LINT_SLOTS, "mock/fake.py")
+                if f.rule == "shared-state"]
+
+
+def test_lint_shared_state_pragma_and_scope():
+    assert not [f for f in lint_source(_LINT_PRAGMA, "client/fake.py")
+                if f.rule == "shared-state"]
+    # out of the lockdep-scoped layers: no finding
+    assert not [f for f in lint_source(_LINT_POS, "obs/fake.py")
+                if f.rule == "shared-state"]
+
+
+def test_lint_package_clean():
+    from librdkafka_tpu.analysis.lint import lint_package
+    assert [str(f) for f in lint_package()] == []
+
+
+# ------------------------------------------------ conf knob + e2e -----
+def test_conf_knob_roundtrip():
+    from librdkafka_tpu import Producer
+    was = races.enabled
+    with races.scope():
+        p = Producer({"bootstrap.servers": "",
+                      "test.mock.num.brokers": 1,
+                      "analysis.races": True, "linger.ms": 1})
+        try:
+            assert races.enabled
+            assert lockdep.enabled, "races implies lockdep"
+            p.produce("races-knob", value=b"x", partition=0)
+            assert p.flush(30) == 0
+        finally:
+            p.close()
+        assert races.enabled == was
+        rep = races.report()
+        assert rep["accesses"] > 0
+        assert races.clean(rep), rep["races"]
+
+
+def test_e2e_produce_consume_sweep_clean():
+    """Regression for every ISSUE-10 unguarded-access fix at once
+    (fetchq accounting under kafka.toppar, stats counters under
+    stats.counters, flush flag under kafka.msg_cnt, engine warmup
+    bump, OpQueue wakeup publish): a ticketed produce + CRC-checked
+    consume under the detector must end with zero strict findings."""
+    from librdkafka_tpu import Consumer, Producer
+    with races.scope():
+        races.enable()
+        c = None
+        try:
+            p = Producer({"bootstrap.servers": "",
+                          "test.mock.num.brokers": 1,
+                          "compression.backend": "tpu",
+                          "tpu.transport.min.mb.s": 0,
+                          "tpu.launch.min.batches": 2,
+                          "tpu.governor": False, "tpu.warmup": False,
+                          "compression.codec": "lz4", "linger.ms": 5,
+                          "statistics.interval.ms": 100})
+            try:
+                bs = p._rk.mock_cluster.bootstrap_servers()
+                for i in range(120):
+                    p.produce("races-e2e", value=b"v%d" % i * 10,
+                              partition=i % 4)
+                assert p.flush(60) == 0
+                stats_blob = p._rk.stats.emit_json()
+                c = Consumer({"bootstrap.servers": bs,
+                              "group.id": "races-e2e",
+                              "auto.offset.reset": "earliest",
+                              "check.crcs": True})
+                c.subscribe(["races-e2e"])
+                got = 0
+                deadline = time.monotonic() + 45
+                while got < 120 and time.monotonic() < deadline:
+                    m = c.poll(0.2)
+                    if m is not None and m.error is None:
+                        got += 1
+                assert got == 120
+            finally:
+                p.close()
+                if c is not None:
+                    c.close()
+            rep = races.report()
+            assert races.clean(rep), races.format_report(rep)
+            # the resurrected txmsgs counter (the sweep also found it
+            # was never bumped): acked count lands in the stats blob
+            import json
+            assert json.loads(stats_blob)["txmsgs"] == 120
+        finally:
+            races.disable()
+
+
+def test_governor_ewma_lock_regression():
+    """The flagship sweep finding: governor EWMAs are RMW'd from the
+    dispatch thread while the stats emitter snapshots — all under
+    engine.governor now; concurrent hammering must stay clean."""
+    from librdkafka_tpu.ops.engine import _Governor
+    with races.scope():
+        races.enable()
+        try:
+            g = _Governor(True, 0.0005)
+            stop = threading.Event()
+
+            def model():
+                i = 0
+                while not stop.is_set() and i < 3000:
+                    g.note_cpu(1000, 0.0001)
+                    g.note_device(128, 0.0002, dev=i % 2)
+                    g.route(128, 4096)
+                    g.note_submit(time.monotonic())
+                    i += 1
+
+            def reader():
+                for _ in range(300):
+                    g.snapshot()
+                    g.device_launch_ms(0)
+                stop.set()
+
+            _run_threads(model, reader)
+            rep = races.report()
+            assert races.clean(rep), races.format_report(rep)
+            snap = g.snapshot()
+            assert snap["cpu_ns_per_byte"] is not None
+        finally:
+            races.disable()
+
+
+def test_fetchq_accounting_exact_under_contention():
+    """Direct regression for the fetchq_cnt/fetchq_bytes lost-update:
+    concurrent locked increments and clamped decrements must land on
+    the exact expected value (the old bare RMW lost updates)."""
+    from librdkafka_tpu.client.partition import Toppar
+    tp = Toppar("t", 0)
+    n = 2000
+
+    def enq():
+        for _ in range(n):
+            with tp.lock:
+                tp.fetchq_cnt += 1
+                tp.fetchq_bytes += 10
+
+    def drain():
+        done = 0
+        while done < n:
+            with tp.lock:
+                if tp.fetchq_cnt > 0:
+                    fc = tp.fetchq_cnt - 1
+                    tp.fetchq_cnt = fc if fc > 0 else 0
+                    fb = tp.fetchq_bytes - 10
+                    tp.fetchq_bytes = fb if fb > 0 else 0
+                    done += 1
+
+    _run_threads(enq, drain)
+    assert tp.fetchq_cnt == 0 and tp.fetchq_bytes == 0
+
+
+def test_races_cli_sweep_shape():
+    """python -m librdkafka_tpu.analysis races wiring: the module
+    resolves the command and the runner exposes the schedule seeds."""
+    from librdkafka_tpu.analysis import __main__ as cli
+    from librdkafka_tpu.analysis import stress
+    assert cli.main(["bogus"]) == 2
+    assert len(stress.SCHEDULE_SEEDS) >= 2
