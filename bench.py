@@ -1231,6 +1231,53 @@ def chaos_bench() -> dict:
             "legs": legs}
 
 
+def fleet_bench(smoke: bool = False) -> dict:
+    """bench.py --fleet: the multi-process fleet leg (ISSUE 11).
+
+    Full mode runs the FLAGSHIP fleet storm — ≥24 real client OS
+    processes under diurnal+burst traffic with hot-key/hot-partition
+    skew against the supervised 3-broker cluster, sustaining 3
+    pid-verified SIGKILLs, an asymmetric brownout and an EIO window —
+    and surfaces the fleet aggregate at artifact top level:
+    ``fleet_msgs_s``, per-client produce->ack p99 (max + median),
+    ``storm_kills``, and post-kill ``recovery_p50/p99_ms``.
+
+    ``--fleet --smoke`` runs the 2-worker mini fleet instead (<20 s):
+    same machinery — spawn, stream-merge, per-group verify — at the
+    smallest honest scale, the pre-commit shape."""
+    from librdkafka_tpu.chaos.oracle import OracleViolation
+    from librdkafka_tpu.fleet.scenarios import fleet_mini, fleet_storm
+
+    t0 = time.perf_counter()
+    try:
+        report = fleet_mini() if smoke else fleet_storm()
+        ok = (report["ok"] and not report["errors"]
+              and not report["schedule_errors"])
+    except (OracleViolation, Exception) as e:  # noqa: B014
+        return {"ok": False, "error": repr(e),
+                "wall_s": round(time.perf_counter() - t0, 2)}
+    fm = report.get("fleet_metrics") or {}
+    sm = report.get("storm_metrics") or {}
+    rec = sm.get("recovery_ms") or {}
+    return {
+        "ok": ok,
+        "leg": "fleet_mini" if smoke else "fleet_storm",
+        "workers": report.get("workers"),
+        "fleet_msgs_s": fm.get("fleet_msgs_s"),
+        "client_p99_ms_max": fm.get("client_p99_ms_max"),
+        "client_p99_ms_median": fm.get("client_p99_ms_median"),
+        "client_p99_ms": fm.get("client_p99_ms"),
+        "storm_kills": sm.get("kills", 0),
+        "recovery_p50_ms": rec.get("p50"),
+        "recovery_p99_ms": rec.get("p99"),
+        "acked": report.get("acked"),
+        "consumed_by_group": report.get("consumed_by_group"),
+        "converged_s": report.get("converged_s"),
+        "replay_key": report.get("replay_key"),
+        "wall_s": round(time.perf_counter() - t0, 2),
+    }
+
+
 def smoke_bench() -> dict:
     """bench.py --smoke (<60 s): one bit-exactness pass over every
     engine leg — sync provider, pipelined engine, fetch pipeline,
@@ -1616,6 +1663,13 @@ def main():
                          "with a clean delivery-invariant oracle "
                          "verdict (bench.py --chaos)",
                **chaos_bench()})
+        return
+    if "--fleet" in sys.argv:
+        _emit({"metric": "multi-process client fleet: aggregate "
+                         "msgs/s, per-client p99, recovery envelopes "
+                         "under SIGKILL+brownout+EIO (bench.py "
+                         "--fleet)",
+               **fleet_bench(smoke="--smoke" in sys.argv)})
         return
     if "--governor" in sys.argv:
         _emit({"metric": "adaptive offload governor: warmup "

@@ -18,15 +18,16 @@ Rules (ids are stable; suppress a line with ``# lint: ok <rule>``):
                    contract of PR 5
   bare-except      no ``except:`` — it eats KeyboardInterrupt/
                    SystemExit and hides real faults in thread loops
-  chaos-random     chaos/ randomness comes only from the schedule's
-                   seeded ``random.Random`` — module-level random
-                   breaks same-seed replay (CHAOS.md)
+  chaos-random     chaos/ and fleet/ randomness comes only from a
+                   seeded ``random.Random`` (schedule or traffic
+                   plan) — module-level random breaks same-seed
+                   replay (CHAOS.md, FLEET.md)
   thread-name      every thread is named so the conftest leak fixture
                    can claim it (engine/sockem/chaos-sched matching)
   manual-acquire   no manual ``.acquire()`` — a raise between acquire
                    and release leaks the lock forever; use ``with``
   lock-factory     lock sites in client/, ops/engine.py, ops/tpu.py,
-                   mock/ and chaos/ create primitives through
+                   mock/, chaos/ and fleet/ create primitives through
                    analysis.locks so lockdep can instrument them
   shared-state     classes in the same scoped layers that start
                    threads or create factory locks must declare their
@@ -56,8 +57,13 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition"}
 
 #: paths (relative to the package root, / separators) under the
 #: lock-factory rule — the layers lockdep instruments
-_FACTORY_SCOPE = ("client/", "mock/", "chaos/", "ops/engine.py",
+_FACTORY_SCOPE = ("client/", "mock/", "chaos/", "fleet/", "ops/engine.py",
                   "ops/tpu.py")
+
+#: layers whose randomness must come from a seeded Random (the
+#: replay-from-seed contract: CHAOS.md for schedules, FLEET.md for
+#: traffic plans and worker sampling)
+_SEEDED_RANDOM_SCOPE = ("chaos/", "fleet/")
 
 #: calls that count as a shared-state declaration (analysis/races.py)
 _SHARED_DECLS = {"shared", "shared_dict", "shared_list",
@@ -253,16 +259,16 @@ class _Visitor(ast.NodeVisitor):
                       f"trace hook {f.value.id}.{f.attr}() outside an "
                       f"`if {f.value.id}.enabled:` guard (PR 5 "
                       "overhead contract)")
-        # chaos-random: module-level random in chaos/
-        if (self.relpath.startswith("chaos/")
+        # chaos-random: module-level random in chaos/ or fleet/
+        if (self.relpath.startswith(_SEEDED_RANDOM_SCOPE)
                 and isinstance(f, ast.Attribute)
                 and isinstance(f.value, ast.Name)
                 and f.value.id == "random" and f.attr != "Random"
                 and not _exempt("chaos-random", self.relpath)):
             self._add(node, "chaos-random",
-                      f"random.{f.attr}() in chaos/ — draw from the "
-                      "schedule's seeded Random so replay_key replays "
-                      "(CHAOS.md)")
+                      f"random.{f.attr}() in {self.relpath.split('/')[0]}/ "
+                      "— draw from the schedule's/plan's seeded Random "
+                      "so replay_key replays (CHAOS.md, FLEET.md)")
         # thread-name: threading.Thread(...) without name=
         if (isinstance(f, ast.Attribute) and f.attr in ("Thread", "Timer")
                 and isinstance(f.value, ast.Name)

@@ -116,6 +116,21 @@ def _external_storm_leg() -> None:
         f"external leg: SIGKILL not pid-verified: {pids}"
 
 
+def _fleet_leg() -> None:
+    """ISSUE 11: the tier-1 fleet smoke — 4 real client OS processes
+    under burst traffic and a pid-verified SIGKILL while the driver's
+    reader threads merge worker ledgers into the per-group oracles:
+    the fleet.driver lock, oracle ledgers, handle control plane and
+    scheduler all interleave under the instrumented locks."""
+    from ..fleet.scenarios import fleet_smoke
+
+    res = fleet_smoke(seed=51)
+    assert res.get("ok", True), f"fleet leg violated delivery: {res}"
+    pids = res.get("pids_killed", [])
+    assert pids and all(e["verified_dead"] for e in pids), \
+        f"fleet leg: SIGKILL not pid-verified: {pids}"
+
+
 def run_stress() -> dict:
     """All four legs under one enabled window; returns the lockdep
     report (``lockdep.clean(report)`` is the pass predicate)."""
@@ -126,6 +141,7 @@ def run_stress() -> dict:
         _txn_leg()
         _chaos_leg()
         _external_storm_leg()
+        _fleet_leg()
     finally:
         lockdep.disable()
     return lockdep.report()
@@ -144,6 +160,7 @@ def run_races(seeds=SCHEDULE_SEEDS) -> tuple:
         _engine_pipeline_leg()
         _txn_leg()
         _chaos_leg()
+        _fleet_leg()
         for seed in seeds:
             fz = interleave.SchedFuzzer(seed)
             keys.append(fz.replay_key())
@@ -163,7 +180,7 @@ def races_main() -> int:
     rep, keys = run_races()
     print(races.format_report(rep))
     print(f"races: lockset sweep (engine pipeline + txn + fast chaos "
-          f"storm) + {len(keys)} seeded schedules "
+          f"storm + fleet smoke) + {len(keys)} seeded schedules "
           f"{[k for k in keys]} in {time.perf_counter() - t0:.1f}s")
     return 0 if races.clean(rep) else 1
 
@@ -173,7 +190,7 @@ def main() -> int:
     rep = run_stress()
     print(lockdep.format_report(rep))
     print(f"stress: engine pipeline + txn commit/abort + fast chaos "
-          f"storm + external SIGKILL storm "
+          f"storm + external SIGKILL storm + fleet smoke "
           f"in {time.perf_counter() - t0:.1f}s")
     return 0 if lockdep.clean(rep) else 1
 

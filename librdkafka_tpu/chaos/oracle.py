@@ -107,10 +107,39 @@ class DeliveryOracle:
 
     def record_ack(self, topic: str, partition: int, offset: int,
                    key: Optional[bytes], value: Optional[bytes],
-                   txn: Optional[str] = None) -> None:
+                   txn: Optional[str] = None,
+                   ts: Optional[float] = None) -> None:
+        """``ts``: the ack's ``time.monotonic()`` stamp.  In-process
+        callers omit it (stamped on arrival); the fleet driver passes
+        the WORKER's stamp so recovery envelopes measure the client's
+        clock, not the merge pipeline's batching latency."""
         with self._lock:
             self.acked.append((topic, partition, offset, key, value, txn))
-            self.acked_ts.append(time.monotonic())
+            self.acked_ts.append(time.monotonic() if ts is None else ts)
+
+    def record_failed(self, topic: str, partition: int,
+                      value, txn: Optional[str], err: str) -> None:
+        with self._lock:
+            self.failed.append((topic, partition, value, txn, err))
+
+    # ------------------------------------------- fleet ledger merge --
+    def record_acks(self, rows) -> None:
+        """Bulk merge of a fleet worker's streamed ack ledger: rows of
+        ``(topic, partition, offset, key, value, txn, ts)`` land under
+        one lock acquisition (hundreds of workers stream batches; a
+        per-row lock would make the merge the bottleneck)."""
+        with self._lock:
+            for topic, partition, offset, key, value, txn, ts in rows:
+                self.acked.append((topic, partition, offset, key, value,
+                                   txn))
+                self.acked_ts.append(ts)
+
+    def record_consumed_rows(self, rows) -> None:
+        """Bulk merge of consumed rows ``(topic, partition, offset,
+        value)`` — the consumer-side half of ``record_acks``."""
+        with self._lock:
+            for topic, partition, offset, value in rows:
+                self.consumed.append((topic, partition, offset, value))
 
     def begin_txn(self, txn: str) -> None:
         with self._lock:

@@ -40,6 +40,46 @@ def _pct(vals: list, q: float) -> Optional[float]:
     return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))], 1)
 
 
+def storm_metrics(timeline: list[dict], acked_ts: list[float]
+                  ) -> Optional[dict]:
+    """Robustness-as-numbers over any fault timeline + ack-stamp
+    stream: throughput sustained inside the fault window and
+    time-to-first-ack after each kill (the recovery envelope).  Shared
+    by Storm (one in-process client) and the fleet driver (many worker
+    processes, whose merged stamps arrive unsorted — sorted here)."""
+    fired = [e for e in timeline
+             if (e.get("resolved") or {}).get("broker") is not None
+             and "mono" in e]
+    if not fired:
+        return None
+    acked_ts = sorted(acked_ts)
+    t0, t1 = fired[0]["mono"], fired[-1]["mono"]
+    window = max(t1 - t0, 1e-3)
+    in_window = sum(1 for t in acked_ts if t0 <= t <= t1)
+    recovery, unrecovered = [], 0
+    kills = [e["mono"] for e in fired
+             if e["action"] in ("broker_kill", "proc_kill9")]
+    for k in kills:
+        nxt = next((t for t in acked_ts if t > k), None)
+        if nxt is None:
+            unrecovered += 1
+        else:
+            recovery.append((nxt - k) * 1000.0)
+    return {
+        "storm_window_s": round(window, 2),
+        "storm_acks": in_window,
+        "storm_msgs_s": round(in_window / window, 1),
+        "kills": len(kills),
+        "recovery_ms": {
+            "per_kill": [round(r, 1) for r in recovery],
+            "p50": _pct(recovery, 0.50),
+            "p99": _pct(recovery, 0.99),
+            "max": _pct(recovery, 1.0),
+            "unrecovered": unrecovered,
+        },
+    }
+
+
 # ---------------------------------------------------------------- storm --
 class Storm:  # lint: ok shared-state
     """One storm run: cluster (in-process MockCluster or external
@@ -256,41 +296,11 @@ class Storm:  # lint: ok shared-state
 
     # -- metrics ----------------------------------------------------------
     def _storm_metrics(self, timeline: list[dict]) -> Optional[dict]:
-        """Robustness-as-numbers (BENCH_r* trajectory): throughput
-        sustained while faults fired, and time-to-first-ack after each
-        process/broker kill — the client's measured recovery latency."""
-        fired = [e for e in timeline
-                 if (e.get("resolved") or {}).get("broker") is not None
-                 and "mono" in e]
-        if not fired:
-            return None
+        """Robustness-as-numbers (BENCH_r* trajectory) — the shared
+        ``storm_metrics`` over this storm's oracle ack stamps."""
         with self.oracle._lock:
             acked_ts = list(self.oracle.acked_ts)
-        t0, t1 = fired[0]["mono"], fired[-1]["mono"]
-        window = max(t1 - t0, 1e-3)
-        in_window = sum(1 for t in acked_ts if t0 <= t <= t1)
-        recovery, unrecovered = [], 0
-        kills = [e["mono"] for e in fired
-                 if e["action"] in ("broker_kill", "proc_kill9")]
-        for k in kills:
-            nxt = next((t for t in acked_ts if t > k), None)
-            if nxt is None:
-                unrecovered += 1
-            else:
-                recovery.append((nxt - k) * 1000.0)
-        return {
-            "storm_window_s": round(window, 2),
-            "storm_acks": in_window,
-            "storm_msgs_s": round(in_window / window, 1),
-            "kills": len(kills),
-            "recovery_ms": {
-                "per_kill": [round(r, 1) for r in recovery],
-                "p50": _pct(recovery, 0.50),
-                "p99": _pct(recovery, 0.99),
-                "max": _pct(recovery, 1.0),
-                "unrecovered": unrecovered,
-            },
-        }
+        return storm_metrics(timeline, acked_ts)
 
     # -- run --------------------------------------------------------------
     def run(self, schedule: Schedule, *, tamper: Optional[Callable] = None,

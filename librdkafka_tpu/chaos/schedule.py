@@ -215,6 +215,200 @@ class _ProcCont(Action):
             ctx.paused.remove(b)
 
 
+def _resolve_broker(ctx, rng, target, *, busy=(), floor: bool = True):
+    """Shared target grammar for the environment-fault verbs (the
+    _BrokerKill grammar): int passthrough, ``"any"`` rng-drawn from
+    the responsive pool, ``"controller"``, ``"coordinator:<key>"``,
+    ``"leader:t:p"``.  The responsive pool excludes every degraded
+    broker — paused, in an EIO window, browned, plus the verb's own
+    ``busy`` list — and ``floor`` applies the min_alive quorum rule
+    to "any": the pool AFTER this fault must stay above it (two
+    different env faults may not jointly freeze the quorum)."""
+    degraded = (set(ctx.paused) | set(ctx.eio) | set(ctx.browned)
+                | set(busy))
+    responsive = [b for b in ctx.cluster.alive_brokers()
+                  if b not in degraded]
+    if isinstance(target, int):
+        return {"broker": target}
+    if target == "any":
+        if floor and len(responsive) <= ctx.min_alive:
+            return {"broker": None, "skipped": "min_alive"}
+        if not responsive:
+            return {"broker": None, "skipped": "none_responsive"}
+        return {"broker": rng.choice(sorted(responsive))}
+    if target == "controller":
+        return {"broker": ctx.cluster.controller_id}
+    if target.startswith("coordinator:"):
+        return {"broker":
+                ctx.cluster.coordinator_for(target.split(":", 1)[1])}
+    if target.startswith("leader:"):
+        _, topic, part = target.split(":")
+        return {"broker": ctx.cluster.partition(topic, int(part)).leader}
+    raise ValueError(f"env verb target {target!r}")
+
+
+class _EnvEio(Action):
+    """Disk-full/EIO window on the storage plane: Produce on the
+    target broker returns KAFKA_STORAGE_ERROR (retriable — exactly a
+    real broker's failed-log-dir reaction) until env_eio_clear or
+    heal().  An EIO'd broker cannot accept writes, so it counts
+    against the quorum floor like a paused one."""
+
+    name = "env_eio"
+
+    def __init__(self, target: int | str = "any"):
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        r = _resolve_broker(ctx, rng, self.target, busy=ctx.eio)
+        b = r.get("broker")
+        if b is not None and (b in ctx.eio or b in ctx.killed):
+            return {"broker": None, "skipped": "unavailable"}
+        return r
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.set_storage_error(b, True)
+        ctx.eio.append(b)
+
+
+class _EnvEioClear(Action):
+    """Heal an EIO window; ``"eio"`` heals in fault order (FIFO)."""
+
+    name = "env_eio_clear"
+
+    def __init__(self, target: int | str = "eio"):
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        if isinstance(self.target, int):
+            return {"broker": self.target}
+        if not ctx.eio:
+            return {"broker": None, "skipped": "none_eio"}
+        return {"broker": ctx.eio[0]}
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.set_storage_error(b, False)
+        if b in ctx.eio:
+            ctx.eio.remove(b)
+
+
+class _EnvSkew(Action):
+    """Clock-skew fault: the target broker's wall clock reads
+    ``skew_ms`` off true.  No quorum impact (a skewed broker still
+    serves); heal() restores every clock."""
+
+    name = "env_skew"
+
+    def __init__(self, skew_ms: float, target: int | str = "any"):
+        self.skew_ms = skew_ms
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        r = _resolve_broker(ctx, rng, self.target,
+                            busy=[b for b, _s in ctx.skewed], floor=False)
+        if r.get("broker") is not None:
+            r["skew_ms"] = self.skew_ms
+        return r
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.set_clock_skew(b, self.skew_ms)
+        ctx.skewed.append((b, self.skew_ms))
+
+
+class _EnvRlimit(Action):
+    """Memory pressure: soft RLIMIT_AS on the target broker's relay
+    OS process (out-of-process tier only — the in-process mock has no
+    per-broker process, so applying there records a schedule error).
+    ``nbytes=0`` would be a heal; heal() restores infinity."""
+
+    name = "env_rlimit"
+
+    def __init__(self, nbytes: int, target: int | str = "any"):
+        self.nbytes = nbytes
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        r = _resolve_broker(ctx, rng, self.target, busy=ctx.rlimited,
+                            floor=False)
+        if r.get("broker") is not None:
+            r["rlim_bytes"] = self.nbytes
+        return r
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.set_rlimit(b, self.nbytes)
+        ctx.rlimited.append(b)
+
+
+class _EnvBrownout(Action):
+    """Asymmetric-partition brownout: one-direction drop/latency on
+    the target broker's relay (ClusterHandle.brownout — the
+    out-of-process sockem rx_drop/tx_drop analog).  A browned broker
+    may be unable to serve (full one-direction drop), so it counts
+    against the quorum floor."""
+
+    name = "env_brownout"
+
+    def __init__(self, target: int | str = "any", *,
+                 rx_drop: bool = False, tx_drop: bool = False,
+                 rx_delay_ms: float = 0.0, tx_delay_ms: float = 0.0):
+        self.target = target
+        self.knobs = {"rx_drop": rx_drop, "tx_drop": tx_drop,
+                      "rx_delay_ms": rx_delay_ms,
+                      "tx_delay_ms": tx_delay_ms}
+
+    def resolve(self, ctx, rng):
+        r = _resolve_broker(ctx, rng, self.target, busy=ctx.browned)
+        b = r.get("broker")
+        if b is not None and (b in ctx.browned or b in ctx.killed):
+            return {"broker": None, "skipped": "unavailable"}
+        if b is not None:
+            r.update(self.knobs)
+        return r
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.brownout(b, **self.knobs)
+        ctx.browned.append(b)
+
+
+class _EnvBrownoutClear(Action):
+    """End a brownout; ``"browned"`` clears in fault order (FIFO)."""
+
+    name = "env_brownout_clear"
+
+    def __init__(self, target: int | str = "browned"):
+        self.target = target
+
+    def resolve(self, ctx, rng):
+        if isinstance(self.target, int):
+            return {"broker": self.target}
+        if not ctx.browned:
+            return {"broker": None, "skipped": "none_browned"}
+        return {"broker": ctx.browned[0]}
+
+    def apply(self, ctx, resolved):
+        b = resolved.get("broker")
+        if b is None:
+            return
+        ctx.cluster.clear_brownout(b)
+        if b in ctx.browned:
+            ctx.browned.remove(b)
+
+
 class _LeaderMigrate(Action):
     name = "leader_migrate"
 
@@ -326,6 +520,30 @@ def proc_cont(target: int | str = "paused") -> Action:
     return _ProcCont(target)
 
 
+def env_eio(target: int | str = "any") -> Action:
+    return _EnvEio(target)
+
+
+def env_eio_clear(target: int | str = "eio") -> Action:
+    return _EnvEioClear(target)
+
+
+def env_skew(skew_ms: float, target: int | str = "any") -> Action:
+    return _EnvSkew(skew_ms, target)
+
+
+def env_rlimit(nbytes: int, target: int | str = "any") -> Action:
+    return _EnvRlimit(nbytes, target)
+
+
+def env_brownout(target: int | str = "any", **knobs) -> Action:
+    return _EnvBrownout(target, **knobs)
+
+
+def env_brownout_clear(target: int | str = "browned") -> Action:
+    return _EnvBrownoutClear(target)
+
+
 def leader_migrate(topic: str, partition: int | str = "any",
                    to: int | str = "any_other") -> Action:
     return _LeaderMigrate(topic, partition, to)
@@ -395,6 +613,14 @@ class ChaosContext:
     killed: list = field(default_factory=list)
     #: brokers currently SIGSTOPped, in pause order (proc_cont FIFO)
     paused: list = field(default_factory=list)
+    #: brokers in an EIO/disk-full window (env_eio_clear FIFO)
+    eio: list = field(default_factory=list)
+    #: (broker, skew_ms) clock-skew faults in effect
+    skewed: list = field(default_factory=list)
+    #: brokers whose relay carries a lowered RLIMIT_AS
+    rlimited: list = field(default_factory=list)
+    #: brokers under an asymmetric brownout (env_brownout_clear FIFO)
+    browned: list = field(default_factory=list)
 
 
 class ChaosScheduler:  # lint: ok shared-state
@@ -484,14 +710,27 @@ class ChaosScheduler:  # lint: ok shared-state
     def heal(self) -> None:
         """Restore a healthy cluster after the storm: thaw every
         paused broker, restart every broker the schedule left down,
-        and clear sockem shaping — the drain phase must measure
-        delivery, not leftover faults."""
+        clear sockem shaping, and lift every environment fault (EIO
+        windows, clock skew, rlimits, brownouts) — the drain phase
+        must measure delivery, not leftover faults."""
         for b in list(self.ctx.paused):
             self.ctx.cluster.resume_broker(b)
             self.ctx.paused.remove(b)
         for b in list(self.ctx.killed):
             self.ctx.cluster.restart_broker(b)
             self.ctx.killed.remove(b)
+        for b in list(self.ctx.eio):
+            self.ctx.cluster.set_storage_error(b, False)
+            self.ctx.eio.remove(b)
+        for b, _skew in list(self.ctx.skewed):
+            self.ctx.cluster.set_clock_skew(b, 0.0)
+            self.ctx.skewed.remove((b, _skew))
+        for b in list(self.ctx.rlimited):
+            self.ctx.cluster.set_rlimit(b, 0)
+            self.ctx.rlimited.remove(b)
+        for b in list(self.ctx.browned):
+            self.ctx.cluster.clear_brownout(b)
+            self.ctx.browned.remove(b)
         if self.ctx.sockem is not None:
             self.ctx.sockem.set(delay_ms=0, jitter_ms=0, rate_bps=0,
                                 max_write=0, rx_drop=False, tx_drop=False)
@@ -509,7 +748,10 @@ class ChaosScheduler:  # lint: ok shared-state
                 if k in ("broker", "topic", "partition", "from", "to",
                          "skipped", "count", "label")
                 or k in ("delay_ms", "jitter_ms", "rate_bps", "max_write",
-                         "rx_drop", "tx_drop")))
+                         "rx_drop", "tx_drop")
+                # environment fault library (ISSUE 11)
+                or k in ("skew_ms", "rlim_bytes", "rx_delay_ms",
+                         "tx_delay_ms")))
             out.append((e["idx"], e["t"], e["action"], stable))
         return out
 

@@ -14,6 +14,22 @@ down mid-write (half-written frames lost, connects refused),
 ``SIGSTOP``/``SIGCONT`` freeze it like a GC pause or VM migration,
 and the client must survive with the delivery contract intact.
 
+**Asymmetric brownouts** (ISSUE 11, the out-of-process analog of
+sockem's one-direction rx_drop/tx_drop + latency): live-settable knobs
+arrive as JSON command lines on stdin::
+
+    {"set": {"rx_drop": true}}            broker->client data discarded
+    {"set": {"tx_drop": true}}            client->broker data discarded
+    {"set": {"rx_delay_ms": 200}}         broker->client latency
+    {"set": {"tx_delay_ms": 50}}          client->broker latency
+    {"set": {}}  /  all-zero knobs        heal
+
+Each command is acked with one JSON line on stdout
+(``{"ok": true, "knobs": {...}}``).  Directions are client-relative,
+matching sockem: **tx** = client->broker, **rx** = broker->client —
+so ``rx_drop`` is the classic half-open partition where the broker
+hears requests but its responses vanish.
+
 Handshake: one JSON line on stdout — ``{"broker", "port", "pid"}``.
 Exits when stdin reaches EOF (supervisor died or closed the pipe), so
 an orphaned relay can never linger eating the host.
@@ -24,23 +40,36 @@ import os
 import selectors
 import socket
 import sys
+import time
 
 RECV_CHUNK = 65536
 #: per-direction backpressure cap: stop reading a side whose peer is
 #: this far behind (a slow client must not balloon the relay)
 BUF_MAX = 1 << 20
 
+#: live brownout knobs (stdin-settable; read per-chunk)
+KNOBS = {"rx_drop": False, "tx_drop": False,
+         "rx_delay_ms": 0.0, "tx_delay_ms": 0.0}
+
 
 class _Half:
-    """One direction's state: bytes waiting to be written to ``sock``."""
+    """One direction's state: bytes waiting to be written to ``sock``
+    plus any delayed chunks still being 'held in flight'."""
 
-    __slots__ = ("sock", "peer", "buf", "reading")
+    __slots__ = ("sock", "peer", "buf", "reading", "dir_read", "holdq",
+                 "held")
 
-    def __init__(self, sock):
+    def __init__(self, sock, dir_read):
         self.sock = sock
         self.peer = None
         self.buf = bytearray()
         self.reading = True
+        #: direction label of data READ from this sock ("tx" for the
+        #: client-side half, "rx" for the upstream/broker-side half)
+        self.dir_read = dir_read
+        #: delayed chunks headed FOR this sock: [(release_t, bytes)]
+        self.holdq = []
+        self.held = 0               # total bytes in holdq
 
 
 def _events(h: _Half) -> int:
@@ -76,9 +105,10 @@ def main(argv=None) -> int:
 
     sel = selectors.DefaultSelector()
     sel.register(ls, selectors.EVENT_READ, "accept")
-    # parent-death watch: stdin is a pipe from the supervisor; EOF
-    # means it is gone (or told us to exit) — no polling anywhere
+    # parent-death watch + brownout command channel: stdin is a pipe
+    # from the supervisor; EOF means it is gone
     sel.register(sys.stdin.fileno(), selectors.EVENT_READ, "stdin")
+    stdin_buf = bytearray()
 
     halves: dict[socket.socket, _Half] = {}
 
@@ -102,11 +132,68 @@ def main(argv=None) -> int:
         except (KeyError, ValueError):
             pass
 
+    def deliver(dst: _Half, data) -> None:
+        """Queue ``data`` for ``dst``'s socket and push what fits now;
+        applies the backpressure contract on the reading side."""
+        src = dst.peer
+        dst.buf += data
+        try:
+            sent = dst.sock.send(dst.buf)
+            del dst.buf[:sent]
+        except BlockingIOError:
+            pass
+        except OSError:
+            close_pair(dst)
+            return
+        if src is not None and len(dst.buf) + dst.held > BUF_MAX:
+            src.reading = False
+            update(src)
+        update(dst)
+
+    def handle_cmd(line: bytes) -> None:
+        try:
+            cmd = json.loads(line)
+        except ValueError:
+            print(json.dumps({"ok": False, "error": "bad json"}),
+                  flush=True)
+            return
+        knobs = cmd.get("set") or {}
+        for k, v in knobs.items():
+            if k in ("rx_drop", "tx_drop"):
+                KNOBS[k] = bool(v)
+            elif k in ("rx_delay_ms", "tx_delay_ms"):
+                KNOBS[k] = float(v)
+        print(json.dumps({"ok": True, "knobs": KNOBS}), flush=True)
+
     while True:
-        for key, mask in sel.select():
+        # release due held chunks first; the nearest future release
+        # bounds the select timeout so latency injection stays accurate
+        now = time.monotonic()
+        timeout = None
+        for h in list(halves.values()):
+            while h.holdq and h.holdq[0][0] <= now:
+                _t, data = h.holdq.pop(0)
+                h.held -= len(data)
+                deliver(h, data)
+                if h.sock not in halves:
+                    break
+            if h.sock in halves and h.holdq:
+                left = h.holdq[0][0] - now
+                timeout = left if timeout is None else min(timeout, left)
+        if timeout is not None:
+            timeout = max(0.0, timeout)
+
+        for key, mask in sel.select(timeout):
             if key.data == "stdin":
-                if not os.read(sys.stdin.fileno(), 4096):
+                chunk = os.read(sys.stdin.fileno(), 4096)
+                if not chunk:
                     return 0
+                stdin_buf += chunk
+                while b"\n" in stdin_buf:
+                    raw, _, rest = bytes(stdin_buf).partition(b"\n")
+                    stdin_buf = bytearray(rest)
+                    if raw.strip():
+                        handle_cmd(raw)
                 continue
             if key.data == "accept":
                 try:
@@ -125,7 +212,7 @@ def main(argv=None) -> int:
                     continue
                 cs.setblocking(False)
                 us.setblocking(False)
-                ch, uh = _Half(cs), _Half(us)
+                ch, uh = _Half(cs, "tx"), _Half(us, "rx")
                 ch.peer, uh.peer = uh, ch
                 halves[cs] = ch
                 halves[us] = uh
@@ -148,20 +235,23 @@ def main(argv=None) -> int:
                     close_pair(h)
                     continue
                 if data:
-                    dst = h.peer
-                    dst.buf += data
-                    try:
-                        sent = dst.sock.send(dst.buf)
-                        del dst.buf[:sent]
-                    except BlockingIOError:
-                        pass
-                    except OSError:
-                        close_pair(h)
+                    # one-direction partition: silently discard this
+                    # direction's traffic while its drop knob is set
+                    # (the peer still sees an established connection —
+                    # a half-open partition, not a close)
+                    if KNOBS[h.dir_read + "_drop"]:
                         continue
-                    if len(dst.buf) > BUF_MAX:
-                        h.reading = False
-                    update(dst)
-                    update(h)
+                    delay = KNOBS[h.dir_read + "_delay_ms"]
+                    dst = h.peer
+                    if delay > 0:
+                        dst.holdq.append(
+                            (time.monotonic() + delay / 1000.0, data))
+                        dst.held += len(data)
+                        if len(dst.buf) + dst.held > BUF_MAX:
+                            h.reading = False
+                            update(h)
+                    else:
+                        deliver(dst, data)
             if mask & selectors.EVENT_WRITE and h.sock in halves:
                 try:
                     if h.buf:
@@ -172,8 +262,8 @@ def main(argv=None) -> int:
                 except OSError:
                     close_pair(h)
                     continue
-                if len(h.buf) <= BUF_MAX and h.peer is not None \
-                        and not h.peer.reading:
+                if (len(h.buf) + h.held <= BUF_MAX and h.peer is not None
+                        and not h.peer.reading):
                     h.peer.reading = True
                     update(h.peer)
                 update(h)

@@ -271,6 +271,14 @@ class MockCluster:
         # succeed (kernel backlog) and then freeze, exactly what a
         # GC-paused/VM-frozen broker looks like from the client
         self._paused: set[int] = set()
+        # environment fault library (ISSUE 11): brokers whose storage
+        # plane is "full"/EIO — every Produce they lead returns
+        # KAFKA_STORAGE_ERROR (retriable: real brokers do exactly this
+        # on a failed log dir) until the window heals
+        self._storage_err: set[int] = set()
+        # per-broker wall-clock skew in ms, reflected in every
+        # timestamp this broker reports (log_append_time, ListOffsets)
+        self._clock_skew_ms: dict[int, float] = {}
         # out-of-process tier: the standalone supervisor fronts each
         # internal listener with a relay OS process on a public port;
         # metadata/FindCoordinator must advertise THAT port or clients
@@ -534,6 +542,52 @@ class MockCluster:
     def paused_brokers(self) -> list[int]:
         with self._lock:
             return sorted(self._paused)
+
+    # ------------------------- environment fault library (ISSUE 11) --
+    def set_storage_error(self, broker_id: Optional[int] = None,
+                          on: bool = True) -> dict:
+        """Disk-full/EIO window on the storage plane (chaos
+        ``env_eio``): every Produce led by an affected broker returns
+        ``KAFKA_STORAGE_ERROR`` — the retriable error a real broker
+        raises when its log dir fails — until the window heals.
+        ``broker_id=None`` applies cluster-wide (all brokers)."""
+        with self._lock:
+            ids = ([broker_id] if broker_id
+                   else list(range(1, self.num_brokers + 1)))
+            for b in ids:
+                if on:
+                    self._storage_err.add(b)
+                else:
+                    self._storage_err.discard(b)
+            return {"brokers": sorted(self._storage_err), "on": on}
+
+    def storage_error_brokers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._storage_err)
+
+    def set_clock_skew(self, broker_id: int, skew_ms: float = 0.0) -> dict:
+        """Clock-skew fault (chaos ``env_skew``): broker
+        ``broker_id``'s wall clock reads ``skew_ms`` off true — every
+        wall timestamp it reports (Produce ``log_append_time``,
+        ``broker_clock_ms``) shifts accordingly.  0 restores a true
+        clock."""
+        with self._lock:
+            if skew_ms:
+                self._clock_skew_ms[broker_id] = float(skew_ms)
+            else:
+                self._clock_skew_ms.pop(broker_id, None)
+            return {"broker": broker_id, "skew_ms": skew_ms}
+
+    def broker_clock_ms(self, broker_id: int) -> int:
+        """This broker's idea of wall-clock now, in ms (true clock +
+        any injected skew)."""
+        with self._lock:
+            skew = self._clock_skew_ms.get(broker_id, 0.0)
+        return int(time.time() * 1000.0 + skew)
+
+    def clock_skews(self) -> dict[int, float]:
+        with self._lock:
+            return dict(self._clock_skew_ms)
 
     def rolling_restart(self, pause_s: float = 0.5) -> None:
         """Kill + restart every broker in id order, one at a time,
@@ -838,6 +892,13 @@ class MockCluster:
     def _h_Produce(self, conn, corrid, hdr, body, inject):
         out_topics = []
         with self._lock:
+            # env_eio: this broker's log dir is "failed" — refuse every
+            # append with the retriable storage error a real broker
+            # raises, without touching the log (nothing is persisted)
+            storage_dead = conn.broker_id in self._storage_err
+            skew = self._clock_skew_ms.get(conn.broker_id)
+            la_time = (int(time.time() * 1000.0 + skew)
+                       if skew is not None else -1)
             for t in body["topics"]:
                 tp = {"topic": t["topic"], "partitions": []}
                 for p in t["partitions"]:
@@ -858,6 +919,9 @@ class MockCluster:
                         if part.leader != conn.broker_id:
                             err = Err.NOT_LEADER_FOR_PARTITION
                             part = None
+                        elif storage_dead:
+                            err = Err.KAFKA_STORAGE_ERROR
+                            part = None
                     if part is not None:
                         blob = p["records"]
                         err, base = self._produce_to(part, blob)
@@ -865,7 +929,7 @@ class MockCluster:
                             err, base = inject, -1
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
-                         "base_offset": base, "log_append_time": -1})
+                         "base_offset": base, "log_append_time": la_time})
                 out_topics.append(tp)
         if body["acks"] == 0:
             return None  # no response for acks=0

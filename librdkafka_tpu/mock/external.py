@@ -71,6 +71,17 @@ def _deregister(pids) -> None:
             _ACTIVE_PIDS.pop(pid, None)
 
 
+# public registry surface for other subprocess-spawning rigs (the
+# fleet driver registers every worker pid here, so the one conftest
+# leak fixture polices brokers AND fleet clients)
+def register_pids(pids: dict[int, str]) -> None:
+    _register(pids)
+
+
+def deregister_pids(pids) -> None:
+    _deregister(pids)
+
+
 def pid_alive(pid: int) -> bool:
     """True iff ``pid`` still exists (signal-0 probe)."""
     try:
@@ -269,6 +280,56 @@ class ClusterHandle:  # lint: ok shared-state
             self.proc_events.append({"verb": "resume", "broker": broker_id,
                                      "pid": resp.get("pid")})
         return resp
+
+    # ------------------------------------ environment fault library --
+    def set_storage_error(self, broker_id: Optional[int] = None,
+                          on: bool = True) -> dict:
+        """Disk-full/EIO window on the supervisor's storage plane
+        (``env_eio``): Produce on the affected broker(s) returns
+        KAFKA_STORAGE_ERROR until healed.  None = every broker."""
+        resp = self._ctl_cmd(f"eio {broker_id or 0} {1 if on else 0}")
+        with self._lock:
+            self.proc_events.append({"verb": "eio", "broker": broker_id,
+                                     "on": on})
+        return resp
+
+    def set_clock_skew(self, broker_id: int, skew_ms: float = 0.0) -> dict:
+        """Clock-skew fault (``env_skew``): broker ``broker_id``'s
+        wall clock reads ``skew_ms`` off true (0 heals)."""
+        resp = self._ctl_cmd(f"skew {broker_id} {skew_ms}")
+        with self._lock:
+            self.proc_events.append({"verb": "skew", "broker": broker_id,
+                                     "skew_ms": skew_ms})
+        return resp
+
+    def set_rlimit(self, broker_id: int, nbytes: int) -> dict:
+        """Memory pressure (``env_rlimit``): soft RLIMIT_AS on the
+        broker's relay OS process via prlimit (0 restores infinity)."""
+        resp = self._ctl_cmd(f"rlimit {broker_id} {int(nbytes)}")
+        with self._lock:
+            self.proc_events.append({"verb": "rlimit",
+                                     "broker": broker_id,
+                                     "pid": resp.get("pid"),
+                                     "soft": resp.get("soft")})
+        return resp
+
+    def brownout(self, broker_id: int, *, rx_drop: bool = False,
+                 tx_drop: bool = False, rx_delay_ms: float = 0.0,
+                 tx_delay_ms: float = 0.0) -> dict:
+        """Asymmetric-partition brownout (``env_brownout``): live
+        one-direction drop/latency knobs on the broker's relay — the
+        out-of-process analog of sockem's rx_drop/tx_drop."""
+        knobs = {"rx_drop": rx_drop, "tx_drop": tx_drop,
+                 "rx_delay_ms": rx_delay_ms, "tx_delay_ms": tx_delay_ms}
+        blob = json.dumps(knobs, separators=(",", ":"))
+        resp = self._ctl_cmd(f"brownout {broker_id} {blob}")
+        with self._lock:
+            self.proc_events.append({"verb": "brownout",
+                                     "broker": broker_id, **knobs})
+        return resp
+
+    def clear_brownout(self, broker_id: int) -> dict:
+        return self.brownout(broker_id)
 
     # -------------------------------------------------------- teardown --
     def pids(self) -> dict[str, int]:

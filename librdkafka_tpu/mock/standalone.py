@@ -40,6 +40,21 @@ in, one JSON line out::
     leader <t> <p> <b>   migrate partition leadership
     shutdown         kill every broker process and exit
 
+Environment fault library (ISSUE 11 — faults a kill/stop schedule
+cannot express; each maps to a chaos ``env_*`` verb):
+
+    eio <id|0> <1|0>     disk-full/EIO window on the storage plane
+                         (0 = every broker): Produce returns
+                         KAFKA_STORAGE_ERROR until healed
+    skew <id> <ms>       clock skew: broker <id>'s wall clock reads
+                         <ms> off true (0 heals)
+    rlimit <id> <bytes>  memory pressure: soft RLIMIT_AS on the
+                         broker's relay process via prlimit
+                         (0 restores infinity)
+    brownout <id> <json> asymmetric partition: forward one-direction
+                         rx/tx drop + latency knobs to the relay's
+                         stdin (see mock/_relay.py); all-zero heals
+
 The supervisor exits on ``shutdown`` or when its stdin reaches EOF
 (the launching ClusterHandle died) — and each relay watches ITS stdin
 the same way, so no broker process can outlive the rig.
@@ -90,6 +105,8 @@ class Supervisor:  # lint: ok shared-state
         self.migrated: dict[int, list] = {}   # broker -> last kill summary
         self.down: set[int] = set()
         self.paused: set[int] = set()
+        #: leftover relay-stdout bytes per broker (brownout acks)
+        self._rbufs: dict[int, bytearray] = {}
         self.shutdown = threading.Event()
 
         for b in range(1, num_brokers + 1):
@@ -243,6 +260,83 @@ class Supervisor:  # lint: ok shared-state
             return {"error": f"SIGCONT failed: {e}"}
         return {"ok": True, "broker": b, "pid": pid}
 
+    def _cmd_rlimit(self, b: int, nbytes: int) -> dict:
+        """Memory pressure on broker ``b``'s relay process: lower its
+        soft RLIMIT_AS (hard limit stays infinite so the verb heals
+        without privileges).  ``nbytes=0`` restores infinity."""
+        import resource
+        with self._cond:
+            if self.procs.get(b) is None or b in self.down:
+                return {"error": f"broker {b} is not running"}
+            pid = self.pids[b]
+        soft = resource.RLIM_INFINITY if nbytes <= 0 else int(nbytes)
+        try:
+            old = resource.prlimit(pid, resource.RLIMIT_AS,
+                                   (soft, resource.RLIM_INFINITY))
+        except (OSError, ValueError) as e:
+            return {"error": f"prlimit failed: {e}"}
+        return {"ok": True, "broker": b, "pid": pid,
+                "soft": -1 if soft == resource.RLIM_INFINITY else soft,
+                "old_soft": (-1 if old[0] == resource.RLIM_INFINITY
+                             else old[0])}
+
+    def _cmd_brownout(self, b: int, knobs: dict) -> dict:
+        """Asymmetric-partition brownout: forward the knob set to the
+        relay's stdin and wait for its ack line.  Refused for paused
+        brokers (a SIGSTOPped relay cannot ack — and SIGCONT would
+        already be the right verb to end THAT fault)."""
+        with self._cond:
+            proc = self.procs.get(b)
+            if proc is None or b in self.down:
+                return {"error": f"broker {b} is not running"}
+            if b in self.paused:
+                return {"error": f"broker {b} is paused (SIGSTOP); "
+                                 "cont it before a brownout"}
+        line = json.dumps({"set": knobs},
+                          separators=(",", ":")).encode() + b"\n"
+        try:
+            proc.stdin.write(line)
+            proc.stdin.flush()
+        except (OSError, ValueError) as e:
+            return {"error": f"relay stdin write failed: {e}"}
+        ack = self._read_relay_line(b, proc, timeout=5.0)
+        if ack is None or not ack.get("ok"):
+            return {"error": f"relay did not ack brownout: {ack}"}
+        return {"ok": True, "broker": b, "knobs": ack.get("knobs")}
+
+    def _read_relay_line(self, b: int, proc, timeout: float):
+        """One JSON line from the relay's stdout (raw fd + per-broker
+        leftover buffer; the buffered handshake readline left nothing
+        behind — the relay writes strictly one line per event)."""
+        buf = self._rbufs.setdefault(b, bytearray())
+        fd = proc.stdout.fileno()
+        deadline = time.monotonic() + timeout
+        sel = selectors.DefaultSelector()
+        try:
+            sel.register(fd, selectors.EVENT_READ)
+        except (OSError, ValueError):
+            return None
+        try:
+            while b"\n" not in buf:
+                left = deadline - time.monotonic()
+                if left <= 0 or not sel.select(timeout=left):
+                    return None
+                try:
+                    chunk = os.read(fd, 4096)
+                except OSError:
+                    return None
+                if not chunk:
+                    return None
+                buf += chunk
+        finally:
+            sel.close()
+        raw, _, rest = bytes(buf).partition(b"\n")
+        self._rbufs[b] = bytearray(rest)
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None
+
     def _cmd_status(self) -> dict:
         with self._cond:
             snap = {
@@ -260,6 +354,9 @@ class Supervisor:  # lint: ok shared-state
             snap["metadata_version"] = self.cluster.metadata_version
             snap["topics"] = {t: [p.leader for p in parts]
                               for t, parts in self.cluster.topics.items()}
+            snap["storage_err"] = sorted(self.cluster._storage_err)
+            snap["clock_skews"] = {str(b): s for b, s in
+                                   self.cluster._clock_skew_ms.items()}
         return snap
 
     def _dispatch(self, line: str) -> dict:
@@ -288,6 +385,21 @@ class Supervisor:  # lint: ok shared-state
             if cmd == "create_topic":
                 self.cluster.create_topic(args[0], int(args[1]))
                 return {"ok": True}
+            if cmd == "eio":
+                b = int(args[0])
+                info = self.cluster.set_storage_error(
+                    b or None, bool(int(args[1])))
+                return {"ok": True, "broker": b, **info}
+            if cmd == "skew":
+                b = int(args[0])
+                self.cluster.set_clock_skew(b, float(args[1]))
+                return {"ok": True, "broker": b,
+                        "skew_ms": float(args[1])}
+            if cmd == "rlimit":
+                return self._cmd_rlimit(int(args[0]), int(args[1]))
+            if cmd == "brownout":
+                return self._cmd_brownout(
+                    int(args[0]), json.loads(" ".join(args[1:])))
             if cmd == "shutdown":
                 self.shutdown.set()
                 return {"ok": True, "bye": True}

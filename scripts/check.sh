@@ -10,5 +10,7 @@
 # the whole suite under the instrumented locks / lockset detector.
 cd "$(dirname "$0")/.."
 set -o pipefail
-timeout -k 10 420 env JAX_PLATFORMS=cpu \
+# 540s: the stress + races passes each grew a multi-process fleet leg
+# (ISSUE 11) on top of the external SIGKILL storm
+timeout -k 10 540 env JAX_PLATFORMS=cpu \
     python -m librdkafka_tpu.analysis all

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Fleet tier (FLEET.md): multi-process client populations against the
+# supervised out-of-process cluster.  Default runs the fast tier —
+# traffic/verb unit tests, the 4-worker smoke fleet with a real
+# SIGKILL, determinism + ledger-merge checks.  --soak adds the slow
+# legs, including the ≥24-worker flagship (diurnal+burst traffic,
+# 3 SIGKILLs + asymmetric brownout + EIO window, per-group verify).
+# Pair with scripts/chaos.sh; the quick pre-commit gate is
+# `python bench.py --fleet --smoke` (2-worker mini fleet).
+cd "$(dirname "$0")/.."
+# concurrency + invariant gate first (lint + lockdep stress, which
+# includes the fleet smoke leg)
+scripts/check.sh || exit $?
+set -o pipefail
+MARK='fleet and not slow'
+LIMIT=600
+ARGS=()
+for a in "$@"; do
+    if [ "$a" = "--soak" ]; then
+        MARK='fleet'          # everything, flagship included
+        LIMIT=1200
+    else
+        ARGS+=("$a")
+    fi
+done
+timeout -k 10 "$LIMIT" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m "$MARK" -p no:cacheprovider -p no:xdist -p no:randomly "${ARGS[@]}"

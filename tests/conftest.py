@@ -112,11 +112,15 @@ def _no_leaked_engine_threads():
     # leaked "sockem-*" pump means a SockemConn outlived its test (its
     # sockets still open), and a leaked "chaos-sched-*" thread means a
     # ChaosScheduler was started but never joined/stopped — both keep
-    # injecting faults into whatever runs next.
+    # injecting faults into whatever runs next.  ISSUE 11 adds the
+    # fleet driver's "fleet-rd-*" reader threads: one still alive
+    # means a FleetDriver (and likely its worker subprocesses) was
+    # never stopped.
     def leaked():
         return [t.name for t in threading.enumerate()
                 if t.is_alive() and ("engine" in t.name
                                      or t.name.startswith("sockem-")
+                                     or t.name.startswith("fleet-rd-")
                                      or t.name.startswith("chaos-sched"))]
 
     while leaked() and time.monotonic() < deadline:
@@ -144,10 +148,13 @@ def _no_leaked_engine_threads():
     # never import the mesh module and should not pay for it here.
     # ISSUE 9: no standalone broker SUBPROCESS may outlive its test —
     # a ClusterHandle registers every pid it spawns (supervisor +
-    # per-broker relays) and stop() reaps + deregisters them all.  A
-    # leaked rig would keep real OS processes (and their ports) alive
-    # under every later test; reap first so one failure can't cascade,
-    # then fail the leaking test here.
+    # per-broker relays) and stop() reaps + deregisters them all.
+    # ISSUE 11 extends the same registry to fleet workers: the fleet
+    # driver registers every client process as "fleet-worker-<name>"
+    # at spawn and deregisters on stop(), so a lost fleet fails HERE
+    # too.  A leaked rig would keep real OS processes (and their
+    # ports) alive under every later test; reap first so one failure
+    # can't cascade, then fail the leaking test here.
     import sys
     ext_mod = sys.modules.get("librdkafka_tpu.mock.external")
     if ext_mod is not None:
@@ -155,8 +162,9 @@ def _no_leaked_engine_threads():
         if leaked_pids:
             ext_mod.reap_leaked()
         assert not leaked_pids, (
-            f"leaked standalone broker subprocess(es): {leaked_pids} — "
-            f"a ClusterHandle was not stopped (now SIGKILLed)")
+            f"leaked broker/fleet-worker subprocess(es): {leaked_pids} "
+            f"— a ClusterHandle or FleetDriver was not stopped (now "
+            f"SIGKILLed)")
 
     mesh_mod = sys.modules.get("librdkafka_tpu.parallel.mesh")
     if mesh_mod is not None:
