@@ -1196,11 +1196,12 @@ def chaos_bench() -> dict:
         t0 = time.perf_counter()
         try:
             report = sc.fn()
-            # the self-test PASSES by detecting its planted violation
+            # the self-tests PASS by detecting their planted violation
             # and proving the dump artifacts exist
             ok = ((not report["ok"] and bool(report.get("diff_path"))
                    and bool(report.get("flight_path")))
-                  if name == "oracle_selftest" else
+                  if name in ("oracle_selftest",
+                              "oracle_continuity_selftest") else
                   (report["ok"] and not report["errors"]
                    and not report["schedule_errors"]))
             legs[name] = {
@@ -1229,6 +1230,89 @@ def chaos_bench() -> dict:
             "recovery_p99_ms": rec.get("p99"),
             "recovery_max_ms": rec.get("max"),
             "legs": legs}
+
+
+def rebalance_bench(smoke: bool = False) -> dict:
+    """bench.py --rebalance (ISSUE 12): eager vs KIP-429 cooperative
+    rebalancing for a 50-member group (12 in ``--smoke``) under
+    join/leave churn on the thread-cheap member harness — no broker
+    faults, pure protocol comparison.  Per leg: convergence time after
+    the last membership change, TOTAL partition-unavailability seconds
+    (integrated zero-active-fetcher time — eager's stop-the-world
+    cost), and messages flowing DURING rebalance windows.  The
+    headline ``coop_unavail_ratio`` (cooperative / eager
+    unavailability) must hold ≤ 0.2 for the 50-member leg."""
+    from librdkafka_tpu.chaos.scenarios import LiteStorm
+    from librdkafka_tpu.chaos.schedule import Schedule
+
+    members = 12 if smoke else 50
+    churners = 2 if smoke else 5
+    duration = 4.0 if smoke else 6.0
+    legs = {}
+    for strategy in ("range", "cooperative-sticky"):
+        t0 = time.perf_counter()
+        storm = LiteStorm(
+            seed=71, brokers=1, partitions=64, external=False,
+            members=members, churners=churners,
+            churn_start_s=1.8, churn_period_s=0.4,
+            churn_lifetime_s=1.6, strategy=strategy, threads=6,
+            heartbeat_s=0.4, member_stagger_s=0.01,
+            duration_s=duration, pace_ms=2, drain_s=25.0,
+            converge_s=30.0, check_continuity=True, flow_stall_s=3.0,
+            # KIP-134 initial hold: the fleet joins ONE first
+            # generation (otherwise member 0 grabs all partitions and
+            # both protocols pay an immediate mass redistribution)
+            initial_delay_ms=700)
+        try:
+            report = storm.run(Schedule(seed=71),
+                               raise_on_violation=False)
+        except Exception as e:  # noqa: B014 — leg must report, not die
+            legs[strategy] = {"ok": False, "error": repr(e)}
+            continue
+        intervals = storm.fleet.rebalancing_intervals()
+        with storm.oracle._lock:
+            stamps = [t for ts in storm.oracle.flow.values()
+                      for t in ts]
+        msgs_during = sum(1 for t in stamps
+                          if any(a <= t <= b for a, b in intervals))
+        reb_s = round(sum(b - a for a, b in intervals), 2)
+        # continuity violations only apply to the cooperative contract
+        bad = {k: len(v) for k, v in report["violations"].items()
+               if v and (strategy != "range" or k != "flow_gap")}
+        legs[strategy] = {
+            "ok": not bad and not report["errors"],
+            "violations": bad,
+            "members": members + churners,
+            "acked": report["acked"], "consumed": report["consumed"],
+            "converged_s": report["converged_s"],
+            "unavailability_s":
+                report["partition_unavailability"]["total_s"],
+            "rebalancing_s": reb_s,
+            "msgs_during_rebalance": msgs_during,
+            "msgs_per_rebalance_s":
+                round(msgs_during / reb_s, 1) if reb_s else None,
+            "incremental": strategy != "range",
+            "wall_s": round(time.perf_counter() - t0, 2)}
+    eager = legs.get("range", {})
+    coop = legs.get("cooperative-sticky", {})
+    ratio = None
+    if eager.get("unavailability_s") and \
+            coop.get("unavailability_s") is not None:
+        ratio = round(coop["unavailability_s"]
+                      / eager["unavailability_s"], 3)
+    return {
+        "ok": all(leg.get("ok") for leg in legs.values()) and bool(legs),
+        "group_members": members + churners,
+        "eager_unavailability_s": eager.get("unavailability_s"),
+        "coop_unavailability_s": coop.get("unavailability_s"),
+        "coop_unavail_ratio": ratio,
+        "eager_converged_s": eager.get("converged_s"),
+        "coop_converged_s": coop.get("converged_s"),
+        "eager_msgs_during_rebalance":
+            eager.get("msgs_during_rebalance"),
+        "coop_msgs_during_rebalance": coop.get("msgs_during_rebalance"),
+        "legs": legs,
+    }
 
 
 def fleet_bench(smoke: bool = False) -> dict:
@@ -1663,6 +1747,13 @@ def main():
                          "with a clean delivery-invariant oracle "
                          "verdict (bench.py --chaos)",
                **chaos_bench()})
+        return
+    if "--rebalance" in sys.argv:
+        _emit({"metric": "eager vs cooperative incremental rebalance: "
+                         "convergence time, partition-unavailability "
+                         "seconds, messages flowing mid-rebalance for "
+                         "a 50-member group (bench.py --rebalance)",
+               **rebalance_bench(smoke="--smoke" in sys.argv)})
         return
     if "--fleet" in sys.argv:
         _emit({"metric": "multi-process client fleet: aggregate "

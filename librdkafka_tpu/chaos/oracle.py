@@ -61,9 +61,15 @@ class DeliveryOracle:
     """Thread-safe ledger (DR callbacks fire on client poll threads,
     consumers record from their own loops)."""
 
-    def __init__(self, *, dump_dir: Optional[str] = None):
+    def __init__(self, *, dump_dir: Optional[str] = None,
+                 track_flow: bool = False):
         self._lock = new_lock("chaos.oracle")
         self.dump_dir = dump_dir
+        #: continuity tracking (ISSUE 12): per-partition consumption
+        #: stamps + per-member rebalance windows feed the flow-gap
+        #: detector (``verify(check_continuity=True)``) — opt-in, the
+        #: stamps are per-message state other storms don't need
+        self.track_flow = track_flow
         # every ledger is declared shared (analysis/races.py): DR
         # callbacks append from broker/poll threads, consumers from
         # their own loops, the verdict snapshots from the storm thread
@@ -87,9 +93,21 @@ class DeliveryOracle:
         # member -> {"assigns": n, "current": set[(t,p)] | None,
         #            "last_poll": ts, "last_assign": ts, "closed": bool}
         self.members: dict[str, dict] = shared_dict("oracle.members")
-        # (ts, member, kind) for every membership/assignment change —
-        # convergence is judged relative to the LAST of these
+        # (ts, member, kind, parts|None) for every membership/
+        # assignment change — convergence is judged relative to the
+        # LAST of these; incremental revokes carry their partition set
         self.group_events: list[tuple] = shared_list("oracle.group_events")
+        # ---- continuity ledger (ISSUE 12 flow-gap detector) ----
+        # (topic, partition) -> [consume monotonic stamps]
+        self.flow: dict[tuple, list] = shared_dict("oracle.flow")
+        # closed rebalance windows: (member, start, end, kept frozenset)
+        # — ``kept`` is the UNREVOKED ownership the member carried
+        # through the window; each kept partition must keep flowing
+        self.windows: list[tuple] = shared_list("oracle.windows")
+        # member -> (start_ts, kept set) while a revoke awaits the
+        # member's next assignment
+        self._open_windows: dict[str, tuple] = shared_dict(
+            "oracle.open_windows")
 
     # ---------------------------------------------------- producer side --
     def dr(self, txn: Optional[str] = None):
@@ -136,10 +154,16 @@ class DeliveryOracle:
 
     def record_consumed_rows(self, rows) -> None:
         """Bulk merge of consumed rows ``(topic, partition, offset,
-        value)`` — the consumer-side half of ``record_acks``."""
+        value[, ts])`` — the consumer-side half of ``record_acks``;
+        the optional worker-side stamp feeds the continuity ledger."""
         with self._lock:
-            for topic, partition, offset, value in rows:
+            for row in rows:
+                topic, partition, offset, value = row[:4]
                 self.consumed.append((topic, partition, offset, value))
+                if self.track_flow:
+                    ts = row[4] if len(row) > 4 else time.monotonic()
+                    self.flow.setdefault((topic, partition),
+                                         []).append(ts)
 
     def begin_txn(self, txn: str) -> None:
         with self._lock:
@@ -167,6 +191,9 @@ class DeliveryOracle:
         with self._lock:
             self.consumed.append((msg.topic, msg.partition, msg.offset,
                                   msg.value))
+            if self.track_flow:
+                self.flow.setdefault((msg.topic, msg.partition),
+                                     []).append(time.monotonic())
 
     # ------------------------------------------------------ group side --
     def _member(self, member: str) -> dict:
@@ -177,24 +204,86 @@ class DeliveryOracle:
                 "last_assign": 0.0, "closed": False}
         return st
 
-    def record_assign(self, member: str, partitions) -> None:
+    def record_assign(self, member: str, partitions,
+                      incremental: bool = False) -> None:
         """on_assign callback: ``partitions`` is the member's NEW
         ownership set as (topic, partition) pairs (empty is a real
-        assignment — a large group legally leaves members idle)."""
+        assignment — a large group legally leaves members idle).
+        ``incremental=True`` (KIP-429 cooperative) ADDS to the current
+        set instead of replacing it."""
         now = time.monotonic()
+        parts = set(partitions)
         with self._lock:
             st = self._member(member)
             st["assigns"] += 1
-            st["current"] = set(partitions)
+            if incremental:
+                st["current"] = (st["current"] or set()) | parts
+            else:
+                st["current"] = parts
             st["last_assign"] = now
-            self.group_events.append((now, member, "assign"))
+            self.group_events.append((now, member, "assign",
+                                      tuple(sorted(parts))))
+            # an assign closes the member's open rebalance window: the
+            # kept partitions were required to flow from the revoke
+            # delivery until right now
+            open_w = self._open_windows.pop(member, None)
+            if open_w is not None and self.track_flow:
+                start, kept = open_w
+                self.windows.append((member, start, now,
+                                     frozenset(kept)))
 
-    def record_revoke(self, member: str) -> None:
+    def record_rebalance_begin(self, member: str) -> None:
+        """The member started rebalancing (left steady state / rejoin
+        triggered) while still OWNING its current set: opens the
+        continuity window — every partition it keeps through the
+        rebalance must flow until the next assignment closes the
+        window.  Mid-window incremental revokes narrow the kept set
+        (``record_revoke``); eager full revokes discard the window
+        (an eager member legally stops the world)."""
         now = time.monotonic()
         with self._lock:
             st = self._member(member)
-            st["current"] = None        # between generations: owns nothing
-            self.group_events.append((now, member, "revoke"))
+            kept = set(st["current"] or ())
+            self.group_events.append((now, member, "rebalance", None))
+            if self.track_flow and kept \
+                    and member not in self._open_windows:
+                self._open_windows[member] = (now, kept)
+
+    def record_revoke(self, member: str, partitions=None) -> None:
+        """``partitions=None`` is the eager full revoke (between
+        generations the member owns nothing).  A (topic, partition)
+        list is a KIP-429 INCREMENTAL revoke: only those leave the
+        member's ownership — everything kept is REQUIRED to keep
+        flowing until the member's next assignment (the continuity
+        invariant's rebalance window)."""
+        now = time.monotonic()
+        with self._lock:
+            st = self._member(member)
+            if partitions is None:
+                st["current"] = None    # between generations: owns nothing
+                self.group_events.append((now, member, "revoke", None))
+                # eager stop-the-world: nothing is kept, no continuity
+                # obligation survives
+                self._open_windows.pop(member, None)
+                return
+            revoked = set(partitions)
+            kept = (st["current"] or set()) - revoked
+            st["current"] = kept
+            self.group_events.append((now, member, "revoke",
+                                      tuple(sorted(revoked))))
+            if not self.track_flow:
+                return
+            prev = self._open_windows.get(member)
+            if prev is not None:
+                # narrow an open window: revoked partitions owe flow
+                # only up to this revoke, the rest to the next assign
+                narrowed = prev[1] - revoked
+                if narrowed:
+                    self._open_windows[member] = (prev[0], narrowed)
+                else:
+                    self._open_windows.pop(member, None)
+            elif kept:
+                self._open_windows[member] = (now, set(kept))
 
     def record_poll(self, member: str) -> None:
         """Liveness heartbeat: the member's consume loop is still
@@ -204,11 +293,14 @@ class DeliveryOracle:
 
     def record_member_closed(self, member: str) -> None:
         """The member left deliberately (churn departure / shutdown):
-        exempt from stuck-consumer and coverage checks."""
+        exempt from stuck-consumer and coverage checks — and its open
+        rebalance window (if any) is discarded, a departing member
+        owes no continuity."""
         now = time.monotonic()
         with self._lock:
             self._member(member)["closed"] = True
-            self.group_events.append((now, member, "closed"))
+            self.group_events.append((now, member, "closed", None))
+            self._open_windows.pop(member, None)
 
     def group_coverage(self, topic: str, n_partitions: int) -> dict:
         """Live snapshot of group assignment state — the convergence
@@ -264,7 +356,10 @@ class DeliveryOracle:
                group_topic: Optional[str] = None,
                group_partitions: int = 0,
                converged_s: Optional[float] = None,
+               converge_bound_s: Optional[float] = None,
                stuck_after_s: float = 8.0,
+               check_continuity: bool = False,
+               flow_stall_s: float = 2.0,
                coverage: Optional[dict] = None,
                now: Optional[float] = None,
                raise_on_violation: bool = True) -> dict:
@@ -286,13 +381,27 @@ class DeliveryOracle:
         (``group_coverage()`` snapshot + clock) BEFORE shutting its
         consumers down — judging the live recompute instead would see
         the deliberate LeaveGroup cascade of teardown as a coverage
-        hole.  When omitted (unit tests), both default to live."""
+        hole.  When omitted (unit tests), both default to live.
+
+        ``check_continuity`` (ISSUE 12, requires ``track_flow=True``):
+        the **zero stop-the-world** invariant — for every rebalance
+        window (incremental revoke delivery → the member's next
+        assignment), each partition the member KEPT must show
+        consumption with no internal gap exceeding ``flow_stall_s``,
+        provided traffic (acks) existed in the window.  An unrevoked
+        partition that stalls across a rebalance is a ``flow_gap``
+        violation.  ``converge_bound_s`` turns a measured-but-slow
+        convergence into a violation too."""
         with self._lock:
             acked = list(self.acked)
+            acked_ts = list(self.acked_ts)
             consumed = list(self.consumed)
             txns = dict(self.txns)
             failed = list(self.failed)
             members = {m: dict(st) for m, st in self.members.items()}
+            windows = list(self.windows)
+            flow = {tp: list(ts) for tp, ts in self.flow.items()} \
+                if check_continuity else {}
 
         lost, duplicated, reordered = [], [], []
         aborted_seen, torn = [], []
@@ -354,6 +463,38 @@ class DeliveryOracle:
                       "reordered": reordered,
                       "aborted_seen": aborted_seen, "torn_txns": torn}
 
+        # -- continuity: zero stop-the-world windows (ISSUE 12) -----------
+        if check_continuity:
+            ack_stamps: dict[tuple, list] = {}
+            for (topic, part, *_rest), ts in zip(acked, acked_ts):
+                ack_stamps.setdefault((topic, part), []).append(ts)
+            for ts_list in ack_stamps.values():
+                ts_list.sort()
+            flow_gaps = []
+            for member, w0, w1, kept in windows:
+                if w1 - w0 <= flow_stall_s:
+                    continue        # too short to even hold a gap
+                for tp in sorted(kept):
+                    stamps = flow.get(tp, ())
+                    # traffic gate: the partition must have received
+                    # acked produce inside the window, otherwise there
+                    # was legitimately nothing to consume
+                    if not any(w0 <= t <= w1
+                               for t in ack_stamps.get(tp, ())):
+                        continue
+                    anchors = ([w0]
+                               + sorted(t for t in stamps
+                                        if w0 <= t <= w1) + [w1])
+                    gap = max(b - a for a, b in zip(anchors, anchors[1:]))
+                    if gap > flow_stall_s:
+                        flow_gaps.append(
+                            {"member": member, "topic": tp[0],
+                             "partition": tp[1],
+                             "gap_s": round(gap, 2),
+                             "window_s": round(w1 - w0, 2),
+                             "window": [round(w0, 3), round(w1, 3)]})
+            violations["flow_gap"] = flow_gaps
+
         # -- consumer-group invariants (assignment ledger) ----------------
         group_blob = None
         if check_group:
@@ -366,6 +507,12 @@ class DeliveryOracle:
                     {"reason": "no_convergence_within_bound", **{
                         k: cov[k] for k in ("missing", "overlaps",
                                             "unassigned")}})
+            elif (converge_bound_s is not None
+                    and converged_s > converge_bound_s):
+                unconverged.append(
+                    {"reason": "convergence_exceeded_bound",
+                     "converged_s": converged_s,
+                     "bound_s": converge_bound_s})
             else:
                 # converged once, but the FINAL state must still hold:
                 # a late rebalance may not leave holes or double owners
@@ -417,6 +564,12 @@ class DeliveryOracle:
         }
         if group_blob is not None:
             report["group"] = group_blob
+        if check_continuity:
+            report["continuity"] = {
+                "windows": len(windows),
+                "flow_stall_s": flow_stall_s,
+                "tracked_partitions": len(flow),
+                "flow_gaps": len(violations.get("flow_gap", ()))}
         if not ok:
             report["diff_path"] = self._dump_diff(violations, report)
             # the trace that explains the storm must survive it: stamp

@@ -27,6 +27,7 @@ from ..mock.cluster import MockCluster
 from ..mock.external import ClusterHandle
 from ..mock.sockem import Sockem
 from ..obs import trace
+from .members import LiteMemberFleet
 from .oracle import DeliveryOracle, OracleViolation
 from .schedule import (ChaosScheduler, Schedule, broker_kill,
                        broker_restart, conn_kill, leader_migrate, net,
@@ -101,6 +102,10 @@ class Storm:  # lint: ok shared-state
                  abort_every: int = 0, isolation: str = "read_committed",
                  consumers: int = 1, consumer_start_delays=(0.0,),
                  check_group: bool = False, converge_s: float = 20.0,
+                 strategy: str = "range,roundrobin",
+                 check_continuity: bool = False,
+                 flow_stall_s: float = 2.0,
+                 converge_bound_s: Optional[float] = None,
                  churn_consumers: int = 0, churn_start_s: float = 1.0,
                  churn_period_s: float = 0.5, churn_lifetime_s: float = 2.0,
                  duration_s: float = 3.0, pace_ms: float = 4.0,
@@ -119,6 +124,10 @@ class Storm:  # lint: ok shared-state
         self.consumer_start_delays = consumer_start_delays
         self.check_group = check_group
         self.converge_s = converge_s
+        self.strategy = strategy
+        self.check_continuity = check_continuity
+        self.flow_stall_s = flow_stall_s
+        self.converge_bound_s = converge_bound_s
         self.churn_consumers = churn_consumers
         self.churn_start_s = churn_start_s
         self.churn_period_s = churn_period_s
@@ -140,7 +149,7 @@ class Storm:  # lint: ok shared-state
             self.cluster = MockCluster(num_brokers=brokers,
                                        topics={topic: partitions})
         self.sockem = Sockem() if use_sockem else None
-        self.oracle = DeliveryOracle()
+        self.oracle = DeliveryOracle(track_flow=check_continuity)
         self.chaos = ChaosScheduler(self.cluster, self.sockem,
                                     min_alive=min_alive)
         self.produced = 0
@@ -191,6 +200,7 @@ class Storm:  # lint: ok shared-state
             # between two stable sub-covers instead of converging
             conf["heartbeat.interval.ms"] = 400
             conf["session.timeout.ms"] = 6000
+        conf["partition.assignment.strategy"] = self.strategy
         return Consumer(self._conf(conf))
 
     # -- loops ------------------------------------------------------------
@@ -207,13 +217,27 @@ class Storm:  # lint: ok shared-state
         try:
             if self.check_group:
                 def _on_assign(cons, parts, _m=member):
+                    coop = cons.rebalance_protocol() == "COOPERATIVE"
                     oracle.record_assign(
-                        _m, [(tp.topic, tp.partition) for tp in parts])
-                    cons.assign(parts)
+                        _m, [(tp.topic, tp.partition) for tp in parts],
+                        incremental=coop)
+                    if coop:
+                        cons.incremental_assign(parts)
+                    else:
+                        cons.assign(parts)
 
                 def _on_revoke(cons, parts, _m=member):
-                    oracle.record_revoke(_m)
-                    cons.unassign()
+                    if cons.rebalance_protocol() == "COOPERATIVE":
+                        # KIP-429 incremental revoke: ONLY these leave;
+                        # the kept set owes continuity until the next
+                        # assignment (oracle window)
+                        oracle.record_revoke(
+                            _m, [(tp.topic, tp.partition)
+                                 for tp in parts])
+                        cons.incremental_unassign(parts)
+                    else:
+                        oracle.record_revoke(_m)
+                        cons.unassign()
 
                 c.subscribe([self.topic], on_assign=_on_assign,
                             on_revoke=_on_revoke)
@@ -221,12 +245,22 @@ class Storm:  # lint: ok shared-state
                 c.subscribe([self.topic])
             deadline = (time.monotonic() + lifetime
                         if lifetime is not None else None)
+            was_steady = False
             while not self._stop_consumers.is_set():
                 if deadline is not None and time.monotonic() >= deadline:
                     break
                 m = c.poll(0.2)
                 if self.check_group:
                     oracle.record_poll(member)
+                    if self.check_continuity:
+                        # continuity windows for REAL clients: the join
+                        # FSM leaving steady marks rebalance begin (the
+                        # kept partitions must flow from here until the
+                        # next assignment closes the window)
+                        steady = c._rk.cgrp.join_state == "steady"
+                        if was_steady and not steady:
+                            oracle.record_rebalance_begin(member)
+                        was_steady = steady
                 if m is not None and m.error is None:
                     oracle.record_consumed(m)
         except Exception as e:
@@ -395,8 +429,12 @@ class Storm:  # lint: ok shared-state
                                 "group_topic": self.topic,
                                 "group_partitions": self.partitions,
                                 "converged_s": self._converged_s,
+                                "converge_bound_s": self.converge_bound_s,
                                 "coverage": group_snapshot["coverage"],
                                 "now": group_snapshot["now"]}
+            if self.check_continuity:
+                group_kwargs.update(check_continuity=True,
+                                    flow_stall_s=self.flow_stall_s)
             try:
                 report = self.oracle.verify(
                     check_duplicates=self.check_duplicates,
@@ -431,6 +469,185 @@ class Storm:  # lint: ok shared-state
             self.chaos.stop()
             if self.sockem is not None:
                 self.sockem.kill_all()
+            self.cluster.stop()
+            trace.disable()
+
+
+# ----------------------------------------------------------- lite storm --
+class LiteStorm:  # lint: ok shared-state
+    """A storm over :class:`~.members.LiteMemberFleet` — hundreds-to-
+    1000 thread-cheap group members instead of full ``Consumer``
+    instances, plus one real paced producer.  The scale tier of the
+    consumer-group axis: Storm proves the REAL client's cooperative
+    protocol; LiteStorm proves the group machinery (mock coordinator,
+    assignor, continuity oracle) at member counts no in-process
+    Consumer army could reach.
+
+    shared-state pragma: the producer thread and the fleet's workers
+    communicate exclusively through the oracle's declared ledgers and
+    the fleet's own declared books; the storm thread reads after
+    joins."""
+
+    def __init__(self, *, seed: int, brokers: int = 3,
+                 partitions: int = 16, topic: str = "coop",
+                 external: bool = False, min_alive: int = 1,
+                 members: int = 100, churners: int = 0,
+                 churn_start_s: float = 2.0, churn_period_s: float = 0.05,
+                 churn_lifetime_s: float = 4.0,
+                 strategy: str = "cooperative-sticky", threads: int = 8,
+                 heartbeat_s: float = 0.4, member_stagger_s: float = 0.0,
+                 duration_s: float = 8.0, pace_ms: float = 2.0,
+                 drain_s: float = 30.0, converge_s: float = 40.0,
+                 converge_bound_s: Optional[float] = None,
+                 check_continuity: bool = True,
+                 flow_stall_s: float = 2.0,
+                 initial_delay_ms: int = 0):
+        self.seed = seed
+        self.topic = topic
+        self.partitions = partitions
+        self.external = external
+        self.members = members
+        self.churners = churners
+        self.duration_s = duration_s
+        self.pace_ms = pace_ms
+        self.drain_s = drain_s
+        self.converge_s = converge_s
+        self.converge_bound_s = converge_bound_s
+        self.check_continuity = check_continuity
+        self.flow_stall_s = flow_stall_s
+        if external:
+            self.cluster = ClusterHandle(brokers=brokers,
+                                         topics={topic: partitions})
+        else:
+            self.cluster = MockCluster(
+                num_brokers=brokers, topics={topic: partitions},
+                group_initial_rebalance_delay_ms=initial_delay_ms)
+        self.oracle = DeliveryOracle(track_flow=check_continuity)
+        self.chaos = ChaosScheduler(self.cluster, None,
+                                    min_alive=min_alive)
+        self.fleet = LiteMemberFleet(
+            self.cluster.bootstrap_servers(), group_id=f"lite-g-{seed}",
+            topic=topic, partitions=partitions, members=members,
+            oracle=self.oracle, seed=seed, strategy=strategy,
+            threads=threads, heartbeat_s=heartbeat_s,
+            member_stagger_s=member_stagger_s,
+            churn_members=churners, churn_start_s=churn_start_s,
+            churn_period_s=churn_period_s,
+            churn_lifetime_s=churn_lifetime_s)
+        self.produced = 0
+        self.errors: list[str] = []
+        self._converged_s: Optional[float] = None
+
+    def run(self, schedule: Schedule, *,
+            tamper: Optional[Callable] = None,
+            raise_on_violation: bool = True) -> dict:
+        trace.enable()
+        t0 = time.monotonic()
+        p = Producer({
+            "bootstrap.servers": self.cluster.bootstrap_servers(),
+            "linger.ms": 2, "enable.idempotence": True,
+            "compression.codec": "none",   # lite fetchers parse raw v2
+            "message.send.max.retries": 1000, "retry.backoff.ms": 50,
+            "message.timeout.ms": 120000, "reconnect.backoff.ms": 50,
+            "reconnect.backoff.max.ms": 1000})
+        try:
+            self.fleet.start()
+            self.chaos.start(schedule)
+            deadline = time.monotonic() + self.duration_s
+            seq = 0
+            while time.monotonic() < deadline:
+                v = b"s%08d" % seq
+                try:
+                    p.produce(self.topic, v,
+                              partition=seq % self.partitions,
+                              on_delivery=self.oracle.dr())
+                    seq += 1
+                except KafkaException as e:
+                    if e.error.code.name == "_QUEUE_FULL":
+                        p.poll(0.05)
+                        continue
+                    raise
+                p.poll(0)
+                if self.pace_ms:
+                    time.sleep(self.pace_ms / 1000.0)
+            self.produced = seq
+            self.chaos.join(timeout=schedule.duration + 30)
+            self.chaos.heal()
+            left = p.flush(60)
+            if left:
+                self.errors.append(f"flush left {left} undelivered")
+            storm_end = time.monotonic()
+
+            drain_end = time.monotonic() + self.drain_s
+            while (self.oracle.missing_count() > 0
+                   and time.monotonic() < drain_end):
+                time.sleep(0.2)
+
+            conv_end = storm_end + self.converge_s
+            while time.monotonic() < conv_end:
+                if self.oracle.group_coverage(
+                        self.topic, self.partitions)["converged"]:
+                    self._converged_s = round(
+                        time.monotonic() - storm_end, 2)
+                    break
+                time.sleep(0.2)
+            group_snapshot = {
+                "coverage": self.oracle.group_coverage(self.topic,
+                                                       self.partitions),
+                "now": time.monotonic()}
+            unavail = self.fleet.partition_unavailability(
+                group_snapshot["now"])
+            self.fleet.stop()
+
+            if tamper is not None:
+                tamper(self.oracle)
+            violation: Optional[OracleViolation] = None
+            try:
+                report = self.oracle.verify(
+                    check_duplicates=False, check_order=False,
+                    check_group=True, group_topic=self.topic,
+                    group_partitions=self.partitions,
+                    converged_s=self._converged_s,
+                    converge_bound_s=self.converge_bound_s,
+                    check_continuity=self.check_continuity,
+                    flow_stall_s=self.flow_stall_s,
+                    coverage=group_snapshot["coverage"],
+                    now=group_snapshot["now"],
+                    raise_on_violation=raise_on_violation)
+            except OracleViolation as v:
+                violation = v
+                report = v.report
+            report.update({
+                "seed": self.seed,
+                "external": self.external,
+                "produced": self.produced,
+                "members": self.members + self.churners,
+                "live_members": self.fleet.live_member_count(),
+                "converged_s": self._converged_s,
+                "partition_unavailability": unavail,
+                "rebalancing_intervals":
+                    len(self.fleet.rebalancing_intervals(
+                        group_snapshot["now"])),
+                "wall_s": round(time.monotonic() - t0, 2),
+                "timeline": self.chaos.timeline,
+                "replay_key": self.chaos.replay_key(),
+                "schedule_errors": self.chaos.errors,
+                "errors": self.errors + list(self.fleet.errors),
+            })
+            with self.oracle._lock:
+                acked_ts = list(self.oracle.acked_ts)
+            metrics = storm_metrics(self.chaos.timeline, acked_ts)
+            if metrics is not None:
+                report["storm_metrics"] = metrics
+            if self.external:
+                report["proc_events"] = list(self.cluster.proc_events)
+            if violation is not None:
+                raise violation
+            return report
+        finally:
+            self.fleet.stop()
+            self.chaos.stop()
+            p.close()
             self.cluster.stop()
             trace.disable()
 
@@ -625,6 +842,153 @@ def fast_group_churn(seed: int = 33, *,
     return report
 
 
+def fast_cooperative_churn(seed: int = 35, *,
+                           raise_on_violation: bool = True) -> dict:
+    """Tier-1 cooperative smoke (<14 s): 4 stable + 2 churning REAL
+    ``Consumer`` members on the KIP-429 ``cooperative-sticky``
+    protocol, one coordinator kill mid-rebalance.  Zero-loss + group
+    invariants PLUS the continuity invariant: every partition a member
+    keeps through a rebalance must keep flowing (zero stop-the-world
+    windows), and convergence lands inside the stated bound."""
+    gid = f"chaos-g-{seed}"
+    storm = Storm(seed=seed, brokers=2, partitions=4, min_alive=1,
+                  consumers=4,
+                  consumer_start_delays=(0.0, 0.1, 0.2, 0.3),
+                  check_group=True, converge_s=20.0,
+                  strategy="cooperative-sticky",
+                  check_continuity=True, flow_stall_s=2.5,
+                  converge_bound_s=20.0,
+                  churn_consumers=2, churn_start_s=0.8,
+                  churn_period_s=0.5, churn_lifetime_s=1.2,
+                  isolation="read_uncommitted",
+                  check_duplicates=False, check_order=False,
+                  duration_s=3.5, pace_ms=2, drain_s=20.0)
+    sched = (Schedule(seed=seed)
+             .at(1.2, broker_kill(f"coordinator:{gid}"))
+             .at(2.2, broker_restart()))
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["converged_s"] = storm._converged_s
+    return report
+
+
+def cooperative_coordinator_storm(seed: int = 37, *, consumers: int = 12,
+                                  churners: int = 8,
+                                  raise_on_violation: bool = True) -> dict:
+    """Cooperative twin of ``group_churn_coordinator_storm`` (slow):
+    12 stable + 8 churning cooperative-sticky members rebalance
+    continuously while the group coordinator is killed TWICE
+    mid-rebalance — zero loss, group invariants, and the continuity
+    invariant across every window."""
+    gid = f"chaos-g-{seed}"
+    storm = Storm(seed=seed, brokers=3, partitions=8, min_alive=2,
+                  consumers=consumers,
+                  consumer_start_delays=tuple(0.05 * i
+                                              for i in range(consumers)),
+                  check_group=True, converge_s=25.0,
+                  strategy="cooperative-sticky",
+                  check_continuity=True, flow_stall_s=2.5,
+                  converge_bound_s=25.0,
+                  churn_consumers=churners, churn_start_s=1.0,
+                  churn_period_s=0.45, churn_lifetime_s=2.2,
+                  isolation="read_uncommitted",
+                  check_duplicates=False, check_order=False,
+                  duration_s=6.0, pace_ms=2, drain_s=30.0)
+    sched = (Schedule(seed=seed)
+             .at(1.6, broker_kill(f"coordinator:{gid}"))
+             .at(2.8, broker_restart())
+             .at(3.8, broker_kill(f"coordinator:{gid}"))
+             .at(5.0, broker_restart()))
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["converged_s"] = storm._converged_s
+    return report
+
+
+def cooperative_churn_storm(seed: int = 55, *, members: int = 240,
+                            churners: int = 80, external: bool = True,
+                            kills: int = 1,
+                            raise_on_violation: bool = True) -> dict:
+    """FLAGSHIP (ISSUE 12): ≥300 thread-cheap cooperative members —
+    ``members`` stable + ``churners`` on overlapping join/leave
+    lifetimes — against the supervised out-of-process cluster, with
+    the group COORDINATOR process SIGKILLed (pid-verified) mid-churn,
+    i.e. mid-rebalance: the churn keeps the group permanently
+    rebalancing.  The oracle asserts zero acked loss, exact final
+    coverage, no stuck member, **zero stop-the-world windows** (every
+    kept partition flows through every rebalance window — the
+    continuity invariant) and convergence within the stated bound.
+    Same seed ⇒ identical ``replay_key`` across supervisor launches
+    (the PR 9 contract, now at 1000-member scale)."""
+    gid = f"lite-g-{seed}"
+    storm = LiteStorm(seed=seed, brokers=3, partitions=16,
+                      external=external, min_alive=2,
+                      members=members, churners=churners,
+                      churn_start_s=2.0, churn_period_s=0.05,
+                      churn_lifetime_s=4.0,
+                      strategy="cooperative-sticky", threads=8,
+                      heartbeat_s=0.5, member_stagger_s=0.004,
+                      duration_s=4.0 + churners * 0.05 + 4.0,
+                      pace_ms=2, drain_s=40.0,
+                      converge_s=45.0, converge_bound_s=45.0,
+                      check_continuity=True, flow_stall_s=3.0)
+    sched = Schedule(seed=seed)
+    kill_verb = proc_kill9 if external else broker_kill
+    restart_verb = proc_restart if external else broker_restart
+    for i in range(kills):
+        t = 4.0 + i * 3.0
+        sched.at(t, kill_verb(f"coordinator:{gid}"))
+        sched.at(t + 1.5, restart_verb())
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["kills_fired"] = sum(
+        1 for e in report["timeline"]
+        if e["action"] in ("proc_kill9", "broker_kill")
+        and (e.get("resolved") or {}).get("broker"))
+    if external:
+        report["pids_killed"] = [e for e in report.get("proc_events", [])
+                                 if e["verb"] == "kill9"]
+    return report
+
+
+def oracle_continuity_selftest(seed: int = 39) -> dict:
+    """Intentionally broken continuity: a quiet cooperative run whose
+    ledger is tampered with a SYNTHETIC flow gap — a rebalance window
+    over an unrevoked partition whose consume stamps inside the window
+    are deleted.  Proves the flow-gap detector yields an
+    OracleViolation carrying the JSON diff + flight dump (mirrors
+    ``oracle_selftest``).  Returns the report (ok=False)."""
+    topic = "chaos"
+
+    def _tamper(oracle: DeliveryOracle):
+        with oracle._lock:
+            stamps = sorted(oracle.flow.get((topic, 0), ()))
+            if len(stamps) < 4:
+                raise AssertionError(
+                    "continuity self-test: no flow recorded to tamper")
+            w0, w1 = stamps[0], stamps[-1]
+            # plant: a window claiming (topic, 0) was kept throughout,
+            # then erase its stamps after the first 10% of the window
+            oracle.windows.append(
+                ("selftest-m", w0, w1, frozenset({(topic, 0)})))
+            cut = w0 + (w1 - w0) * 0.1
+            oracle.flow[(topic, 0)] = [t for t in stamps if t <= cut]
+
+    storm = Storm(seed=seed, brokers=1, partitions=2, consumers=1,
+                  check_group=True, strategy="cooperative-sticky",
+                  check_continuity=True, flow_stall_s=1.0,
+                  isolation="read_uncommitted",
+                  check_duplicates=False, check_order=False,
+                  duration_s=3.0, pace_ms=2, drain_s=12.0)
+    try:
+        storm.run(Schedule(seed=seed), tamper=_tamper)
+    except OracleViolation as v:
+        if not v.report["violations"].get("flow_gap"):
+            raise AssertionError(
+                "continuity self-test: violation raised but no "
+                "flow_gap row — wrong detector fired") from v
+        return v.report
+    raise AssertionError("continuity self-test: planted flow gap was "
+                         "not flagged — the continuity oracle is blind")
+
+
 def fast_net_flap(seed: int = 11, *,
                   raise_on_violation: bool = True) -> dict:
     """Tier-1 deterministic smoke (<10 s): partial writes, latency
@@ -734,6 +1098,26 @@ SCENARIOS: dict[str, Scenario] = {
         fast_group_churn,
         "tier-1 smoke: 4+2-member group churn across a coordinator "
         "kill, <12s", "fast", 33, "loss,group"),
+    "fast_cooperative_churn": Scenario(
+        fast_cooperative_churn,
+        "tier-1 smoke: 4+2 cooperative-sticky members churn across a "
+        "coordinator kill — continuity invariant on, <14s",
+        "fast", 35, "loss,group,continuity"),
+    "cooperative_coordinator_storm": Scenario(
+        cooperative_coordinator_storm,
+        "12+8 cooperative-sticky members rebalance while the "
+        "coordinator dies twice — zero stop-the-world windows",
+        "slow", 37, "loss,group,continuity"),
+    "cooperative_churn_storm": Scenario(
+        cooperative_churn_storm,
+        "FLAGSHIP: >=300 thread-cheap cooperative members under "
+        "overlapping join/leave churn + a pid-verified coordinator "
+        "SIGKILL mid-rebalance — continuity + bounded convergence",
+        "slow", 55, "loss,group,continuity,convergence-bound"),
+    "oracle_continuity_selftest": Scenario(
+        oracle_continuity_selftest,
+        "intentionally broken: a synthetic flow gap on an unrevoked "
+        "partition must dump flight + diff", "fast", 39, "selftest"),
     "fast_net_flap": Scenario(
         fast_net_flap,
         "tier-1 smoke: partial writes + jitter + conn kill, <10s",

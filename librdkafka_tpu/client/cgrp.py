@@ -14,9 +14,12 @@ import re
 import time
 from typing import Optional, TYPE_CHECKING
 
+from ..analysis.locks import new_lock
+from ..analysis.races import shared
 from ..protocol.proto import ApiKey
-from .assignor import (ASSIGNORS, assignment_decode, assignment_encode,
-                       subscription_decode, subscription_encode)
+from .assignor import (ASSIGNOR_PROTOCOLS, ASSIGNORS, assignment_decode,
+                       assignment_encode, subscription_decode,
+                       subscription_encode)
 from .broker import Request
 from .errors import Err, KafkaError
 from .queue import Op, OpType, SyncReply
@@ -25,7 +28,33 @@ if TYPE_CHECKING:
     from .kafka import Kafka
 
 
+def _tps_dict(tps) -> dict:
+    """(topic, partition) set -> {topic: sorted [partitions]}."""
+    out: dict = {}
+    for t, p in sorted(tps):
+        out.setdefault(t, []).append(p)
+    return out
+
+
 class ConsumerGroup:
+    # lockset declarations (analysis/races.py).  Relaxed: the join/
+    # sync/heartbeat response handlers run on broker threads while
+    # serve() drives the FSM from the rk main thread — serialized by
+    # the single-flight ``_pending`` gate (at most one group request
+    # outstanding) and read lock-free by the stats emitter (str/int
+    # snapshots, GIL-atomic); tracked so a genuinely concurrent second
+    # writer path would surface in the --races sweeps.  Strict (all
+    # sites under the ``cgrp`` factory lock): ``assignment`` — replaced
+    # by the apply paths on app AND broker-callback threads while
+    # _join snapshots it for owned_partitions and stats reads it — and
+    # the incremental-revoke counter, an RMW between those threads.
+    join_state = shared("cgrp.join_state", relaxed=True)
+    member_id = shared("cgrp.member_id", relaxed=True)
+    generation = shared("cgrp.generation", relaxed=True)
+    rebalance_protocol = shared("cgrp.rebalance_proto", relaxed=True)
+    assignment = shared("cgrp.assignment")
+    incremental_revoke_cnt = shared("cgrp.incremental_revokes")
+
     def __init__(self, rk: "Kafka", group_id: str):
         self.rk = rk
         self.group_id = group_id
@@ -35,6 +64,21 @@ class ConsumerGroup:
         self.member_id = ""
         self.generation = -1
         self.protocol = ""
+        #: rebalance protocol of the broker-elected assignor
+        #: (rd_kafka_rebalance_protocol): NONE until the first
+        #: JoinGroup completes, then EAGER or COOPERATIVE
+        self.rebalance_protocol = "NONE"
+        #: guards ``assignment`` + ``incremental_revoke_cnt`` (leaf
+        #: lock: nothing else is ever acquired while held)
+        self._lock = new_lock("cgrp")
+        self.incremental_revoke_cnt = 0
+        # two-phase cooperative rebalance chain (KIP-429): the sync
+        # response's incremental revoke is delivered first; its ack
+        # chains the incremental assign; a non-empty revoke re-joins
+        # afterwards so the freed partitions land next generation
+        self._coop_active = False
+        self._coop_added: Optional[dict] = None
+        self._coop_rejoin = False
         self.subscription: list[str] = []
         self.patterns: list = []            # compiled ^regex subscriptions
         self._matched: set[str] = set()     # topics currently matching
@@ -156,7 +200,13 @@ class ConsumerGroup:
         self.rk.dbg("cgrp", f"rejoin: {reason}")
         self.sub_version += 1
         if self.join_state in ("started", "steady"):
-            self._trigger_rebalance_revoke()
+            # COOPERATIVE (KIP-429): rejoin WITHOUT revoking — the
+            # current assignment rides the JoinGroup as
+            # owned_partitions and every unrevoked partition keeps
+            # fetching through the whole rebalance; only the sync
+            # response's incremental revoke set ever stops a fetcher
+            if self.rebalance_protocol != "COOPERATIVE":
+                self._trigger_rebalance_revoke()
         self.join_state = "init"
 
     # ------------------------------------------------------------- serve --
@@ -261,8 +311,16 @@ class ConsumerGroup:
         self._pending = True
         self.join_state = "wait-join"
         self._join_version = self.sub_version
-        names = self.rk.conf.get("partition.assignment.strategy").split(",")
-        meta = subscription_encode(self.effective_subscription())
+        names = [n.strip() for n in
+                 self.rk.conf.get("partition.assignment.strategy").split(",")
+                 if n.strip()]
+        topics = self.effective_subscription()
+        meta = subscription_encode(topics)
+        with self._lock:
+            owned = {t: list(ps) for t, ps in self.assignment.items()}
+        # cooperative assignors get Subscription v1 with the member's
+        # current claims (KIP-429); eager ones keep the v0 encoding
+        coop_meta = subscription_encode(topics, owned=owned)
         self.rk.dbg("cgrp", f"joining group {self.group_id!r} "
                             f"member={self.member_id!r}")
         b.enqueue_request(Request(
@@ -275,8 +333,11 @@ class ConsumerGroup:
              "group_instance_id":
                  self.rk.conf.get("group.instance.id") or None,
              "protocol_type": self.rk.conf.get("group.protocol.type"),
-             "protocols": [{"name": n.strip(), "metadata": meta}
-                           for n in names if n.strip()]},
+             "protocols": [{"name": n,
+                            "metadata":
+                            (coop_meta if ASSIGNOR_PROTOCOLS.get(n)
+                             == "COOPERATIVE" else meta)}
+                           for n in names]},
             cb=self._handle_join,
             abs_timeout=time.monotonic() +
             self.rk.conf.get("max.poll.interval.ms") / 1000.0 + 5))
@@ -304,6 +365,7 @@ class ConsumerGroup:
         if ec in (Err.UNKNOWN_MEMBER_ID, Err.ILLEGAL_GENERATION):
             self.member_id = ""
             self.join_state = "init"
+            self._lost_assignment(ec.name)
             return
         if ec == Err.NOT_COORDINATOR or ec == Err.COORDINATOR_NOT_AVAILABLE:
             self.state = "init"
@@ -315,6 +377,8 @@ class ConsumerGroup:
         self.member_id = resp["member_id"]
         self.generation = resp["generation_id"]
         self.protocol = resp["protocol"]
+        self.rebalance_protocol = ASSIGNOR_PROTOCOLS.get(self.protocol,
+                                                         "EAGER")
         is_leader = resp["leader_id"] == self.member_id
         self.rk.dbg("cgrp", f"joined gen {self.generation} "
                             f"{'as leader' if is_leader else ''}")
@@ -325,9 +389,12 @@ class ConsumerGroup:
 
     def _run_assignor(self, members: list[dict]) -> list[dict]:
         """Leader-side assignment (reference: rd_kafka_assignor_run)."""
-        subs = {m["member_id"]:
-                subscription_decode(m["metadata"])["topics"]
-                for m in members}
+        subs = {}
+        owned = {}
+        for m in members:
+            d = subscription_decode(m["metadata"])
+            subs[m["member_id"]] = d["topics"]
+            owned[m["member_id"]] = d.get("owned_partitions") or {}
         all_topics = sorted({t for ts in subs.values() for t in ts})
         # partition counts from metadata (refresh if missing)
         with self.rk._metadata_lock:
@@ -337,7 +404,10 @@ class ConsumerGroup:
         if missing:
             self.rk.metadata_refresh(f"assignor needs {missing}")
         fn = ASSIGNORS.get(self.protocol, ASSIGNORS["range"])
-        per_member = fn(subs, parts)
+        if ASSIGNOR_PROTOCOLS.get(self.protocol) == "COOPERATIVE":
+            per_member = fn(subs, parts, owned)
+        else:
+            per_member = fn(subs, parts)
         return [{"member_id": m,
                  "assignment": assignment_encode(a)}
                 for m, a in per_member.items()]
@@ -364,15 +434,69 @@ class ConsumerGroup:
         if ec != Err.NO_ERROR:
             if ec in (Err.UNKNOWN_MEMBER_ID,):
                 self.member_id = ""
+                self._lost_assignment(ec.name)
             self.join_state = "init"
             return
         new_assignment = assignment_decode(resp["assignment"] or b"")
         self.rebalance_cnt += 1
         self.last_heartbeat = time.monotonic()
         self.rk.dbg("cgrp", f"assignment: {new_assignment}")
-        self._deliver_rebalance(Err._ASSIGN_PARTITIONS, new_assignment)
+        if self.rebalance_protocol == "COOPERATIVE":
+            self._apply_cooperative(new_assignment)
+        else:
+            self._deliver_rebalance(Err._ASSIGN_PARTITIONS, new_assignment)
 
-    def _deliver_rebalance(self, code: Err, assignment: dict):
+    # ------------------------------------- cooperative two-phase flow --
+    def _apply_cooperative(self, target: dict):
+        """KIP-429 incremental application of a sync response: deliver
+        only the revoked/added DELTAS — partitions in both the old and
+        new assignment are never touched and keep fetching through the
+        entire rebalance.  A non-empty revoke chains revoke → assign →
+        rejoin (the freed partitions land with their new owner next
+        generation — the assignor never moves a partition in the
+        generation it is revoked)."""
+        with self._lock:
+            owned = {t: list(ps) for t, ps in self.assignment.items()}
+        own = {(t, p) for t, ps in owned.items() for p in ps}
+        tgt = {(t, p) for t, ps in target.items() for p in ps}
+        revoked = _tps_dict(own - tgt)
+        added = _tps_dict(tgt - own)
+        self._coop_active = True
+        self._coop_added = added
+        self._coop_rejoin = bool(revoked)
+        self.rk.dbg("cgrp", f"cooperative delta: revoke={revoked} "
+                            f"add={added}")
+        if revoked:
+            with self._lock:
+                self.incremental_revoke_cnt += 1
+            self._deliver_rebalance(Err._REVOKE_PARTITIONS, revoked,
+                                    incremental=True)
+        else:
+            self._deliver_assign_phase()
+
+    def _deliver_assign_phase(self):
+        added = self._coop_added if self._coop_added is not None else {}
+        self._coop_added = None
+        self._deliver_rebalance(Err._ASSIGN_PARTITIONS, added,
+                                incremental=True)
+
+    def _coop_ack(self, assigned: bool):
+        """Advance the cooperative chain after an incremental assign/
+        unassign (the app's callback, or the auto-apply path)."""
+        self._wait_rebalance_cb = False
+        if not self._coop_active:
+            return          # manual incremental call outside a rebalance
+        if not assigned and self._coop_added is not None:
+            self._deliver_assign_phase()
+            return
+        rejoin = self._coop_rejoin
+        self._coop_active = False
+        self._coop_rejoin = False
+        self._coop_added = None
+        self.join_state = "init" if rejoin else "steady"
+
+    def _deliver_rebalance(self, code: Err, assignment: dict,
+                           incremental: bool = False):
         """Rebalance op to the app (or auto-apply)
         (reference: rd_kafka_cgrp_rebalance → op to app queue)."""
         consumer = self.rk.consumer
@@ -380,21 +504,57 @@ class ConsumerGroup:
             self.join_state = "wait-assign-rebalance-cb"
             self._wait_rebalance_cb = True
             consumer.queue.push(Op(OpType.REBALANCE,
-                                   payload=(code, assignment)))
-        else:
+                                   payload=(code, assignment, incremental)))
+            return
+        if incremental:
             if code == Err._ASSIGN_PARTITIONS:
-                consumer.apply_assignment(assignment)
+                consumer.apply_incremental_assign(assignment)
+                self._coop_ack(True)
             else:
-                consumer.apply_assignment({})
-            self.join_state = "steady"
+                consumer.apply_incremental_unassign(assignment)
+                self._coop_ack(False)
+            return
+        if code == Err._ASSIGN_PARTITIONS:
+            consumer.apply_assignment(assignment)
+        else:
+            consumer.apply_assignment({})
+        self.join_state = "steady"
 
     def rebalance_done(self, assigned: bool):
         """Called after the app's assign()/unassign() in the rebalance cb."""
+        if self._coop_active:
+            # the app answered a cooperative op (with either the
+            # incremental API or a full assign): drive the chain
+            self._coop_ack(assigned)
+            return
         self._wait_rebalance_cb = False
         self.join_state = "steady" if assigned else "init"
 
     def _trigger_rebalance_revoke(self):
-        self._deliver_rebalance(Err._REVOKE_PARTITIONS, self.assignment)
+        with self._lock:
+            assignment = {t: list(ps) for t, ps in self.assignment.items()}
+        self._deliver_rebalance(Err._REVOKE_PARTITIONS, assignment)
+
+    def _lost_assignment(self, why: str):
+        """This member's ownership is void (fenced / unknown member /
+        illegal generation): in cooperative mode every owned partition
+        must be revoked — incrementally, so the flow machinery stays on
+        the incremental path — before the fresh join claims nothing
+        (reference: rd_kafka_cgrp_assignment_lost)."""
+        if self.rebalance_protocol != "COOPERATIVE":
+            return
+        with self._lock:
+            owned = {t: list(ps) for t, ps in self.assignment.items()}
+        if not any(owned.values()):
+            return
+        self.rk.dbg("cgrp", f"assignment lost ({why}): revoking {owned}")
+        self._coop_active = True
+        self._coop_added = {}
+        self._coop_rejoin = True    # chain must end back at init
+        with self._lock:
+            self.incremental_revoke_cnt += 1
+        self._deliver_rebalance(Err._REVOKE_PARTITIONS, owned,
+                                incremental=True)
 
     # ---------------------------------------------------------- heartbeat --
     def _heartbeat(self):
@@ -416,13 +576,23 @@ class ConsumerGroup:
             return
         if ec == Err.REBALANCE_IN_PROGRESS:
             self.rk.dbg("cgrp", "group is rebalancing")
-            self._trigger_rebalance_revoke()
-            if not self._wait_rebalance_cb:
-                self.join_state = "init"
+            if self.rebalance_protocol == "COOPERATIVE":
+                # KIP-429: rejoin WITHOUT revoking — every owned
+                # partition keeps fetching; the sync response's
+                # incremental revoke is the only thing that stops one
+                if not self._wait_rebalance_cb:
+                    self.join_state = "init"
+            else:
+                self._trigger_rebalance_revoke()
+                if not self._wait_rebalance_cb:
+                    self.join_state = "init"
         elif ec in (Err.UNKNOWN_MEMBER_ID, Err.ILLEGAL_GENERATION,
                     Err.FENCED_INSTANCE_ID):
             self.member_id = "" if ec == Err.UNKNOWN_MEMBER_ID else self.member_id
             self.join_state = "init"
+            # ownership is void: cooperative members must drop their
+            # claims (and stop those fetchers) before rejoining
+            self._lost_assignment(ec.name)
         elif ec in (Err.NOT_COORDINATOR, Err.COORDINATOR_NOT_AVAILABLE):
             self.state = "init"
 

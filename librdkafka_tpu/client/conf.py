@@ -257,7 +257,13 @@ PROPERTIES: list[Prop] = [
     _p("group.instance.id", GLOBAL, "str", "",
        "Static membership instance id.", app=C),
     _p("partition.assignment.strategy", GLOBAL, "str", "range,roundrobin",
-       "Assignor names in preference order.", app=C),
+       "Assignor names in preference order: range, roundrobin (EAGER "
+       "protocol) and cooperative-sticky (KIP-429 COOPERATIVE "
+       "incremental rebalancing). The broker picks the first strategy "
+       "every group member supports, so a group mixing cooperative and "
+       "eager-only members downgrades to the common eager assignor; "
+       "list an eager fallback after cooperative-sticky for rolling "
+       "upgrades.", app=C),
     _p("session.timeout.ms", GLOBAL, "int", 10000, "Group session timeout.", app=C,
        vmin=1, vmax=3600000),
     _p("heartbeat.interval.ms", GLOBAL, "int", 3000, "Group heartbeat interval.", app=C,

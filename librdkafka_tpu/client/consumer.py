@@ -130,14 +130,19 @@ class Consumer:
 
     def _make_rebalance_cb(self, on_assign, on_revoke):
         def cb(consumer, code, partitions):
+            coop = consumer.rebalance_protocol() == "COOPERATIVE"
             if code == Err._ASSIGN_PARTITIONS:
                 if on_assign:
                     on_assign(consumer, partitions)
+                elif coop:
+                    consumer.incremental_assign(partitions)
                 else:
                     consumer.assign(partitions)
             else:
                 if on_revoke:
                     on_revoke(consumer, partitions)
+                elif coop:
+                    consumer.incremental_unassign(partitions)
                 else:
                     consumer.unassign()
         return cb
@@ -165,46 +170,72 @@ class Consumer:
         if self._rk.cgrp:
             self._rk.cgrp.rebalance_done(assigned=False)
 
+    def incremental_assign(self, partitions: list[TopicPartition]):
+        """KIP-429: ADD ``partitions`` to the current assignment —
+        every already-assigned partition is untouched and keeps
+        fetching (reference: rd_kafka_incremental_assign).  The
+        cooperative rebalance callback's assign-side answer."""
+        add: dict[str, list[int]] = {}
+        for tp in partitions:
+            add.setdefault(tp.topic, []).append(tp.partition)
+        self.apply_incremental_assign(
+            add, offsets={(tp.topic, tp.partition): tp.offset
+                          for tp in partitions})
+        if self._rk.cgrp:
+            self._rk.cgrp._coop_ack(True)
+
+    def incremental_unassign(self, partitions: list[TopicPartition]):
+        """KIP-429: REMOVE only ``partitions`` from the assignment
+        (reference: rd_kafka_incremental_unassign) — the cooperative
+        revoke-side answer; unrevoked fetchers never stop."""
+        rem: dict[str, list[int]] = {}
+        for tp in partitions:
+            rem.setdefault(tp.topic, []).append(tp.partition)
+        self.apply_incremental_unassign(rem)
+        if self._rk.cgrp:
+            self._rk.cgrp._coop_ack(False)
+
+    def rebalance_protocol(self) -> str:
+        """``NONE`` / ``EAGER`` / ``COOPERATIVE`` — the protocol of the
+        broker-elected assignor (rd_kafka_rebalance_protocol)."""
+        cg = self._rk.cgrp
+        return cg.rebalance_protocol if cg is not None else "NONE"
+
     def assignment(self) -> list[TopicPartition]:
         return [TopicPartition(t, p, tp.app_offset)
                 for (t, p), tp in self._assignment.items()]
 
-    def apply_assignment(self, assignment: dict[str, list[int]],
-                         offsets: Optional[dict] = None):
-        """Start/stop fetchers to match the assignment (reference:
-        rd_kafka_cgrp_assign → toppar OP_FETCH_START)."""
-        rk = self._rk
-        # generation stamp: an async committed-offset lookup from an
-        # OLDER apply_assignment call must not touch fetch state after
-        # an unassign/reassign bounce superseded it (it could resurrect
-        # an outdated committed offset and re-deliver messages)
-        self._assign_gen = getattr(self, "_assign_gen", 0) + 1
-        gen = self._assign_gen
-        new_keys = {(t, p) for t, ps in assignment.items() for p in ps}
-        # stop removed partitions
-        for key in list(self._assignment):
-            if key not in new_keys:
-                tp = self._assignment.pop(key)
-                tp.fetch_state = FetchState.STOPPED
-                tp.version += 1
-                tp.fetchq.forward_to(None)
-                with tp.lock:
-                    tp.fetchq_cnt = 0
-                    tp.fetchq_bytes = 0
-        if rk.cgrp:
-            rk.cgrp.assignment = assignment
-        if not new_keys:
+    def _sync_cgrp_assignment(self):
+        """Mirror the live membership into cgrp.assignment (the
+        owned_partitions source + stats gauge) under the cgrp lock."""
+        cgrp = self._rk.cgrp
+        if cgrp is None:
             return
-        # gather committed offsets for every partition whose fetcher
-        # hasn't STARTED — not merely "not registered": a registered
-        # partition whose async offset lookup was superseded (gen
-        # guard below) still needs a restart or it would sit in
-        # FetchState.NONE forever
-        need = [k for k in new_keys
-                if k not in self._assignment
-                or self._assignment[k].fetch_state
-                in (FetchState.NONE, FetchState.STOPPED)]
-        explicit = offsets or {}
+        current: dict[str, list[int]] = {}
+        for t, p in sorted(self._assignment):
+            current.setdefault(t, []).append(p)
+        with cgrp._lock:
+            cgrp.assignment = current
+
+    def _stop_partitions(self, keys):
+        for key in keys:
+            tp = self._assignment.pop(key, None)
+            if tp is None:
+                continue
+            tp.fetch_state = FetchState.STOPPED
+            tp.version += 1
+            tp.fetchq.forward_to(None)
+            with tp.lock:
+                tp.fetchq_cnt = 0
+                tp.fetchq_bytes = 0
+
+    def _start_partitions(self, need, explicit: dict, gen: Optional[int]):
+        """Register ``need`` synchronously, resolve committed offsets
+        asynchronously, then start the fetchers.  ``gen`` is the
+        full-assignment generation guard (None on incremental paths:
+        a later incremental change must not cancel unrelated pending
+        starts — per-key liveness is checked instead)."""
+        rk = self._rk
 
         # membership is registered SYNCHRONOUSLY (rd_kafka_assign sets
         # the assignment list before any async offset resolution —
@@ -216,7 +247,7 @@ class Consumer:
             tp.fetchq.forward_to(self.queue)
 
         def start(committed: dict):
-            if self._assign_gen != gen:
+            if gen is not None and self._assign_gen != gen:
                 return              # superseded by a newer assignment
             for key in need:
                 t, p = key
@@ -240,8 +271,6 @@ class Consumer:
                 rk._wake_leader(tp)
 
         if rk.cgrp and need:
-            done = {}
-
             def on_fetched(err, resp):
                 committed = {}
                 if err is None:
@@ -256,6 +285,58 @@ class Consumer:
                 start({})
         else:
             start({})
+
+    def apply_assignment(self, assignment: dict[str, list[int]],
+                         offsets: Optional[dict] = None):
+        """Start/stop fetchers to match the assignment (reference:
+        rd_kafka_cgrp_assign → toppar OP_FETCH_START)."""
+        # generation stamp: an async committed-offset lookup from an
+        # OLDER apply_assignment call must not touch fetch state after
+        # an unassign/reassign bounce superseded it (it could resurrect
+        # an outdated committed offset and re-deliver messages)
+        self._assign_gen = getattr(self, "_assign_gen", 0) + 1
+        gen = self._assign_gen
+        new_keys = {(t, p) for t, ps in assignment.items() for p in ps}
+        # stop removed partitions
+        self._stop_partitions([k for k in list(self._assignment)
+                               if k not in new_keys])
+        cgrp = self._rk.cgrp
+        if cgrp:
+            with cgrp._lock:
+                cgrp.assignment = assignment
+        if not new_keys:
+            return
+        # gather committed offsets for every partition whose fetcher
+        # hasn't STARTED — not merely "not registered": a registered
+        # partition whose async offset lookup was superseded (gen
+        # guard) still needs a restart or it would sit in
+        # FetchState.NONE forever
+        need = [k for k in new_keys
+                if k not in self._assignment
+                or self._assignment[k].fetch_state
+                in (FetchState.NONE, FetchState.STOPPED)]
+        self._start_partitions(need, offsets or {}, gen)
+
+    def apply_incremental_assign(self, assignment: dict[str, list[int]],
+                                 offsets: Optional[dict] = None):
+        """Start fetchers for ``assignment`` without touching any other
+        partition — the mechanics of ``incremental_assign`` (no join-
+        FSM side effects; cgrp calls this on the auto-apply path)."""
+        new_keys = {(t, p) for t, ps in assignment.items() for p in ps}
+        need = [k for k in sorted(new_keys)
+                if k not in self._assignment
+                or self._assignment[k].fetch_state
+                in (FetchState.NONE, FetchState.STOPPED)]
+        self._start_partitions(need, offsets or {}, None)
+        self._sync_cgrp_assignment()
+
+    def apply_incremental_unassign(self, assignment: dict[str, list[int]]):
+        """Stop ONLY the named fetchers; everything else keeps flowing
+        (the zero stop-the-world property the chaos continuity
+        invariant asserts)."""
+        self._stop_partitions([(t, p) for t, ps in assignment.items()
+                               for p in ps])
+        self._sync_cgrp_assignment()
 
     # --------------------------------------------------------------- poll --
     def _next_pending(self) -> Optional[Message]:
@@ -418,12 +499,25 @@ class Consumer:
             tp, msg, version = op.payload
             return msg if tp.version == version else None
         if op.type == OpType.REBALANCE:
-            code, assignment = op.payload
+            code, assignment, incremental = op.payload
             cb = rk.conf.get("rebalance_cb")
             parts = [TopicPartition(t, p) for t, ps in assignment.items()
                      for p in ps]
             if cb:
                 cb(self, code, parts)
+                if rk.cgrp is not None and rk.cgrp._wait_rebalance_cb:
+                    # the app's callback returned without answering
+                    # (no assign/unassign family call): apply the
+                    # default action so the join FSM can't wedge in
+                    # wait-assign-rebalance-cb (reference:
+                    # rd_kafka_poll_cb's rebalance op fallback)
+                    if code == Err._ASSIGN_PARTITIONS:
+                        (self.incremental_assign if incremental
+                         else self.assign)(parts)
+                    elif incremental:
+                        self.incremental_unassign(parts)
+                    else:
+                        self.unassign()
             return None
         # forwarded main-queue ops (errors/stats/logs): dispatch to the
         # same handlers rd_kafka_poll would use

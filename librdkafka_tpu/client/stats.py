@@ -206,9 +206,28 @@ class StatsCollector:
                 # codec_engine.devices[])
                 "devices": eng.devices_snapshot()}
         if rk.cgrp is not None:
-            blob["cgrp"] = {"state": rk.cgrp.join_state,
-                            "rebalance_cnt": rk.cgrp.rebalance_cnt,
-                            "assignment_size": len(rk.cgrp.assignment)}
+            cg = rk.cgrp
+            with cg._lock:
+                assignment_size = len(cg.assignment)
+                incremental_revokes = cg.incremental_revoke_cnt
+            # stuck partitions: assigned but not fetching (NONE /
+            # STOPPED after the rebalance settled) — steady state must
+            # read 0, the stats-level echo of the chaos continuity
+            # invariant (ISSUE 12)
+            stuck = 0
+            consumer = getattr(rk, "consumer", None)
+            if consumer is not None:
+                from .partition import FetchState
+                for tp in list(consumer._assignment.values()):
+                    if tp.fetch_state in (FetchState.NONE,
+                                          FetchState.STOPPED):
+                        stuck += 1
+            blob["cgrp"] = {"state": cg.join_state,
+                            "rebalance_cnt": cg.rebalance_cnt,
+                            "assignment_size": assignment_size,
+                            "rebalance_proto": cg.rebalance_protocol,
+                            "incremental_revokes": incremental_revokes,
+                            "stuck_partitions": stuck}
         if rk.idemp is not None:
             blob["eos"] = {"idemp_state": rk.idemp.state,
                            "producer_id": rk.idemp.pid,

@@ -193,6 +193,10 @@ def _run_consumer(spec: dict, bootstrap: str, ctl: _Stdin) -> dict:
         "client.id": name.replace(":", "-"),
         "auto.offset.reset": "earliest",
         "isolation.level": spec.get("isolation", "read_uncommitted"),
+        # strategy knob (ISSUE 12): "cooperative-sticky" runs the
+        # KIP-429 incremental protocol — fleet_storm exercises it
+        "partition.assignment.strategy":
+            spec.get("strategy", "range,roundrobin"),
         "heartbeat.interval.ms": 400,   # inside the mock's 3s rebalance
         "session.timeout.ms": 6000,     # window (PR 9 group tuning)
         "reconnect.backoff.ms": 50,
@@ -200,14 +204,25 @@ def _run_consumer(spec: dict, bootstrap: str, ctl: _Stdin) -> dict:
     })
 
     def _on_assign(cons, parts):
+        coop = cons.rebalance_protocol() == "COOPERATIVE"
         _emit({"type": "group", "event": "assign", "member": name,
+               "incremental": coop,
                "parts": [[tp.topic, tp.partition] for tp in parts]})
-        cons.assign(parts)
+        if coop:
+            cons.incremental_assign(parts)
+        else:
+            cons.assign(parts)
 
     def _on_revoke(cons, parts):
+        coop = cons.rebalance_protocol() == "COOPERATIVE"
         _emit({"type": "group", "event": "revoke", "member": name,
-               "parts": []})
-        cons.unassign()
+               "incremental": coop,
+               "parts": [[tp.topic, tp.partition] for tp in parts]
+               if coop else []})
+        if coop:
+            cons.incremental_unassign(parts)
+        else:
+            cons.unassign()
 
     rows: list = []
     consumed = 0

@@ -182,12 +182,20 @@ class FleetDriver:  # lint: ok shared-state
                 for r in msg["rows"]:
                     oracle.record_failed(r[0], r[1], r[2], None, r[3])
             elif t == "group":
+                # cooperative workers flag incremental deltas (KIP-429)
+                # and revokes carry their partition set; eager events
+                # keep the full-replace / full-revoke semantics
                 if msg["event"] == "assign":
                     oracle.record_assign(
                         msg["member"],
-                        [(p[0], p[1]) for p in msg["parts"]])
+                        [(p[0], p[1]) for p in msg["parts"]],
+                        incremental=bool(msg.get("incremental")))
                 elif msg["event"] == "revoke":
-                    oracle.record_revoke(msg["member"])
+                    parts = msg.get("parts") or None
+                    oracle.record_revoke(
+                        msg["member"],
+                        [(p[0], p[1]) for p in parts]
+                        if msg.get("incremental") and parts else None)
             elif t == "poll":
                 oracle.record_poll(msg["member"])
             elif t == "stats":

@@ -47,6 +47,7 @@ class FleetRun:
                  producers: int = 2, groups: int = 1, group_size: int = 2,
                  shape: Optional[dict] = None, keys: Optional[dict] = None,
                  hot_partition_weight: float = 0.0,
+                 strategy: str = "range,roundrobin",
                  min_alive: int = 1, duration_s: float = 3.0,
                  drain_s: float = 30.0, converge_s: float = 25.0,
                  worker_max_s: float = 120.0):
@@ -62,6 +63,7 @@ class FleetRun:
             group_size=group_size, topics=[topic], partitions=partitions,
             shape=shape, keys=keys,
             hot_partition_weight=hot_partition_weight,
+            strategy=strategy,
             max_s=worker_max_s)
         self.driver = FleetDriver(self.handle.bootstrap_servers(),
                                   self.plan)
@@ -178,6 +180,7 @@ def fleet_smoke(seed: int = 51, *,
 
 def fleet_storm(seed: int = 61, *, producers: int = 16,
                 groups: int = 2, group_size: int = 4,
+                strategy: str = "cooperative-sticky",
                 raise_on_violation: bool = True) -> dict:
     """FLAGSHIP (ISSUE 11): ≥24 real client OS processes — 16
     producers under a diurnal+burst traffic shape with Zipf hot keys
@@ -186,13 +189,16 @@ def fleet_storm(seed: int = 61, *, producers: int = 16,
     the 3-broker supervised cluster, sustaining 3 pid-verified
     SIGKILL/respawn cycles, one asymmetric rx-drop brownout, and one
     disk-full/EIO window.  Per-group merged-oracle verify: zero acked
-    loss, exact final coverage, convergence, nobody stuck."""
+    loss, exact final coverage, convergence, nobody stuck.  Since
+    ISSUE 12 the consumer groups run the KIP-429 cooperative protocol
+    (``strategy`` knob; pass ``"range"`` for the eager baseline)."""
     run = FleetRun(seed=seed, brokers=3, partitions=8,
                    producers=producers, groups=groups,
                    group_size=group_size,
                    shape=stack(diurnal(8.0, 30.0, 6.0),
                                bursts(0.0, 25.0, 2.0, 0.3)),
                    keys=zipf(200, 1.2), hot_partition_weight=0.6,
+                   strategy=strategy,
                    min_alive=2, duration_s=9.5,
                    drain_s=45.0, converge_s=30.0,
                    worker_max_s=180.0)

@@ -189,6 +189,7 @@ class TrafficPlan:
                  keys: Optional[dict] = None,
                  hot_partition_weight: float = 0.0,
                  isolation: str = "read_uncommitted",
+                 strategy: str = "range,roundrobin",
                  max_s: float = 120.0):
         self.seed = seed
         self.topics = list(topics) if topics else ["fleet"]
@@ -222,6 +223,7 @@ class TrafficPlan:
                     "role": "consumer", "name": f"g{g}:c{m}",
                     "group": f"fleet-g{g}-{seed}", "group_idx": g,
                     "topics": self.topics, "isolation": isolation,
+                    "strategy": strategy,
                     "seed": rng.randrange(1 << 31), "max_s": max_s})
         self.n_groups = groups
 

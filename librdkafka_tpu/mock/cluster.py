@@ -164,7 +164,19 @@ class MockGroup:
     members: dict[str, GroupMember] = field(default_factory=dict)
     offsets: dict[tuple[str, int], tuple[int, Optional[str]]] = field(default_factory=dict)
     rebalance_deadline: float = 0.0
+    # KIP-134 initial-rebalance hold: the first generation of a fresh
+    # group stays open until this stamp (see MockCluster
+    # group_initial_rebalance_delay_ms)
+    hold_until: float = 0.0
     pending_syncs: list[tuple] = field(default_factory=list)  # (conn, corrid, member_id)
+    # ownership book (ISSUE 12): (topic, partition) -> member_id as of
+    # the LAST completed sync, plus the cooperative-protocol violations
+    # the validator caught — a partition handed to a new owner in the
+    # same generation its old owner still held it (KIP-429 forbids the
+    # move without an intermediate revoke generation), or double-owned
+    # within one generation.  Tests assert the list stays empty.
+    owned: dict[tuple[str, int], str] = field(default_factory=dict)
+    validation_errors: list[dict] = field(default_factory=list)
 
 
 @dataclass
@@ -206,8 +218,17 @@ class MockCluster:
                  tls: Optional[dict] = None,
                  sasl_users: Optional[dict] = None,
                  broker_version: Optional[str] = None,
-                 retention_bytes: int = 0):
-        """``tls``: enable the TLS listener mode —
+                 retention_bytes: int = 0,
+                 group_initial_rebalance_delay_ms: int = 0):
+        """``group_initial_rebalance_delay_ms``: real brokers hold a
+        brand-new (Empty) group's FIRST rebalance open for
+        ``group.initial.rebalance.delay.ms`` (default 3000 there, 0
+        here to keep tests instant) so a starting fleet joins one
+        generation instead of the first member grabbing every
+        partition and immediately redistributing — exactly the
+        mass-move the cooperative assignor otherwise pays for.
+
+        ``tls``: enable the TLS listener mode —
         ``{"certfile": ..., "keyfile": ..., "cafile": ...,
         "require_client_cert": bool}``. All mock brokers then speak TLS
         (like a real cluster with an SSL listener); clients must set
@@ -238,6 +259,8 @@ class MockCluster:
         # per-partition size retention for long-running/benchmark use
         # (real brokers: log.retention.bytes); 0 keeps everything
         self.retention_bytes = retention_bytes
+        self.group_initial_delay_s = group_initial_rebalance_delay_ms \
+            / 1000.0
         # the cluster tables are declared shared (analysis/races.py),
         # RELAXED with one justification: every handler and chaos
         # controller hook (kill/restart/migrate from the scheduler
@@ -1173,6 +1196,34 @@ class MockCluster:
                                             hdr["client_id"] or "member")
             if static_id is not None:
                 member_id = static_id
+                m = g.members.get(member_id)
+                if m is not None and g.state == "Stable" \
+                        and self._static_rejoin_ok(m, body):
+                    # KIP-345 static rejoin fast path: a known
+                    # group.instance.id returning while the group is
+                    # Stable reclaims its slot at the CURRENT
+                    # generation — no rebalance, nobody else revokes
+                    # anything; SyncGroup serves the retained
+                    # assignment (real broker behavior for static
+                    # members inside session.timeout.ms)
+                    m.protocols = [(p["name"], p["metadata"])
+                                   for p in body["protocols"]]
+                    m.metadata = m.protocols[0][1] if m.protocols else b""
+                    m.session_timeout_ms = body["session_timeout"]
+                    m.last_heartbeat = time.monotonic()
+                    members_meta = [
+                        {"member_id": mm.member_id,
+                         "group_instance_id": getattr(mm, "instance_id",
+                                                      None),
+                         "metadata": dict(mm.protocols).get(g.protocol,
+                                                            b"")}
+                        for mm in g.members.values()]
+                    return {"throttle_time_ms": 0, "error_code": 0,
+                            "generation_id": g.generation,
+                            "protocol": g.protocol, "leader_id": g.leader,
+                            "member_id": member_id,
+                            "members": (members_meta
+                                        if member_id == g.leader else [])}
             if not member_id:
                 member_id = f"{hdr['client_id'] or 'member'}-{len(g.members) + 1}-{int(time.monotonic()*1e6) & 0xFFFF}"
             m = g.members.get(member_id)
@@ -1189,16 +1240,62 @@ class MockCluster:
             g.protocol_type = body["protocol_type"]
             m.pending_join = (conn, corrid, hdr["api_version"])
             if g.state in ("Empty", "Stable", "CompletingRebalance"):
+                was_empty = g.state == "Empty"
                 g.state = "PreparingRebalance"
                 g.rebalance_deadline = time.monotonic() + min(
                     body.get("rebalance_timeout", 3000), 3000) / 1000.0
+                if was_empty and self.group_initial_delay_s > 0:
+                    # KIP-134 group.initial.rebalance.delay.ms: hold
+                    # the FIRST generation open so a starting fleet
+                    # joins together
+                    g.hold_until = (time.monotonic()
+                                    + self.group_initial_delay_s)
+                    g.rebalance_deadline = max(g.rebalance_deadline,
+                                               g.hold_until)
             # complete immediately if every member has rejoined
             self._maybe_complete_join(g)
         return None  # parked; responded by _maybe_complete_join / timer
 
+    @staticmethod
+    def _static_rejoin_ok(m, body) -> bool:
+        """Whether a known static member's JoinGroup may take the
+        no-rebalance fast path: its effective subscription (protocol
+        names + topic lists) must be unchanged, AND it must be either
+        a fresh restart reclaiming its slot (empty member_id — the new
+        instance never knew its id) or the live member itself.  A LIVE
+        cooperative member rejoining after an incremental revoke
+        carries a CHANGED owned_partitions set and an explicit
+        member_id — that rejoin exists to trigger the next generation
+        and must NOT be swallowed (real GroupCoordinator semantics:
+        updateMemberAndRebalance when the protocols changed)."""
+        from ..client.assignor import subscription_decode
+
+        def sig(protocols):
+            out = []
+            for name, meta in protocols:
+                try:
+                    out.append((name, tuple(
+                        subscription_decode(meta)["topics"])))
+                except Exception:
+                    out.append((name, bytes(meta)))
+            return out
+
+        new = [(p["name"], bytes(p["metadata"])) for p in body["protocols"]]
+        old = [(n, bytes(b)) for n, b in m.protocols]
+        if not body["member_id"]:
+            # fresh restart reclaiming the slot: the new instance never
+            # knew its owned set, so compare topics only
+            return sig(new) == sig(old)
+        # live member: byte-exact metadata match — a cooperative
+        # rejoin after an incremental revoke differs in
+        # owned_partitions and must trigger the next generation
+        return body["member_id"] == m.member_id and new == old
+
     def _maybe_complete_join(self, g: MockGroup):
         if g.state != "PreparingRebalance":
             return
+        if time.monotonic() < g.hold_until:
+            return          # initial-rebalance delay window still open
         if any(m.pending_join is None for m in g.members.values()):
             return
         self._complete_join(g)
@@ -1272,6 +1369,7 @@ class MockCluster:
                 for a in body["assignments"]:
                     if a["member_id"] in g.members:
                         g.members[a["member_id"]].assignment = a["assignment"]
+                self._validate_group_assignment(g)
                 g.state = "Stable"
                 # flush parked syncs; a parked member that was dropped
                 # meanwhile (never rejoined before the rebalance window
@@ -1296,6 +1394,42 @@ class MockCluster:
             g.pending_syncs.append((conn, corrid, body["member_id"],
                                     hdr["api_version"]))
             return None
+
+    def _validate_group_assignment(self, g: MockGroup):
+        """ISSUE 12 ownership validation (called under ``self._lock``
+        when a leader sync lands): decode every member's embedded-
+        protocol assignment, flag (a) partitions owned by two members
+        in ONE generation and (b) — for COOPERATIVE protocols — a
+        partition handed to a new owner in the same generation its
+        previous owner lost it (KIP-429 requires an intermediate
+        generation where nobody owns it).  Violations are recorded in
+        ``g.validation_errors`` for tests/oracles; the wire response
+        is unchanged (a real broker treats assignments as opaque)."""
+        from ..client.assignor import ASSIGNOR_PROTOCOLS, assignment_decode
+        new_owned: dict[tuple[str, int], str] = {}
+        for mid, m in g.members.items():
+            try:
+                asn = assignment_decode(m.assignment or b"")
+            except Exception:
+                continue            # opaque/foreign protocol bytes
+            for t, ps in asn.items():
+                for p in ps:
+                    prev = new_owned.get((t, p))
+                    if prev is not None and prev != mid:
+                        g.validation_errors.append(
+                            {"kind": "double_owner", "gen": g.generation,
+                             "topic": t, "partition": p,
+                             "members": sorted((prev, mid))})
+                    new_owned[(t, p)] = mid
+        if ASSIGNOR_PROTOCOLS.get(g.protocol) == "COOPERATIVE":
+            for tp, mid in new_owned.items():
+                old = g.owned.get(tp)
+                if old is not None and old != mid and old in g.members:
+                    g.validation_errors.append(
+                        {"kind": "moved_without_revoke",
+                         "gen": g.generation, "topic": tp[0],
+                         "partition": tp[1], "from": old, "to": mid})
+        g.owned = new_owned
 
     def _h_Heartbeat(self, conn, corrid, hdr, body, inject):
         if inject:
@@ -1331,10 +1465,22 @@ class MockCluster:
         g = self._group(body["group_id"])
         out = []
         with self._lock:
+            # generation/membership validation (ISSUE 12; real broker
+            # GroupCoordinator semantics): a group-member commit
+            # (generation >= 0) must name a live member at the current
+            # generation — a fenced/zombie member's commit is rejected
+            # so its offsets can't clobber the new owner's.  Simple
+            # consumers commit with generation -1 and skip the check.
+            gen_err = Err.NO_ERROR
+            if body.get("generation_id", -1) >= 0:
+                if body.get("member_id") not in g.members:
+                    gen_err = Err.UNKNOWN_MEMBER_ID
+                elif body["generation_id"] != g.generation:
+                    gen_err = Err.ILLEGAL_GENERATION
             for t in body["topics"]:
                 tp = {"topic": t["topic"], "partitions": []}
                 for p in t["partitions"]:
-                    err = inject or Err.NO_ERROR
+                    err = inject or gen_err or Err.NO_ERROR
                     if err == Err.NO_ERROR:
                         g.offsets[(t["topic"], p["partition"])] = (
                             p["offset"], p["metadata"])

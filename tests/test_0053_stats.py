@@ -402,3 +402,50 @@ def test_stats_blob_codec_engine_governor_counters():
     for field in ("enabled", "warmup", "interarrival_us",
                   "cpu_ns_per_byte", "dev_launch_ms"):
         assert field in gov, field
+
+
+def test_cgrp_blob_cooperative_fields():
+    """ISSUE 12 cross-check: the cgrp blob's rebalance_proto /
+    incremental_revokes / stuck_partitions track the live cooperative
+    state — a steady cooperative member reports COOPERATIVE, zero
+    stuck partitions, and the incremental-revoke counter matches the
+    cgrp's own."""
+    import time as _time
+
+    from librdkafka_tpu import Consumer, Producer
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"cb": 2})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "linger.ms": 2})
+        for i in range(6):
+            p.produce("cb", value=b"v%d" % i, partition=i % 2)
+        assert p.flush(10) == 0
+        p.close()
+
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "cb-g",
+                      "partition.assignment.strategy":
+                          "cooperative-sticky",
+                      "auto.offset.reset": "earliest"})
+        c.subscribe(["cb"])
+        got = 0
+        deadline = _time.monotonic() + 15
+        while got < 6 and _time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 6
+        blob = json.loads(c._rk.stats.emit_json())
+        cg = blob["cgrp"]
+        assert cg["rebalance_proto"] == "COOPERATIVE"
+        assert cg["state"] == "steady"
+        assert cg["stuck_partitions"] == 0
+        with c._rk.cgrp._lock:
+            want = c._rk.cgrp.incremental_revoke_cnt
+        assert cg["incremental_revokes"] == want
+        # a pre-join producer-side instance reports NONE
+        c.close()
+    finally:
+        cluster.stop()

@@ -1,0 +1,616 @@
+"""ISSUE 12: cooperative incremental rebalance — KIP-429 end to end.
+
+Covers the cooperative-sticky assignor (stickiness + the
+never-move-in-the-revoking-generation invariant), Subscription v1
+owned_partitions marshalling, the client's two-phase incremental
+revoke→assign→rejoin flow with `incremental_assign`/
+`incremental_unassign`, the mock broker's static-member fast path and
+generation/ownership validation, the oracle's continuity (flow-gap)
+invariant + convergence bound, the thread-cheap LiteMemberFleet churn
+harness, and the chaos scenarios built on all of it (tier-1 smoke +
+the ≥300-member flagship with a pid-verified coordinator SIGKILL).
+"""
+import json
+import os
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.chaos.members import LiteMemberFleet
+from librdkafka_tpu.chaos.oracle import DeliveryOracle, OracleViolation
+from librdkafka_tpu.client.assignor import (
+    ASSIGNOR_PROTOCOLS, cooperative_sticky_assignor, subscription_decode,
+    subscription_encode)
+from librdkafka_tpu.mock.cluster import GroupMember, MockCluster, MockGroup
+
+
+# ===================================================== assignor unit ==
+class TestCooperativeStickyAssignor:
+    def test_fresh_group_balanced(self):
+        out = cooperative_sticky_assignor(
+            {"a": ["t"], "b": ["t"]}, {"t": 4})
+        assert sorted(out["a"].get("t", []) + out["b"].get("t", [])) \
+            == [0, 1, 2, 3]
+        assert abs(len(out["a"].get("t", []))
+                   - len(out["b"].get("t", []))) <= 1
+
+    def test_sticky_keeps_owned(self):
+        owned = {"a": {"t": [0, 1]}, "b": {"t": [2, 3]}}
+        out = cooperative_sticky_assignor(
+            {"a": ["t"], "b": ["t"]}, {"t": 4}, owned)
+        assert out["a"]["t"] == [0, 1]
+        assert out["b"]["t"] == [2, 3]
+
+    def test_never_moves_in_revoking_generation(self):
+        """One member owns the world; a second joins.  The overloaded
+        member is stripped down, but the stripped partitions go to
+        NOBODY this generation — the old owner must revoke first."""
+        owned = {"a": {"t": [0, 1, 2, 3]}}
+        out = cooperative_sticky_assignor(
+            {"a": ["t"], "b": ["t"]}, {"t": 4}, owned)
+        a = set(out["a"].get("t", []))
+        b = set(out["b"].get("t", []))
+        assert a < {0, 1, 2, 3} and len(a) == 2
+        assert not b, "moved partitions must sit out one generation"
+        # next generation: a's claims shrank, the freed ones are free
+        owned2 = {"a": {"t": sorted(a)}}
+        out2 = cooperative_sticky_assignor(
+            {"a": ["t"], "b": ["t"]}, {"t": 4}, owned2)
+        assert set(out2["a"]["t"]) == a, "stickiness across generations"
+        assert set(out2["b"]["t"]) == {0, 1, 2, 3} - a
+
+    def test_conflicting_claims_sit_out(self):
+        """A partition claimed by two members (zombie overlap) is kept
+        by neither — both revoke, the next generation reassigns."""
+        owned = {"a": {"t": [0, 1]}, "b": {"t": [1, 2]}}
+        out = cooperative_sticky_assignor(
+            {"a": ["t"], "b": ["t"]}, {"t": 4}, owned)
+        assert 1 not in out["a"].get("t", [])
+        assert 1 not in out["b"].get("t", [])
+
+    def test_claims_on_unsubscribed_topic_dropped(self):
+        owned = {"a": {"gone": [0]}}
+        out = cooperative_sticky_assignor(
+            {"a": ["t"], "b": ["t"]}, {"t": 2}, owned)
+        all_parts = sorted(out["a"].get("t", []) + out["b"].get("t", []))
+        assert all_parts == [0, 1]
+        assert not out["a"].get("gone")
+
+    def test_protocol_registry(self):
+        assert ASSIGNOR_PROTOCOLS["cooperative-sticky"] == "COOPERATIVE"
+        assert ASSIGNOR_PROTOCOLS["range"] == "EAGER"
+        assert ASSIGNOR_PROTOCOLS["roundrobin"] == "EAGER"
+
+
+class TestSubscriptionV1:
+    def test_owned_roundtrip(self):
+        blob = subscription_encode(["t1", "t2"],
+                                   owned={"t1": [2, 0], "t2": []})
+        d = subscription_decode(blob)
+        assert d["version"] == 1
+        assert d["topics"] == ["t1", "t2"]
+        assert d["owned_partitions"] == {"t1": [0, 2]}
+
+    def test_v0_compat(self):
+        d = subscription_decode(subscription_encode(["t"]))
+        assert d["version"] == 0
+        assert d["owned_partitions"] == {}
+
+
+# ================================================ client two-phase ==
+def _consume_n(c, n, timeout=20):
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got.append(m.value)
+    return got
+
+
+def _wait(cond, timeout=15, tick=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if tick is not None:
+            tick()
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestCooperativeClient:
+    def _mk(self, cluster, i, **extra):
+        conf = {"bootstrap.servers": cluster.bootstrap_servers(),
+                "group.id": "coop-g", "client.id": f"c{i}",
+                "partition.assignment.strategy": "cooperative-sticky",
+                "auto.offset.reset": "earliest",
+                "heartbeat.interval.ms": 300,
+                "session.timeout.ms": 6000}
+        conf.update(extra)
+        return Consumer(conf)
+
+    def test_incremental_two_phase_keeps_survivors_fetching(self):
+        """Second member joins: the first keeps half WITHOUT its
+        fetchers restarting (toppar.version unchanged = the fetch
+        stream was never interrupted), revokes the other half
+        incrementally, and the mock's cooperative ownership validator
+        sees no same-generation move."""
+        cluster = MockCluster(num_brokers=1, topics={"ct": 4})
+        try:
+            p = Producer({"bootstrap.servers":
+                          cluster.bootstrap_servers(), "linger.ms": 2})
+            for i in range(40):
+                p.produce("ct", value=b"m%d" % i, partition=i % 4)
+            assert p.flush(10) == 0
+            p.close()
+
+            c1 = self._mk(cluster, 1)
+            c1.subscribe(["ct"])
+            assert len(_consume_n(c1, 40)) == 40
+            assert c1.rebalance_protocol() == "COOPERATIVE"
+            assert len(c1.assignment()) == 4
+            vers_before = {(tp.topic, tp.partition):
+                           c1._rk.get_toppar(tp.topic, tp.partition).version
+                           for tp in c1.assignment()}
+
+            c2 = self._mk(cluster, 2)
+            c2.subscribe(["ct"])
+            ok = _wait(lambda: len(c1.assignment()) == 2
+                       and len(c2.assignment()) == 2,
+                       tick=lambda: (c1.poll(0.05), c2.poll(0.05)))
+            assert ok, (c1.assignment(), c2.assignment())
+            s1 = {(tp.topic, tp.partition) for tp in c1.assignment()}
+            s2 = {(tp.topic, tp.partition) for tp in c2.assignment()}
+            assert not (s1 & s2) and len(s1 | s2) == 4
+            # the kept fetchers were NEVER stopped/restarted: version
+            # bumps only on stop/seek — zero stop-the-world
+            for key in s1:
+                assert c1._rk.get_toppar(*key).version \
+                    == vers_before[key], f"kept fetcher {key} bounced"
+            with c1._rk.cgrp._lock:
+                assert c1._rk.cgrp.incremental_revoke_cnt >= 1
+            g = cluster.groups["coop-g"]
+            assert g.validation_errors == []
+            assert g.protocol == "cooperative-sticky"
+            c1.close()
+            c2.close()
+        finally:
+            cluster.stop()
+
+    def test_incremental_assign_unassign_api(self):
+        """Manual incremental_assign/unassign compose the assignment
+        without disturbing unrelated partitions."""
+        from librdkafka_tpu.client.consumer import TopicPartition
+        cluster = MockCluster(num_brokers=1, topics={"ia": 4})
+        try:
+            c = Consumer({"bootstrap.servers":
+                          cluster.bootstrap_servers(),
+                          "group.id": "ia-g",
+                          "auto.offset.reset": "earliest"})
+            from librdkafka_tpu.client.partition import FetchState
+            c.incremental_assign([TopicPartition("ia", 0),
+                                  TopicPartition("ia", 1)])
+            assert len(c.assignment()) == 2
+            # wait out the async fetcher start (it bumps version once
+            # on activation) before sampling the stability baseline
+            assert _wait(lambda: c._rk.get_toppar("ia", 0).fetch_state
+                         in (FetchState.ACTIVE, FetchState.OFFSET_QUERY))
+            v0 = c._rk.get_toppar("ia", 0).version
+            c.incremental_assign([TopicPartition("ia", 2)])
+            assert len(c.assignment()) == 3
+            c.incremental_unassign([TopicPartition("ia", 1)])
+            keys = {(tp.topic, tp.partition) for tp in c.assignment()}
+            assert keys == {("ia", 0), ("ia", 2)}
+            assert c._rk.get_toppar("ia", 0).version == v0, \
+                "unrelated partition bounced by incremental ops"
+            c.close()
+        finally:
+            cluster.stop()
+
+    def test_mixed_protocol_downgrades_to_eager(self):
+        """A group with one cooperative+range member and one
+        range-only member settles on the common EAGER assignor."""
+        cluster = MockCluster(num_brokers=1, topics={"mx": 2})
+        try:
+            c1 = self._mk(cluster, 1, **{
+                "partition.assignment.strategy":
+                    "cooperative-sticky,range"})
+            c1.subscribe(["mx"])
+            _wait(lambda: c1._rk.cgrp.join_state == "steady",
+                  tick=lambda: c1.poll(0.05))
+            assert c1.rebalance_protocol() == "COOPERATIVE"
+            c2 = self._mk(cluster, 2, **{
+                "partition.assignment.strategy": "range"})
+            c2.subscribe(["mx"])
+            ok = _wait(lambda: c1.rebalance_protocol() == "EAGER"
+                       and c2._rk.cgrp.join_state == "steady",
+                       tick=lambda: (c1.poll(0.05), c2.poll(0.05)))
+            assert ok, c1.rebalance_protocol()
+            assert cluster.groups["coop-g"].protocol == "range"
+            c1.close()
+            c2.close()
+        finally:
+            cluster.stop()
+
+
+# ====================================== static × cooperative (KIP-345) ==
+class TestStaticCooperative:
+    def test_static_restart_reclaims_exact_assignment_zero_revokes(self):
+        """ISSUE 12 satellite: a group.instance.id member restarting
+        within session.timeout.ms reclaims its EXACT prior assignment
+        at the same generation — the other member sees no revoke (its
+        rebalance_cnt and incremental_revoke_cnt stay flat, its
+        fetcher versions never bump)."""
+        cluster = MockCluster(num_brokers=1, topics={"sm": 4})
+        try:
+            conf = {"bootstrap.servers": cluster.bootstrap_servers(),
+                    "group.id": "gstat",
+                    "partition.assignment.strategy": "cooperative-sticky",
+                    "auto.offset.reset": "earliest",
+                    "heartbeat.interval.ms": 300,
+                    "session.timeout.ms": 30000}
+            other = Consumer(dict(conf, **{"group.instance.id": "n-2",
+                                           "client.id": "other"}))
+            other.subscribe(["sm"])
+            stat = Consumer(dict(conf, **{"group.instance.id": "n-1",
+                                          "client.id": "stat"}))
+            stat.subscribe(["sm"])
+            ok = _wait(lambda: len(other.assignment()) == 2
+                       and len(stat.assignment()) == 2,
+                       tick=lambda: (other.poll(0.05), stat.poll(0.05)))
+            assert ok
+            prior = sorted((tp.topic, tp.partition)
+                           for tp in stat.assignment())
+            gen_before = cluster.groups["gstat"].generation
+            other_reb = other._rk.cgrp.rebalance_cnt
+            with other._rk.cgrp._lock:
+                other_rev = other._rk.cgrp.incremental_revoke_cnt
+            other_vers = {(tp.topic, tp.partition):
+                          other._rk.get_toppar(tp.topic,
+                                               tp.partition).version
+                          for tp in other.assignment()}
+            mid = stat._rk.cgrp.member_id
+            stat.close()
+
+            stat2 = Consumer(dict(conf, **{"group.instance.id": "n-1",
+                                           "client.id": "stat"}))
+            stat2.subscribe(["sm"])
+            ok = _wait(lambda: sorted(
+                (tp.topic, tp.partition)
+                for tp in stat2.assignment()) == prior,
+                tick=lambda: (other.poll(0.05), stat2.poll(0.05)))
+            assert ok, stat2.assignment()
+            assert stat2._rk.cgrp.member_id == mid
+            g = cluster.groups["gstat"]
+            assert g.generation == gen_before, \
+                "static rejoin must not bump the generation"
+            # keep polling a moment: no revoke may reach the survivor
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                other.poll(0.05)
+            assert other._rk.cgrp.rebalance_cnt == other_reb
+            with other._rk.cgrp._lock:
+                assert other._rk.cgrp.incremental_revoke_cnt == other_rev
+            for key, v in other_vers.items():
+                assert other._rk.get_toppar(*key).version == v, \
+                    f"survivor fetcher {key} bounced on static rejoin"
+            assert g.validation_errors == []
+            stat2.close()
+            other.close()
+        finally:
+            cluster.stop()
+
+
+# ============================================== mock-side validation ==
+class TestMockValidation:
+    def test_offset_commit_generation_fencing(self):
+        """A zombie member's commit (stale generation / unknown member)
+        is rejected per real GroupCoordinator semantics; simple
+        consumers (generation -1) pass."""
+        from librdkafka_tpu.client.errors import Err
+        cluster = MockCluster(num_brokers=1, topics={"oc": 1})
+        try:
+            g = cluster._group("ocg")
+            with cluster._lock:
+                g.generation = 5
+                g.members["alive"] = GroupMember(
+                    member_id="alive", client_id="x", client_host="h")
+
+            def commit(gen, member):
+                return cluster._h_OffsetCommit(
+                    None, 0, {}, {"group_id": "ocg",
+                                  "generation_id": gen,
+                                  "member_id": member,
+                                  "topics": [{"topic": "oc",
+                                              "partitions": [
+                                                  {"partition": 0,
+                                                   "offset": 7,
+                                                   "metadata": None}]}]},
+                    None)
+
+            ec = commit(5, "alive")["topics"][0]["partitions"][0][
+                "error_code"]
+            assert ec == 0
+            ec = commit(4, "alive")["topics"][0]["partitions"][0][
+                "error_code"]
+            assert ec == Err.ILLEGAL_GENERATION.wire
+            ec = commit(5, "ghost")["topics"][0]["partitions"][0][
+                "error_code"]
+            assert ec == Err.UNKNOWN_MEMBER_ID.wire
+            ec = commit(-1, "")["topics"][0]["partitions"][0][
+                "error_code"]
+            assert ec == 0, "simple-consumer commits skip the check"
+            assert g.offsets[("oc", 0)][0] == 7
+        finally:
+            cluster.stop()
+
+    def test_ownership_validator_flags_same_generation_move(self):
+        """A cooperative leader assignment moving a partition directly
+        from a live member to another (no intermediate revoke
+        generation) — and double-owning one — is recorded."""
+        from librdkafka_tpu.client.assignor import assignment_encode
+        cluster = MockCluster(num_brokers=1)
+        try:
+            g = MockGroup(group_id="vg", protocol="cooperative-sticky")
+            g.members["a"] = GroupMember("a", "x", "h")
+            g.members["b"] = GroupMember("b", "x", "h")
+            g.generation = 1
+            g.members["a"].assignment = assignment_encode({"t": [0, 1]})
+            g.members["b"].assignment = assignment_encode({"t": [2]})
+            with cluster._lock:
+                cluster._validate_group_assignment(g)
+            assert g.validation_errors == []
+            # gen 2: partition 0 jumps a -> b while a is still live
+            g.generation = 2
+            g.members["a"].assignment = assignment_encode({"t": [1]})
+            g.members["b"].assignment = assignment_encode({"t": [0, 2]})
+            with cluster._lock:
+                cluster._validate_group_assignment(g)
+            kinds = [e["kind"] for e in g.validation_errors]
+            assert "moved_without_revoke" in kinds, g.validation_errors
+            # double ownership within one generation
+            g.generation = 3
+            g.members["a"].assignment = assignment_encode({"t": [1, 2]})
+            with cluster._lock:
+                cluster._validate_group_assignment(g)
+            kinds = [e["kind"] for e in g.validation_errors]
+            assert "double_owner" in kinds
+        finally:
+            cluster.stop()
+
+
+# ================================================= oracle continuity ==
+class TestContinuityOracle:
+    def _seed_traffic(self, o, t0, parts=(0,), n=60, step=0.1):
+        for p in parts:
+            for i in range(n):
+                ts = t0 + i * step
+                o.record_ack("t", p, i, None, b"%d-%d" % (p, i), ts=ts)
+                o.record_consumed_rows([("t", p, i, b"%d-%d" % (p, i),
+                                         ts)])
+
+    def test_clean_window_passes(self):
+        o = DeliveryOracle(track_flow=True)
+        t0 = time.monotonic() - 10
+        self._seed_traffic(o, t0)
+        with o._lock:
+            o.windows.append(("m", t0 + 1, t0 + 5,
+                              frozenset({("t", 0)})))
+        r = o.verify(check_duplicates=False, check_order=False,
+                     check_continuity=True, flow_stall_s=2.0,
+                     raise_on_violation=False)
+        assert r["ok"] and r["continuity"]["windows"] == 1
+
+    def test_flow_gap_flagged_with_dump(self):
+        o = DeliveryOracle(track_flow=True)
+        t0 = time.monotonic() - 10
+        self._seed_traffic(o, t0)
+        with o._lock:
+            o.windows.append(("m", t0 + 1, t0 + 5,
+                              frozenset({("t", 0)})))
+            o.flow[("t", 0)] = [t0 + 1.0, t0 + 4.9]   # 3.9 s hole
+        with pytest.raises(OracleViolation) as ei:
+            o.verify(check_duplicates=False, check_order=False,
+                     check_continuity=True, flow_stall_s=2.0)
+        rep = ei.value.report
+        assert rep["violations"]["flow_gap"][0]["partition"] == 0
+        assert rep["diff_path"] and os.path.exists(rep["diff_path"])
+
+    def test_no_traffic_no_violation(self):
+        """A quiet partition (no acks in the window) owes nothing."""
+        o = DeliveryOracle(track_flow=True)
+        t0 = time.monotonic() - 10
+        with o._lock:
+            o.windows.append(("m", t0 + 1, t0 + 5,
+                              frozenset({("t", 0)})))
+        r = o.verify(check_duplicates=False, check_order=False,
+                     check_continuity=True, raise_on_violation=False)
+        assert r["ok"]
+
+    def test_window_lifecycle(self):
+        """rebalance_begin opens; incremental revoke narrows; eager
+        full revoke discards; assign closes."""
+        o = DeliveryOracle(track_flow=True)
+        o.record_assign("m", [("t", 0), ("t", 1)])
+        o.record_rebalance_begin("m")
+        assert "m" in o._open_windows
+        o.record_revoke("m", [("t", 1)])
+        assert o._open_windows["m"][1] == {("t", 0)}
+        o.record_assign("m", [("t", 1)], incremental=True)
+        assert "m" not in o._open_windows
+        assert o.windows[-1][3] == frozenset({("t", 0)})
+        # eager: full revoke discards the open window
+        o.record_rebalance_begin("m")
+        o.record_revoke("m")
+        assert "m" not in o._open_windows
+
+    def test_converge_bound_violation(self):
+        o = DeliveryOracle()
+        o.record_assign("m", [("t", 0)])
+        o.record_poll("m")
+        with pytest.raises(OracleViolation) as ei:
+            o.verify(check_duplicates=False, check_order=False,
+                     check_group=True, group_topic="t",
+                     group_partitions=1, converged_s=9.0,
+                     converge_bound_s=5.0)
+        rows = ei.value.report["violations"]["unconverged"]
+        assert rows[0]["reason"] == "convergence_exceeded_bound"
+
+
+# ================================================== lite member fleet ==
+@pytest.mark.chaos
+class TestLiteMemberFleet:
+    def test_cooperative_churn_converges_with_continuity(self):
+        """In-process: 12 stable + 4 churning thread-cheap members
+        converge to exact coverage with zero flow gaps; the coverage
+        ledger and rebalance intervals populate."""
+        cluster = MockCluster(num_brokers=2, topics={"lm": 8},
+                              group_initial_rebalance_delay_ms=300)
+        oracle = DeliveryOracle(track_flow=True)
+        fleet = LiteMemberFleet(
+            cluster.bootstrap_servers(), group_id="lg", topic="lm",
+            partitions=8, members=12, oracle=oracle, seed=5,
+            strategy="cooperative-sticky", threads=4,
+            churn_members=4, churn_start_s=1.0, churn_period_s=0.3,
+            churn_lifetime_s=1.5)
+        try:
+            p = Producer({"bootstrap.servers":
+                          cluster.bootstrap_servers(), "linger.ms": 2,
+                          "compression.codec": "none"})
+            fleet.start()
+            deadline = time.monotonic() + 30
+            seq = 0
+            conv = False
+            while time.monotonic() < deadline:
+                p.produce("lm", b"v%08d" % seq, partition=seq % 8,
+                          on_delivery=oracle.dr())
+                seq += 1
+                p.poll(0)
+                time.sleep(0.002)
+                if seq % 100 == 0:
+                    cov = oracle.group_coverage("lm", 8)
+                    if cov["converged"] and \
+                            fleet.live_member_count() == 12:
+                        conv = True
+                        break
+            assert conv, oracle.group_coverage("lm", 8)
+            p.flush(10)
+            p.close()
+            dl = time.monotonic() + 20
+            while oracle.missing_count() > 0 and time.monotonic() < dl:
+                time.sleep(0.2)
+            snap = {"coverage": oracle.group_coverage("lm", 8),
+                    "now": time.monotonic()}
+            fleet.stop()
+            r = oracle.verify(
+                check_duplicates=False, check_order=False,
+                check_group=True, group_topic="lm",
+                group_partitions=8, converged_s=1.0,
+                check_continuity=True, flow_stall_s=3.0,
+                coverage=snap["coverage"], now=snap["now"])
+            assert r["ok"]
+            assert not list(fleet.errors)
+            assert cluster.groups["lg"].validation_errors == []
+            assert fleet.partition_unavailability(
+                snap["now"])["total_s"] >= 0
+            assert fleet.rebalancing_intervals(snap["now"])
+        finally:
+            fleet.stop()
+            cluster.stop()
+
+    def test_eager_strategy_stops_the_world(self):
+        """The eager baseline on the same harness accrues coverage
+        gaps (the stop-the-world eager cost the bench leg measures)."""
+        cluster = MockCluster(num_brokers=1, topics={"eg": 8},
+                              group_initial_rebalance_delay_ms=300)
+        oracle = DeliveryOracle(track_flow=True)
+        fleet = LiteMemberFleet(
+            cluster.bootstrap_servers(), group_id="eg-g", topic="eg",
+            partitions=8, members=6, oracle=oracle, seed=7,
+            strategy="range", threads=2, churn_members=2,
+            churn_start_s=1.0, churn_period_s=0.3,
+            churn_lifetime_s=1.2)
+        try:
+            fleet.start()
+            deadline = time.monotonic() + 25
+            while time.monotonic() < deadline:
+                cov = oracle.group_coverage("eg", 8)
+                if cov["converged"] and all(
+                        m.state in ("stable", "done")
+                        for m in fleet._members):
+                    break
+                time.sleep(0.2)
+            unavail = fleet.partition_unavailability()
+            fleet.stop()
+            assert not list(fleet.errors)
+            # churn under eager: every rejoin revoked the world, so
+            # real uncovered seconds accumulated
+            assert unavail["total_s"] > 0.2, unavail
+        finally:
+            fleet.stop()
+            cluster.stop()
+
+
+# ==================================================== fast scenarios ==
+@pytest.mark.chaos
+class TestCooperativeScenarios:
+    def test_fast_cooperative_churn(self):
+        from librdkafka_tpu.chaos.scenarios import fast_cooperative_churn
+        t0 = time.monotonic()
+        r = fast_cooperative_churn()
+        assert r["ok"], r["violations"]
+        assert not r["errors"] and not r["schedule_errors"]
+        assert r["continuity"]["flow_gaps"] == 0
+        assert r["converged_s"] is not None
+        assert time.monotonic() - t0 < 16, "tier-1 scenario budget"
+
+    def test_oracle_continuity_selftest(self):
+        from librdkafka_tpu.chaos.scenarios import (
+            oracle_continuity_selftest)
+        r = oracle_continuity_selftest()
+        assert not r["ok"]
+        assert r["violations"]["flow_gap"]
+        assert r["diff_path"] and os.path.exists(r["diff_path"])
+        assert r["flight_path"] and os.path.exists(r["flight_path"])
+        with open(r["flight_path"]) as f:
+            flight = json.load(f)
+        names = {e.get("name") for e in flight["traceEvents"]}
+        assert "oracle_violation" in names
+
+
+# ================================================== flagship (slow) ==
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestFlagship:
+    def test_cooperative_churn_storm_300_members(self):
+        """ISSUE 12 acceptance: ≥300 members with overlapping
+        join/leave lifetimes + a pid-verified coordinator SIGKILL
+        mid-rebalance sustain the continuity invariant (zero
+        stop-the-world windows) and converge to exact coverage within
+        the stated bound."""
+        from librdkafka_tpu.chaos.scenarios import cooperative_churn_storm
+        r = cooperative_churn_storm()
+        assert r["ok"], r["violations"]
+        assert r["members"] >= 300
+        assert r["kills_fired"] >= 1
+        assert r["pids_killed"] and \
+            r["pids_killed"][0]["verified_dead"]
+        assert r["continuity"]["flow_gaps"] == 0
+        assert r["converged_s"] is not None and r["converged_s"] <= 45
+        assert r["group"]["coverage"]["converged"]
+        assert not r["errors"] and not r["schedule_errors"]
+
+    def test_flagship_replay_key_identical_across_rigs(self):
+        """Same seed ⇒ identical fault replay_key across two separate
+        supervisor launches (the PR 9 determinism contract at
+        1000-member scale) — run small to keep the double-rig cost
+        sane; the resolution path is scale-independent."""
+        from librdkafka_tpu.chaos.scenarios import cooperative_churn_storm
+        r1 = cooperative_churn_storm(members=30, churners=10,
+                                     raise_on_violation=False)
+        r2 = cooperative_churn_storm(members=30, churners=10,
+                                     raise_on_violation=False)
+        assert r1["replay_key"] == r2["replay_key"]
+        assert r1["kills_fired"] == r2["kills_fired"] == 1
