@@ -1362,6 +1362,172 @@ def fleet_bench(smoke: bool = False) -> dict:
     }
 
 
+def _session_wire_leg(n_parts: int, enable: bool, produce_parts: int,
+                      n_msgs: int, steady_s: float):
+    """One fetch-session wire leg: a consumer assigned to ALL
+    ``n_parts`` partitions (the interest set) with data on the first
+    ``produce_parts``; returns (delivered records, steady-state
+    Fetch-API wire bytes over ``steady_s``, session stats)."""
+    from librdkafka_tpu import Consumer, Producer
+    from librdkafka_tpu.client.consumer import TopicPartition
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"wt": n_parts})
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "linger.ms": 2})
+        for i in range(n_msgs):
+            p.produce("wt", value=b"w%06d" % i,
+                      partition=i % produce_parts)
+        assert p.flush(60.0) == 0
+        p.close()
+
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "bw", "auto.offset.reset": "earliest",
+                      "fetch.session.enable": enable})
+        c.assign([TopicPartition("wt", i) for i in range(n_parts)])
+        records = []
+        deadline = time.monotonic() + 120
+        while len(records) < n_msgs and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                records.append((m.partition, m.offset, m.value))
+        assert len(records) == n_msgs, \
+            f"delivery incomplete: {len(records)}/{n_msgs}"
+        # warm-up barrier: offset resolution is one ListOffsets round
+        # trip per partition, so a 10k assign keeps turning partitions
+        # ACTIVE (and folding them into the session book) for seconds
+        # after delivery completes — measure steady state only once the
+        # whole interest set is fetchable on both legs
+        from librdkafka_tpu.client.partition import FetchState
+        rk = c._rk
+        warm_deadline = time.monotonic() + 180
+        warmed = False
+        while time.monotonic() < warm_deadline:
+            c.poll(0.1)
+            tps = list(rk.active_toppars())
+            if (len(tps) < n_parts or any(
+                    tp.fetch_state != FetchState.ACTIVE for tp in tps)):
+                continue
+            if not enable:
+                warmed = True
+                break
+            with rk._brokers_lock:
+                bs = list(rk.brokers.values())
+            if sum(b._fetch_session.stats()["partitions_total"]
+                   for b in bs) >= n_parts:
+                warmed = True
+                break
+        assert warmed, "interest set never fully fetchable"
+        # steady state: everything consumed, only long-polls remain —
+        # the window where incremental sessions collapse the wire
+        with rk._brokers_lock:
+            data_brokers = [b for b in rk.brokers.values()]
+        tx0 = sum(b.c_fetch_tx_bytes for b in data_brokers)
+        rx0 = sum(b.c_fetch_rx_bytes for b in data_brokers)
+        t_end = time.monotonic() + steady_s
+        while time.monotonic() < t_end:
+            c.poll(0.1)
+        wire = (sum(b.c_fetch_tx_bytes for b in data_brokers) - tx0
+                + sum(b.c_fetch_rx_bytes for b in data_brokers) - rx0)
+        sess = [b._fetch_session.stats() for b in data_brokers
+                if b._fetch_session.stats()["partitions_total"]
+                or not enable]
+        c.close()
+        return records, wire, sess
+    finally:
+        cluster.stop()
+
+
+def partitions_bench(smoke: bool = False) -> dict:
+    """bench.py --partitions (ISSUE 14): many-partition scale.
+
+    Two sweeps against the in-process mock:
+
+    * scale legs — a topic with 1k / 10k / 100k partitions (1k only in
+      ``--smoke``): first-produce time (metadata registration of the
+      whole partition table), paced produce msgs/s to 8 partitions,
+      and stats-emit wall time.  The emitter is O(active), so
+      ``stats_emit_ms`` must stay flat while registered toppars grow
+      100x.
+
+    * wire legs — sessionless vs KIP-227 incremental fetch sessions
+      with the SAME 10k-partition interest set (1k in ``--smoke``):
+      delivered records must be bit-identical, and the steady-state
+      Fetch wire bytes must drop >= 10x (the headline
+      ``wire_reduction``)."""
+    from librdkafka_tpu import Producer
+    from librdkafka_tpu.client.errors import KafkaException
+    from librdkafka_tpu.mock.cluster import MockCluster
+
+    t_start = time.perf_counter()
+    counts = [1000] if smoke else [1000, 10000, 100000]
+    scale = {}
+    for n in counts:
+        cluster = MockCluster(num_brokers=1, topics={"pt": n})
+        try:
+            p = Producer({"bootstrap.servers":
+                          cluster.bootstrap_servers(), "linger.ms": 2})
+            t0 = time.perf_counter()
+            p.produce("pt", value=b"warm", partition=0)
+            assert p.flush(120.0) == 0
+            md_s = time.perf_counter() - t0
+            n_msgs = 2000 if smoke else 20000
+            t0 = time.perf_counter()
+            for i in range(n_msgs):
+                while True:
+                    try:
+                        p.produce("pt", value=b"v%06d" % i,
+                                  partition=i % 8)
+                        break
+                    except KafkaException as e:
+                        if e.error.code.name != "_QUEUE_FULL":
+                            raise
+                        p.poll(0.01)
+                p.poll(0)
+            assert p.flush(120.0) == 0
+            msgs_s = n_msgs / (time.perf_counter() - t0)
+            emits = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                p._rk.stats.emit_json()
+                emits.append(time.perf_counter() - t0)
+            p.close()
+            scale[str(n)] = {
+                "first_produce_s": round(md_s, 3),
+                "produce_msgs_s": int(msgs_s),
+                "stats_emit_ms": round(min(emits) * 1e3, 3)}
+        finally:
+            cluster.stop()
+    # stats-emit flatness across a 10-100x registered-toppar spread
+    emit_ms = [leg["stats_emit_ms"] for leg in scale.values()]
+    emit_flat = max(emit_ms) / max(min(emit_ms), 1e-3)
+
+    wire_parts = 1000 if smoke else 10000
+    produce_parts = 64 if smoke else 256
+    wire_msgs = 1000 if smoke else 4000
+    steady_s = 1.5 if smoke else 3.0
+    rec_off, wire_off, _ = _session_wire_leg(
+        wire_parts, False, produce_parts, wire_msgs, steady_s)
+    rec_on, wire_on, sess = _session_wire_leg(
+        wire_parts, True, produce_parts, wire_msgs, steady_s)
+    bit_identical = sorted(rec_off) == sorted(rec_on)
+    reduction = round(wire_off / max(wire_on, 1), 1)
+    return {
+        "ok": bool(bit_identical and reduction >= 10.0
+                   and emit_flat < 10.0),
+        "scale": scale,
+        "stats_emit_flatness": round(emit_flat, 2),
+        "wire_interest_set": wire_parts,
+        "wire_bytes_sessionless": wire_off,
+        "wire_bytes_session": wire_on,
+        "wire_reduction": reduction,
+        "delivered_bit_identical": bit_identical,
+        "fetch_sessions": sess,
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+
+
 def smoke_bench() -> dict:
     """bench.py --smoke (<60 s): one bit-exactness pass over every
     engine leg — sync provider, pipelined engine, fetch pipeline,
@@ -1562,6 +1728,18 @@ def smoke_bench() -> dict:
             os.unlink(trace_path)
         except OSError:
             pass
+
+    # incremental fetch sessions (ISSUE 14): session-on vs session-off
+    # over the same 64-partition interest set must deliver the exact
+    # same (partition, offset, value) set
+    rec_off, wire_off, _ = _session_wire_leg(64, False, 8, 200, 0.5)
+    rec_on, wire_on, fs = _session_wire_leg(64, True, 8, 200, 0.5)
+    assert sorted(rec_off) == sorted(rec_on), \
+        "fetch-session leg not bit-exact"
+    assert fs and fs[0]["epoch"] >= 1, fs
+    legs["fetch_session"] = (f"bit-identical (steady wire "
+                             f"{wire_off}B sessionless -> {wire_on}B "
+                             f"incremental)")
 
     trace_ovh = _trace_overhead_gate()
     return {"elapsed_s": round(time.perf_counter() - t_start, 1),
@@ -1774,6 +1952,12 @@ def main():
                                     "produce throughput (bench.py "
                                     "--txn)",
                           **txn_bench()})
+        return
+    if "--partitions" in sys.argv:
+        _emit({"metric": "many-partition scale: O(active) stats emit "
+                         "+ incremental fetch-session wire reduction "
+                         "at 1k-100k toppars (bench.py --partitions)",
+               **partitions_bench(smoke="--smoke" in sys.argv)})
         return
     if "--smoke" in sys.argv:
         _emit({"metric": "pre-commit smoke: bit-exactness "

@@ -116,6 +116,65 @@ def _external_storm_leg() -> None:
         f"external leg: SIGKILL not pid-verified: {pids}"
 
 
+def _session_leg() -> None:
+    """ISSUE 14: incremental fetch sessions under instrumented locks —
+    a 16-partition interest set negotiates a session, runs incremental
+    epochs, survives a broker-side cache eviction (top-level
+    FETCH_SESSION_ID_NOT_FOUND → reset + epoch-0 renegotiation) and
+    rides the forgotten_topics path on unassign, interleaving the
+    per-broker session state with the mock's shared session cache."""
+    from .. import Consumer, Producer
+    from ..client.consumer import TopicPartition
+    from ..mock.cluster import MockCluster
+
+    cluster = MockCluster(num_brokers=1, topics={"sess": 16})
+    c = None
+    try:
+        p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "linger.ms": 2})
+        for i in range(200):
+            p.produce("sess", value=b"s%03d" % i, partition=i % 16)
+        assert p.flush(60.0) == 0, "session leg: flush left messages"
+        p.close()
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "lockdep-sess",
+                      "auto.offset.reset": "earliest"})
+        c.assign([TopicPartition("sess", i) for i in range(16)])
+        got = 0
+        deadline = time.monotonic() + 60
+        while got < 100 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 100, f"session leg: consumed {got}/100 pre-evict"
+        assert cluster.evict_fetch_sessions() >= 1, \
+            "session leg: no broker-side session to evict"
+        while got < 200 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 200, f"session leg: consumed {got}/200 post-evict"
+        # the post-evict records may have been prefetched before the
+        # eviction landed — keep polling until the next session fetch
+        # hits FETCH_SESSION_ID_NOT_FOUND and resets
+        reset_seen = False
+        while not reset_seen and time.monotonic() < deadline:
+            with c._rk._brokers_lock:
+                brokers = list(c._rk.brokers.values())
+            reset_seen = any(b._fetch_session.c_resets >= 1
+                             for b in brokers)
+            if not reset_seen:
+                c.poll(0.1)
+        assert reset_seen, \
+            "session leg: eviction did not reset the client session"
+        c.unassign()
+        c.poll(0.2)
+    finally:
+        if c is not None:
+            c.close()
+        cluster.stop()
+
+
 def _fleet_leg() -> None:
     """ISSUE 11: the tier-1 fleet smoke — 4 real client OS processes
     under burst traffic and a pid-verified SIGKILL while the driver's
@@ -142,6 +201,7 @@ def run_stress() -> dict:
         _chaos_leg()
         _external_storm_leg()
         _fleet_leg()
+        _session_leg()
     finally:
         lockdep.disable()
     return lockdep.report()
@@ -161,6 +221,7 @@ def run_races(seeds=SCHEDULE_SEEDS) -> tuple:
         _txn_leg()
         _chaos_leg()
         _fleet_leg()
+        _session_leg()
         for seed in seeds:
             fz = interleave.SchedFuzzer(seed)
             keys.append(fz.replay_key())
@@ -180,8 +241,9 @@ def races_main() -> int:
     rep, keys = run_races()
     print(races.format_report(rep))
     print(f"races: lockset sweep (engine pipeline + txn + fast chaos "
-          f"storm + fleet smoke) + {len(keys)} seeded schedules "
-          f"{[k for k in keys]} in {time.perf_counter() - t0:.1f}s")
+          f"storm + fleet smoke + fetch sessions) + {len(keys)} seeded "
+          f"schedules {[k for k in keys]} "
+          f"in {time.perf_counter() - t0:.1f}s")
     return 0 if races.clean(rep) else 1
 
 
@@ -190,8 +252,8 @@ def main() -> int:
     rep = run_stress()
     print(lockdep.format_report(rep))
     print(f"stress: engine pipeline + txn commit/abort + fast chaos "
-          f"storm + external SIGKILL storm + fleet smoke "
-          f"in {time.perf_counter() - t0:.1f}s")
+          f"storm + external SIGKILL storm + fleet smoke + fetch "
+          f"sessions in {time.perf_counter() - t0:.1f}s")
     return 0 if lockdep.clean(rep) else 1
 
 

@@ -156,6 +156,11 @@ class Storm:  # lint: ok shared-state
         self.errors: list[str] = []
         self._converged_s: Optional[float] = None
         self._stop_consumers = threading.Event()
+        # per-member KIP-227 fetch-session counters, snapshotted just
+        # before each consumer closes (ISSUE 14): {member: {broker
+        # name: FetchSession.stats()}} — session-chaos scenarios assert
+        # renegotiation happened off these
+        self.fetch_session_stats: dict = {}
 
     # -- client builders --------------------------------------------------
     def _conf(self, extra: dict) -> dict:
@@ -266,6 +271,15 @@ class Storm:  # lint: ok shared-state
         except Exception as e:
             self.errors.append(f"consumer{i}: {e!r}")
         finally:
+            try:
+                # snapshot BEFORE close(): close tears sessions down
+                # and would count its own resets
+                with c._rk._brokers_lock:
+                    bs = list(c._rk.brokers.values())
+                self.fetch_session_stats[member] = {
+                    b.name: b._fetch_session.stats() for b in bs}
+            except Exception:
+                pass
             if self.check_group and lifetime is not None:
                 oracle.record_member_closed(member)
             c.close()
@@ -820,6 +834,47 @@ def fast_external_kill9(seed: int = 23, *,
     return report
 
 
+def fast_session_kill9(seed: int = 57, *,
+                       raise_on_violation: bool = True) -> dict:
+    """Tier-1 fetch-session chaos smoke (<15 s, ISSUE 14): one real
+    ``SIGKILL`` of a broker OS process (pid-verified) under idempotent
+    produce + consume with KIP-227 incremental fetch sessions on.  The
+    session cache is broker MEMORY — it dies with the process — so the
+    reconnecting client must renegotiate from epoch 0 (a fresh full
+    fetch) and keep delivering with zero acked loss.  Asserted off the
+    per-member ``FetchSession`` counters the storm snapshots at
+    teardown.  Broker 1 is SIGKILLed and restarted, then broker 2 is
+    SIGKILLed — failing every partition back onto broker 1, so the
+    client MUST renegotiate the session its disconnect reset: broker
+    1's counters deterministically show resets >= 1 AND full_fetches
+    >= 2 (the initial create + the post-kill renegotiation)."""
+    storm = Storm(seed=seed, brokers=2, partitions=2, min_alive=1,
+                  external=True, duration_s=4.0, pace_ms=2, drain_s=20.0)
+    sched = (Schedule(seed=seed)
+             .at(1.4, proc_kill9(1))
+             .at(2.2, proc_restart())
+             .at(2.8, proc_kill9(2))
+             .at(3.6, proc_restart()))
+    report = storm.run(sched, raise_on_violation=raise_on_violation)
+    report["pids_killed"] = [e for e in report.get("proc_events", [])
+                             if e["verb"] == "kill9"]
+    fs = storm.fetch_session_stats.get("c0", {})
+    report["fetch_sessions"] = fs
+    if raise_on_violation:
+        assert len(report["pids_killed"]) == 2 and all(
+            e["verified_dead"] for e in report["pids_killed"]), \
+            "expected two pid-verified SIGKILLs"
+        b1 = next((s for n, s in fs.items() if n.endswith("/1")), None)
+        assert b1 is not None, f"no broker-1 session stats: {list(fs)}"
+        assert b1["resets"] >= 1, \
+            "broker SIGKILL never reset the fetch session"
+        assert b1["full_fetches"] >= 2, \
+            "no renegotiation after the broker came back"
+        live = [s for s in fs.values() if s["partitions_total"] > 0]
+        assert live, "no fetch session was live at teardown"
+    return report
+
+
 def fast_group_churn(seed: int = 33, *,
                      raise_on_violation: bool = True) -> dict:
     """Tier-1 group smoke (<12 s): 4 stable members + 2 churners, one
@@ -1094,6 +1149,11 @@ SCENARIOS: dict[str, Scenario] = {
         fast_external_kill9,
         "tier-1 smoke: real SIGKILL + SIGSTOP brownout of broker OS "
         "processes, <15s", "fast", 23, "loss,dup,order"),
+    "fast_session_kill9": Scenario(
+        fast_session_kill9,
+        "tier-1 smoke: pid-verified broker SIGKILL under incremental "
+        "fetch sessions — session dies with the broker, client "
+        "renegotiates, zero loss, <15s", "fast", 57, "loss,dup,order"),
     "fast_group_churn": Scenario(
         fast_group_churn,
         "tier-1 smoke: 4+2-member group churn across a coordinator "

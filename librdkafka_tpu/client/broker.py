@@ -498,6 +498,12 @@ class Broker:
     c_rx_bytes = shared("broker.c_rx_bytes", relaxed=True)
     c_connects = shared("broker.c_connects", relaxed=True)
     c_req_timeouts = shared("broker.c_req_timeouts", relaxed=True)
+    # KIP-227 fetch session + per-API fetch wire counters (ISSUE 14):
+    # mutated on the serve thread (request build / response handling),
+    # snapshot-read by the stats emitter like the counters above
+    _fetch_session = shared("broker.fetch_session", relaxed=True)
+    c_fetch_tx_bytes = shared("broker.c_fetch_tx_bytes", relaxed=True)
+    c_fetch_rx_bytes = shared("broker.c_fetch_rx_bytes", relaxed=True)
 
     def __init__(self, rk: "Kafka", nodeid: int, host: str, port: int,
                  name: str = ""):
@@ -562,6 +568,15 @@ class Broker:
         self.c_tx = self.c_rx = self.c_tx_bytes = self.c_rx_bytes = 0
         self.c_connects = 0             # connection attempts (stats)
         self.c_req_timeouts = 0
+        # Fetch-API wire bytes (both directions), split out from the
+        # totals so the bench can prove the incremental-session savings
+        # (stats: brokers[].fetch_session + top-level wire_fetch_bytes)
+        self.c_fetch_tx_bytes = 0
+        self.c_fetch_rx_bytes = 0
+        # KIP-227 incremental fetch session with this broker
+        # (client/fetch_session.py); torn down on disconnect
+        from .fetch_session import FetchSession
+        self._fetch_session = FetchSession()
         # consecutive request timeouts since the last good response;
         # socket.max.fails of these mark the connection broken
         # (reference: rkb_req_timeouts, rdkafka_broker.c timeout scan)
@@ -967,6 +982,9 @@ class Broker:
         self._wbuf.clear()
         self._unsent_req_ends.clear()
         self.fetch_inflight_cnt = 0
+        # the broker's session cache entry died with the connection (or
+        # will be evicted); renegotiate from epoch 0 after reconnect
+        self._fetch_session.reset("disconnect")
         self._tls_handshaking = False
         # fail all in-flight + queued requests (callers decide on retry)
         for req in list(self.waitresp.values()):
@@ -1009,6 +1027,8 @@ class Broker:
         self._unsent_req_ends.append(self._wbuf.queued_total)
         self.c_tx += 1
         self.c_tx_bytes += wire_len
+        if req.api == ApiKey.Fetch:
+            self.c_fetch_tx_bytes += wire_len
         req.ts_sent = time.monotonic()
         if req.ts_enq:
             self.outbuf_avg.add((req.ts_sent - req.ts_enq) * 1e6)
@@ -1121,6 +1141,9 @@ class Broker:
             self.rk.dbg("broker", f"{self.name}: unknown corrid {corrid}")
             return
         self.c_rx += 1
+        if req.api == ApiKey.Fetch:
+            # + frame length prefix: count what crossed the wire
+            self.c_fetch_rx_bytes += len(payload) + 4
         self._req_timeouts_pending = 0  # connection is alive
         if req.ts_sent:
             self.rtt_avg.add((time.monotonic() - req.ts_sent) * 1e6)
@@ -1679,7 +1702,8 @@ class Broker:
             if kerr.code in (Err.NOT_LEADER_FOR_PARTITION,
                              Err.LEADER_NOT_AVAILABLE,
                              Err.UNKNOWN_TOPIC_OR_PART):
-                rk.metadata_refresh(reason=f"produce error {kerr.code.name}")
+                rk.metadata_refresh(reason=f"produce error {kerr.code.name}",
+                                    topics=[tp.topic])
             if rk.idemp or fast:
                 # keep the batch frozen: membership must survive the retry
                 # for (BaseSequence, count) dup detection; budget is judged
@@ -1730,7 +1754,14 @@ class Broker:
             return
         from .partition import FetchState
         fetch_parts = []
-        for tp in list(self.toppars):
+        # O(active): scan the client's active-toppar index (consumer-
+        # started or produced-to), not this broker's full toppar set —
+        # metadata registration alone puts every partition of every
+        # known topic in self.toppars, and a 100k-toppar client must
+        # not walk them per serve pass (ISSUE 14)
+        for tp in rk.active_toppars():
+            if tp not in self.toppars:
+                continue
             # KIP-392: a delegated partition fetches from its follower;
             # everyone else fetches from the leader
             fetch_node = (tp.fetch_broker_id
@@ -1762,9 +1793,11 @@ class Broker:
             fetch_parts.append(tp)
         if not fetch_parts:
             return
-        by_topic: dict[str, list] = {}
-        for tp in fetch_parts:
-            by_topic.setdefault(tp.topic, []).append(tp)
+        fetch_ver = pick_version(self.api_versions, ApiKey.Fetch, 11)
+        fs = self._fetch_session
+        use_session = (fetch_ver >= 7
+                       and rk.conf.get("fetch.session.enable"))
+        part_max = rk.conf.get("fetch.message.max.bytes")
         body = {
             "replica_id": -1,
             "max_wait_time": rk.conf.get("fetch.wait.max.ms"),
@@ -1774,19 +1807,82 @@ class Broker:
                                "read_committed" else 0,
             # v11+ (KIP-392): our rack lets the broker nominate a
             # same-rack follower via preferred_read_replica
-            "rack_id": rk.conf.get("client.rack"),
-            "topics": [{"topic": t, "partitions": [
-                {"partition": tp.partition, "fetch_offset": tp.fetch_offset,
-                 "max_bytes": rk.conf.get("fetch.message.max.bytes")}
-                for tp in tps]} for t, tps in by_topic.items()]}
+            "rack_id": rk.conf.get("client.rack")}
+        session_req = False
+        if use_session and not fs.inflight:
+            # KIP-227 session fetch: the request lists only partitions
+            # whose (offset, max_bytes) CHANGED vs the session book —
+            # added/seeked — plus forgotten_topics for removals; an
+            # all-unchanged steady state sends an EMPTY topic list and
+            # the broker long-polls the whole book.  The effective
+            # partition set is all of `wanted`, so every eligible
+            # partition is claimed and version-stamped, listed or not.
+            wanted = {(tp.topic, tp.partition): (tp.fetch_offset, part_max)
+                      for tp in fetch_parts}
+            epoch, to_send, forgotten = fs.build(wanted)
+            by_tp = {(tp.topic, tp.partition): tp for tp in fetch_parts}
+            by_topic: dict[str, list] = {}
+            for key in to_send:
+                by_topic.setdefault(key[0], []).append(by_tp[key])
+            fby: dict[str, list] = {}
+            for t, p in forgotten:
+                fby.setdefault(t, []).append(p)
+            body["session_id"] = fs.session_id
+            body["session_epoch"] = epoch
+            body["topics"] = [
+                {"topic": t, "partitions": [
+                    {"partition": tp.partition,
+                     "fetch_offset": tp.fetch_offset,
+                     "max_bytes": part_max}
+                    for tp in tps]} for t, tps in by_topic.items()]
+            body["forgotten_topics"] = [
+                {"topic": t, "partitions": ps} for t, ps in fby.items()]
+            session_req = True
+        else:
+            # sessionless full fetch (schema defaults: session_id=0,
+            # epoch=-1): sessions disabled, a pre-v7 broker, or a
+            # session request already outstanding — newly eligible
+            # partitions go out as one-shot full fetches and fold into
+            # the session on a later pass (KIP-227 epochs are strictly
+            # sequential; only ONE session request may be in flight)
+            if use_session:
+                # overflow next to an in-flight session: ONE immediate-
+                # return fetch per partition per session epoch.  A
+                # long-polling (or repeated) overflow turns over on the
+                # same cadence as the session itself, so its partitions
+                # are forever in flight at session-build time and never
+                # fold into the book (observed: a 1000-partition assign
+                # stuck at a 1-partition session, then a half-absorbed
+                # book with the spin costing more wire than the session
+                # saved).  One max_wait=0 round serves fresh data NOW;
+                # after it the partition sits free until the in-flight
+                # session turns over (<= fetch.wait.max.ms) and the
+                # next epoch's build absorbs it deterministically.
+                fetch_parts = [tp for tp in fetch_parts
+                               if (tp.topic, tp.partition)
+                               not in fs.overflowed]
+                if not fetch_parts:
+                    return
+                fs.overflowed.update(
+                    (tp.topic, tp.partition) for tp in fetch_parts)
+                body["max_wait_time"] = 0
+            by_topic = {}
+            for tp in fetch_parts:
+                by_topic.setdefault(tp.topic, []).append(tp)
+            body["topics"] = [{"topic": t, "partitions": [
+                {"partition": tp.partition,
+                 "fetch_offset": tp.fetch_offset,
+                 "max_bytes": part_max}
+                for tp in tps]} for t, tps in by_topic.items()]
         self.fetch_inflight_cnt += 1
         for tp in fetch_parts:
             tp.fetch_in_flight = True
         versions = {(tp.topic, tp.partition): tp.version for tp in fetch_parts}
-        fetch_ver = pick_version(self.api_versions, ApiKey.Fetch, 11)
         self._xmit(Request(ApiKey.Fetch, body, version=fetch_ver,
-                           cb=lambda err, resp, parts=fetch_parts:
-                           self._handle_fetch(err, resp, versions, parts)))
+                           cb=lambda err, resp, parts=fetch_parts,
+                           sess=session_req:
+                           self._handle_fetch(err, resp, versions, parts,
+                                              session=sess)))
 
     def _offset_query(self, tp):
         """Logical offset (BEGINNING/END) → ListOffsets
@@ -1836,7 +1932,7 @@ class Broker:
         tp.fetch_state = FetchState.ACTIVE
         self.rk.dbg("fetch", f"{tp}: offset query -> {tp.fetch_offset}")
 
-    def _handle_fetch(self, err, resp, versions, parts):
+    def _handle_fetch(self, err, resp, versions, parts, session=False):
         self.fetch_inflight_cnt = max(0, self.fetch_inflight_cnt - 1)
         # in-flight claim discipline: OK partitions stay claimed
         # continuously from request to deferred-entry processing (a
@@ -1846,7 +1942,8 @@ class Broker:
         # the ok-list is final — releases in _handle_fetch0's finally.
         ok_final = None
         try:
-            ok_final = self._handle_fetch0(err, resp, versions, parts)
+            ok_final = self._handle_fetch0(err, resp, versions, parts,
+                                           session=session)
         finally:
             keep = ({id(e[0]) for e in ok_final}
                     if ok_final is not None else set())
@@ -1854,7 +1951,29 @@ class Broker:
                 if id(tp) not in keep:
                     tp.fetch_in_flight = False
 
-    def _handle_fetch0(self, err, resp, versions, parts):
+    def _handle_fetch0(self, err, resp, versions, parts, session=False):
+        if session:
+            fs = self._fetch_session
+            fs.inflight = False
+            if err is not None:
+                # transport error: the broker-side cache entry is gone
+                # (or unreachable) — renegotiate from epoch 0
+                fs.reset("transport error")
+            else:
+                top_ec = Err.from_wire(resp.get("error_code", 0))
+                if top_ec in (Err.FETCH_SESSION_ID_NOT_FOUND,
+                              Err.INVALID_FETCH_SESSION_EPOCH):
+                    # the broker evicted/lost the session (cache
+                    # pressure, restart) or we desynced: fall back to a
+                    # full fetch — the reset makes the next request an
+                    # epoch-0 full renegotiation.  The response carries
+                    # no partitions; claims release via the finally.
+                    self.rk.dbg("fetch",
+                                f"{self.name}: fetch session "
+                                f"{top_ec.name}; renegotiating")
+                    fs.reset(top_ec.name)
+                    return None
+                fs.on_success(resp.get("session_id", 0))
         if err is not None:
             # a failed fetch to a FOLLOWER falls back to the leader
             # (reference reverts the preferred replica on errors) —
@@ -1940,7 +2059,8 @@ class Broker:
                             Err.FENCED_LEADER_EPOCH):
                     if tp.fetch_broker_id is not None:
                         rk.revoke_fetch_delegation(tp, ec.name)
-                    rk.metadata_refresh(reason=f"fetch error {ec.name}")
+                    rk.metadata_refresh(reason=f"fetch error {ec.name}",
+                                        topics=[tp.topic])
                     tp.fetch_backoff_until = time.monotonic() + \
                         rk.conf.get("fetch.error.backoff.ms") / 1000.0
                 else:
@@ -1975,8 +2095,12 @@ class Broker:
         return ok
 
     def _queued_fetch_bytes(self) -> int:
+        # O(active): only started/produced-to toppars can hold fetchq
+        # bytes — never walk the full (metadata-registered) toppar set
         total = 0
-        for tp in list(self.toppars):
+        for tp in self.rk.active_toppars():
+            if tp not in self.toppars:
+                continue
             with tp.lock:
                 total += tp.fetchq_bytes
         return total

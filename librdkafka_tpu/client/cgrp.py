@@ -238,7 +238,11 @@ class ConsumerGroup:
                     not t.startswith("^") and t not in self._lit_known
                     for t in self.subscription):
                 self._unknown_topic_scan = now
-                self.rk.metadata_refresh("unknown subscribed topic(s)")
+                self.rk.metadata_refresh(
+                    "unknown subscribed topic(s)",
+                    topics=[t for t in self.subscription
+                            if not t.startswith("^")
+                            and t not in self._lit_known])
         if self.state != "up":
             # the coordinator lookup runs even without a subscription:
             # commit()/committed() on an assign()-based or fresh consumer
@@ -402,7 +406,8 @@ class ConsumerGroup:
                      for t in all_topics}
         missing = [t for t, n in parts.items() if n == 0]
         if missing:
-            self.rk.metadata_refresh(f"assignor needs {missing}")
+            self.rk.metadata_refresh(f"assignor needs {missing}",
+                                     topics=missing)
         fn = ASSIGNORS.get(self.protocol, ASSIGNORS["range"])
         if ASSIGNOR_PROTOCOLS.get(self.protocol) == "COOPERATIVE":
             per_member = fn(subs, parts, owned)

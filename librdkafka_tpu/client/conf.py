@@ -135,6 +135,14 @@ PROPERTIES: list[Prop] = [
        "Refresh interval while leaders are unknown.", vmin=1, vmax=60000),
     _p("topic.metadata.refresh.sparse", GLOBAL, "bool", True,
        "Sparse metadata requests (only subscribed topics)."),
+    _p("topic.metadata.interest.only", GLOBAL, "bool", True,
+       "Interest-set metadata (ISSUE 14, beyond the reference): "
+       "refreshes request only subscribed/produced topics with "
+       "per-topic staleness — an empty interest set sends a "
+       "brokers-only probe instead of a full sweep; full enumerations "
+       "happen only for regex subscriptions, the periodic refresh and "
+       "explicit all-topics requests. false restores the reference's "
+       "empty-set full-sweep shape."),
     _p("topic.blacklist", GLOBAL, "list", "", "Topic blacklist regex list."),
     _p("debug", GLOBAL, "list", "",
        "Comma-separated debug contexts: generic,broker,topic,metadata,feature,queue,msg,"
@@ -298,6 +306,14 @@ PROPERTIES: list[Prop] = [
        vmin=1, vmax=100000000),
     _p("fetch.error.backoff.ms", GLOBAL, "int", 500, "Backoff on fetch error.", app=C,
        vmin=0, vmax=300000),
+    _p("fetch.session.enable", GLOBAL, "bool", True,
+       "KIP-227 incremental fetch sessions (ISSUE 14, beyond the "
+       "reference): negotiate a per-broker session on Fetch v7+ and "
+       "send only changed partitions per request (removals ride "
+       "forgotten_topics); steady state is an O(1)-byte request for "
+       "any partition count. Session errors fall back to a full fetch "
+       "and renegotiate. false restores sessionless full fetches.",
+       app=C),
     _p("isolation.level", GLOBAL, "enum", "read_committed",
        "Transactional read isolation.", app=C, enum=("read_uncommitted", "read_committed")),
     _p("enable.partition.eof", GLOBAL, "bool", False,

@@ -228,6 +228,11 @@ class Consumer:
             with tp.lock:
                 tp.fetchq_cnt = 0
                 tp.fetchq_bytes = 0
+            # out of the O(active) index: stats emit and the broker
+            # serve scans stop visiting it; the next fetch-session
+            # request forgets it broker-side (absent from the wanted
+            # set → forgotten_topics)
+            self._rk.toppar_set_active(tp, False)
 
     def _start_partitions(self, need, explicit: dict, gen: Optional[int]):
         """Register ``need`` synchronously, resolve committed offsets
@@ -245,6 +250,15 @@ class Consumer:
             tp = self._assignment.get(key) or rk.get_toppar(*key)
             self._assignment[key] = tp
             tp.fetchq.forward_to(self.queue)
+            rk.toppar_set_active(tp, True)
+        # interest-set registration: an assign()-based consumer has no
+        # subscription, so its topics reach the sparse/interest-only
+        # metadata refresh through the topic-handle table (subscribe
+        # literals and regex matches already pass through get_topic);
+        # creating the handle also fires the "new topic" refresh that
+        # resolves leaders for never-seen topics
+        for t in {k[0] for k in need}:
+            rk.get_topic(t)
 
         def start(committed: dict):
             if gen is not None and self._assign_gen != gen:
@@ -753,7 +767,8 @@ class Consumer:
                 # block on the metadata condvar (notified on every
                 # metadata update) instead of sleep-polling; the 0.5s
                 # cap re-issues the refresh if an update didn't help
-                rk.metadata_refresh("offsets_for_times")
+                rk.metadata_refresh("offsets_for_times",
+                                    topics=[tpo.topic])
                 rk.metadata_wait(
                     lambda: tp.leader_id >= 0,
                     min(0.5, max(0.0, deadline - time.monotonic())))

@@ -198,6 +198,15 @@ class Kafka:  # lint: ok shared-state
         self._topics_lock = new_lock("kafka.topics")
         self._toppars: dict[tuple[str, int], Toppar] = {}
         self._toppars_lock = new_lock("kafka.toppars")
+        # ACTIVE toppars: produced-to or consumer-started partitions.
+        # Metadata registration alone creates Toppar objects for EVERY
+        # partition of every known topic — a 100k-partition topic means
+        # 100k registered toppars — so anything periodic (stats emit,
+        # queued-fetch-bytes sums, the consumer serve scan) iterates
+        # THIS index, O(active), never _toppars.  Guarded by
+        # _toppars_lock; membership mirrored in tp.stats_active for the
+        # lock-free hot-path check.
+        self._active_toppars: dict[tuple[str, int], Toppar] = {}
         self.metadata: dict = {"brokers": {}, "topics": {}}
         self._metadata_lock = new_lock("kafka.metadata")
         # notified (under _metadata_lock) after every metadata cache
@@ -494,7 +503,12 @@ class Kafka:  # lint: ok shared-state
         return random.choice(ups) if ups else None
 
     def metadata_refresh(self, reason: str = "",
-                         all_topics: bool = False):
+                         all_topics: bool = False,
+                         topics: Optional[list] = None):
+        """``topics`` is an interest HINT: the caller knows these
+        specific topics need fresh metadata (fetch/produce errors, new
+        topic registration) — they bypass the interest-only freshness
+        debounce below."""
         if self.terminating:
             return
         if self._metadata_inflight:
@@ -510,15 +524,41 @@ class Kafka:  # lint: ok shared-state
             return
         self._metadata_inflight = True
         sparse = self.conf.get("topic.metadata.refresh.sparse")
+        interest_only = self.conf.get("topic.metadata.interest.only")
         with self._topics_lock:
             names = list(self.topics) if sparse else None
-        if names == []:
-            names = None if not self.is_consumer else []
-        if all_topics:
-            names = None          # full enumeration (list_topics)
+        if names is not None and topics:
+            names = list(dict.fromkeys([*names, *topics]))
+        if names == [] and not interest_only:
+            # legacy shape: an empty interest set falls back to a full
+            # sweep; interest-only keeps it empty — a brokers-only
+            # request (Metadata v1+ empty topic array = no topics)
+            names = None
+        if all_topics or reason == "periodic":
+            # full enumeration: list_topics, and the periodic refresh —
+            # the ONE recurring full sweep interest-only keeps (deleted-
+            # topic pruning + regex discovery happen here)
+            names = None
         if self.cgrp is not None and self.cgrp.patterns:
             # regex subscriptions need the full cluster topic list
             names = None
+        if interest_only and names:
+            # per-topic staleness debounce: a topic whose metadata just
+            # landed isn't re-requested by an unrelated trigger (bursts
+            # of "new topic" refreshes re-listing the whole interest set
+            # were O(topics²) on the wire).  Hinted topics and anything
+            # older than half the fast-refresh interval pass — the
+            # leaderless fast path (250ms) always re-polls.
+            cutoff = self.conf.get(
+                "topic.metadata.refresh.fast.interval.ms") / 1000.0 * 0.5
+            hint = set(topics or ())
+            now0 = time.monotonic()
+            with self._metadata_lock:
+                names = [t for t in names if t in hint
+                         or now0 - self._metadata_topic_ts.get(t, 0.0)
+                         >= cutoff]
+            if not names and hint:
+                names = list(hint)
         # metadata.max.age.ms: expire cache entries past their age
         # (reference rdkafka_metadata_cache.c:289). Existing toppar
         # leader delegation is updated by the refresh RESPONSE
@@ -532,7 +572,10 @@ class Kafka:  # lint: ok shared-state
                     self.metadata["topics"].pop(name, None)
                     del self._metadata_topic_ts[name]
         self.dbg("metadata", f"refresh ({reason}) via {b.name}")
-        full = not names        # None or [] → broker enumerates all topics
+        # ONLY a null topic array is a full enumeration (Metadata v1+:
+        # null = all topics, [] = none — the mock used to conflate the
+        # two); [] is a brokers-only liveness probe and must not prune
+        full = names is None
         b.enqueue_request(Request(
             ApiKey.Metadata,
             # v4+ carries the auto-creation flag: producers may trigger
@@ -1107,10 +1150,30 @@ class Kafka:  # lint: ok shared-state
         tp.demote_arena()
 
     def _wake_leader(self, tp: Toppar):
+        # every wake means "this toppar has work" (first produce enqueue,
+        # fetcher start, retry) — the cheapest correct hook for the
+        # O(active) index; consumer _stop_partitions deactivates
+        if not tp.stats_active:
+            self.toppar_set_active(tp, True)
         with self._brokers_lock:
             b = self.brokers.get(tp.leader_id)
         if b is not None:
             b.ops.push(Op(OpType.BROKER_WAKEUP))
+
+    def toppar_set_active(self, tp: Toppar, active: bool) -> None:
+        """Add/remove ``tp`` from the active-toppar index (stats emit,
+        fetch-serve and queue-budget scans iterate only this set)."""
+        with self._toppars_lock:
+            if active:
+                self._active_toppars[(tp.topic, tp.partition)] = tp
+            else:
+                self._active_toppars.pop((tp.topic, tp.partition), None)
+            tp.stats_active = active
+
+    def active_toppars(self) -> list[Toppar]:
+        """Snapshot of the active toppars (O(active), not O(registered))."""
+        with self._toppars_lock:
+            return list(self._active_toppars.values())
 
     # ------------------------------------------------------------ DR path --
     def _dr_out_wanted(self) -> bool:

@@ -49,7 +49,7 @@ class Toppar:
         "stored_offset", "committed_offset", "hi_offset", "ls_offset",
         "paused", "fetch_backoff_until", "fetch_in_flight",
         "fetch_broker_id", "fetchq_cnt", "fetchq_bytes",
-        "eof_reported_at", "aborted_txns", "version")
+        "eof_reported_at", "aborted_txns", "version", "stats_active")
 
     def __init__(self, topic: str, partition: int):
         self.topic = topic
@@ -96,6 +96,10 @@ class Toppar:
         self.eof_reported_at = proto.OFFSET_INVALID
         self.aborted_txns: dict[int, list[int]] = {}  # pid -> abort offsets
         self.version = 1                 # barrier for stale fetch ops
+        # in Kafka._active_toppars (stats/serve iterate only ACTIVE
+        # toppars — a metadata-registered one costs nothing per emit);
+        # flag checked lock-free on hot paths, index under kafka.toppars
+        self.stats_active = False
 
     # ------------------------------------------------------- producer ----
     def enq_msg(self, msg: Message) -> bool:
@@ -193,5 +197,5 @@ register_slots(Toppar, "msgq", "xmit_msgq", "msgq_bytes",
 # pattern Eraser classically false-positives on.  Tracked, reported
 # informationally.
 register_slots(Toppar, "inflight", "inflight_msgids", "next_msgid",
-               "retry_batches", "fetch_in_flight", prefix="toppar",
-               relaxed=True)
+               "retry_batches", "fetch_in_flight", "stats_active",
+               prefix="toppar", relaxed=True)

@@ -100,6 +100,11 @@ class StatsCollector:
     def emit_json(self) -> str:
         rk = self.rk
         brokers = {}
+        # ONE active-toppar snapshot feeds both the per-broker toppar
+        # maps and the topics{} tree: the emitter is O(active), never
+        # O(registered) — a 100k-partition topic in the metadata cache
+        # costs the stats timer nothing (ISSUE 14)
+        active = rk.active_toppars()
         with rk._brokers_lock:
             rk_brokers = list(rk.brokers.values())
         for b in rk_brokers:
@@ -119,14 +124,20 @@ class StatsCollector:
                 # consumer fetch pipeline: codec-ticket submit -> reap
                 # (the _PendingFetch window PR 2 added; ISSUE 5)
                 "fetch_latency": b.fetch_latency_avg.rollover(),
+                # KIP-227 session snapshot + fetch-API wire split
+                # (ISSUE 14): the bench reads these to prove on-wire
+                # savings; partitions_sent/partitions_total give the
+                # incremental ratio
+                "fetch_session": {**b._fetch_session.stats(),
+                                  "tx_bytes": b.c_fetch_tx_bytes,
+                                  "rx_bytes": b.c_fetch_rx_bytes},
                 "toppars": {f"{tp.topic}-{tp.partition}":
                             {"topic": tp.topic, "partition": tp.partition}
-                            for tp in list(b.toppars)},
+                            for tp in active if tp in b.toppars},
             }
         topics = {}
-        with rk._toppars_lock:
-            toppars = list(rk._toppars.items())
-        for (t, p), tp in toppars:
+        for tp in active:
+            t, p = tp.topic, tp.partition
             topics.setdefault(t, {"topic": t, "partitions": {}})
             # reference lag (rdkafka.c:1283-1297): end_offset (ls under
             # read_committed) minus MAX(app, committed), clamped >= 0
@@ -181,6 +192,12 @@ class StatsCollector:
             "tx_bytes": sum(b["txbytes"] for b in brokers.values()),
             "rx": sum(b["rx"] for b in brokers.values()),
             "rx_bytes": sum(b["rxbytes"] for b in brokers.values()),
+            # Fetch-API bytes (both directions) across brokers: the
+            # incremental-session savings gauge (ISSUE 14)
+            "wire_fetch_bytes": sum(
+                b["fetch_session"]["tx_bytes"]
+                + b["fetch_session"]["rx_bytes"]
+                for b in brokers.values()),
             "metadata_cache_cnt": metadata_cache_cnt,
             "txmsgs": txmsgs, "rxmsgs": rxmsgs,
             "int_latency": self.int_latency.rollover(),

@@ -283,6 +283,19 @@ class MockCluster:
             shared_dict("mock.transactions", relaxed=True)
         self._pid_tid: dict[int, str] = \
             shared_dict("mock.pid_tid", relaxed=True)
+        # KIP-227 incremental fetch session cache (ISSUE 14): one entry
+        # per negotiated session — {session_id: {broker, epoch, book,
+        # last}} where `book` is the per-session partition state
+        # {(topic, partition): {fetch_offset, max_bytes}} and `epoch`
+        # the NEXT expected request epoch.  Bounded (LRU eviction at
+        # fetch_session_slots, like a real broker's
+        # max.incremental.fetch.session.cache.slots); a broker's
+        # sessions die with it (set_broker_down) — the cache is broker
+        # memory, which is exactly what the chaos kill tests assert.
+        self._fetch_sessions: dict[int, dict] = \
+            shared_dict("mock.fetch_sessions", relaxed=True)
+        self._next_session_id = 1
+        self.fetch_session_slots = 1000
         self._lock = new_rlock("mock.cluster")
         # fault injection
         self._err_stacks: dict[int, deque] = defaultdict(deque)
@@ -457,6 +470,12 @@ class MockCluster:
                 for c in list(self._conns):
                     if c.broker_id == broker_id:
                         self._close(c)
+                # fetch sessions are broker MEMORY: they die with the
+                # broker — a reconnecting client's incremental fetch
+                # gets FETCH_SESSION_ID_NOT_FOUND and renegotiates
+                for sid in [sid for sid, s in self._fetch_sessions.items()
+                            if s["broker"] == broker_id]:
+                    del self._fetch_sessions[sid]
             else:
                 if broker_id not in self._down:
                     return
@@ -876,9 +895,14 @@ class MockCluster:
             # v4+ request flag (KIP-204): a False flag suppresses broker
             # auto-creation even when the cluster allows it
             allow = body.get("allow_auto_topic_creation", True)
-            if names is None or len(names) == 0:
+            # Metadata v1+ semantics (ISSUE 14 satellite): ONLY a null
+            # topic array enumerates everything; an EMPTY array means
+            # "no topics" — a brokers-only liveness probe.  The old
+            # conflation materialized the full topic table for clients
+            # that asked for nothing.
+            if names is None:
                 names = list(self.topics)
-            elif self.auto_create_topics and allow:
+            elif names and self.auto_create_topics and allow:
                 for t in names:
                     if t not in self.topics and _valid_topic_name(t):
                         self.create_topic(t)
@@ -1009,20 +1033,115 @@ class MockCluster:
         with self._lock:
             self.topics[topic][partition].follower_id = broker_id
 
+    # ------------------------------------------------------------------
+    # KIP-227 incremental fetch sessions (ISSUE 14)
+
+    def _session_error(self, err: Err) -> dict:
+        """Top-level session error: empty topics, client renegotiates."""
+        return {"throttle_time_ms": 0, "error_code": err.wire,
+                "session_id": 0, "topics": []}
+
+    def _evict_fetch_sessions_locked(self) -> None:
+        """LRU-evict past the cache cap (mirrors the real broker's
+        max.incremental.fetch.session.cache.slots). Lock held."""
+        while len(self._fetch_sessions) > self.fetch_session_slots:
+            victim = min(self._fetch_sessions,
+                         key=lambda sid: self._fetch_sessions[sid]["last"])
+            del self._fetch_sessions[victim]
+
+    def evict_fetch_sessions(self, broker_id: Optional[int] = None) -> int:
+        """Test hook: drop cached fetch sessions (all, or one broker's).
+        The next incremental fetch gets FETCH_SESSION_ID_NOT_FOUND."""
+        with self._lock:
+            doomed = [sid for sid, s in self._fetch_sessions.items()
+                      if broker_id is None or s["broker"] == broker_id]
+            for sid in doomed:
+                del self._fetch_sessions[sid]
+            return len(doomed)
+
+    def fetch_session_ids(self, broker_id: Optional[int] = None) -> list:
+        """Test hook: session ids cached (for one broker, or all)."""
+        with self._lock:
+            return [sid for sid, s in self._fetch_sessions.items()
+                    if broker_id is None or s["broker"] == broker_id]
+
+    @staticmethod
+    def _session_book_merge(book: dict, body: dict) -> None:
+        """Fold a request's partition list + forgotten list into the
+        session book {(topic, partition): {fetch_offset, max_bytes}}."""
+        for ft in body.get("forgotten_topics") or []:
+            for p in ft["partitions"]:
+                book.pop((ft["topic"], p), None)
+        for t in body["topics"]:
+            for p in t["partitions"]:
+                book[(t["topic"], p["partition"])] = {
+                    "fetch_offset": p["fetch_offset"],
+                    "max_bytes": p["max_bytes"]}
+
+    @staticmethod
+    def _session_body(body: dict, book: dict) -> dict:
+        """Materialize the effective fetch body from a session book —
+        the incremental request named only CHANGES; the broker serves
+        its cached view of the full interest set."""
+        by_topic: dict = {}
+        for (t, p), st in book.items():
+            by_topic.setdefault(t, []).append(
+                {"partition": p, "fetch_offset": st["fetch_offset"],
+                 "max_bytes": st["max_bytes"]})
+        eff = dict(body)
+        eff["topics"] = [{"topic": t, "partitions": ps}
+                         for t, ps in sorted(by_topic.items())]
+        return eff
+
     def _h_Fetch(self, conn, corrid, hdr, body, inject):
         now = time.monotonic()
-        resp = self._try_fetch(conn, body, inject,
-                               ver=hdr["api_version"])
+        ver = hdr["api_version"]
+        epoch = body.get("session_epoch", -1)
+        sess = None           # (session_id, incremental-response?)
+        eff_body = body
+        if ver >= 7 and epoch != -1:
+            with self._lock:
+                if epoch == 0:
+                    # FULL_FETCH establishing a session: cache the whole
+                    # partition book, answer with a broker-assigned id
+                    sid = self._next_session_id
+                    self._next_session_id += 1
+                    book: dict = {}
+                    self._session_book_merge(book, body)
+                    self._fetch_sessions[sid] = {
+                        "broker": conn.broker_id, "epoch": 1,
+                        "book": book, "last": now}
+                    self._evict_fetch_sessions_locked()
+                    sess = (sid, False)   # full response this once
+                else:
+                    sid = body.get("session_id", 0)
+                    s = self._fetch_sessions.get(sid)
+                    if s is None or s["broker"] != conn.broker_id:
+                        return self._session_error(
+                            Err.FETCH_SESSION_ID_NOT_FOUND)
+                    if epoch != s["epoch"]:
+                        return self._session_error(
+                            Err.INVALID_FETCH_SESSION_EPOCH)
+                    self._session_book_merge(s["book"], body)
+                    s["epoch"] += 1
+                    s["last"] = now
+                    sess = (sid, True)
+                    eff_body = self._session_body(body, s["book"])
+        resp = self._try_fetch(conn, eff_body, inject, ver=ver,
+                               incremental=bool(sess and sess[1]))
         if resp is not None:
+            if sess is not None:
+                resp["error_code"] = 0
+                resp["session_id"] = sess[0]
             return resp
         # no data yet: park until max_wait or data arrives
         deadline = now + body["max_wait_time"] / 1000.0
-        self._parked_fetches.append((deadline, conn, corrid, body,
-                                     hdr["api_version"]))
+        self._parked_fetches.append((deadline, conn, corrid, eff_body,
+                                     ver, sess))
         return None
 
     def _try_fetch(self, conn, body, inject, force: bool = False,
-                   ver: int = 4):
+                   ver: int = 4, incremental: bool = False):
         """Build a fetch response, or None if empty and not forced."""
         any_data = False
         any_err = False
@@ -1091,28 +1210,40 @@ class MockCluster:
                             >= p["fetch_offset"]]
                     if preferred != -1:
                         any_data = True      # redirects return immediately
+                    if incremental and not records \
+                            and err == Err.NO_ERROR and preferred == -1:
+                        # KIP-227: incremental responses OMIT unchanged
+                        # empty partitions — the whole point of the
+                        # session; steady-state long-poll answers are
+                        # O(partitions-with-data), not O(interest set)
+                        continue
                     tp["partitions"].append(
                         {"partition": p["partition"], "error_code": err.wire,
                          "high_watermark": hwm, "last_stable_offset": lso,
                          "aborted_transactions": aborted,
                          "preferred_read_replica": preferred,
                          "records": records})
-                out_topics.append(tp)
+                if tp["partitions"]:
+                    out_topics.append(tp)
         if not any_data and not any_err and not force:
             return None
         return {"throttle_time_ms": 0, "topics": out_topics}
 
     def _serve_parked_fetches(self, now: float):
         still = []
-        for deadline, conn, corrid, body, ver in self._parked_fetches:
+        for deadline, conn, corrid, body, ver, sess in self._parked_fetches:
             if conn.closed:
                 continue
             resp = self._try_fetch(conn, body, None,
-                                   force=(now >= deadline), ver=ver)
+                                   force=(now >= deadline), ver=ver,
+                                   incremental=bool(sess and sess[1]))
             if resp is not None:
+                if sess is not None:
+                    resp["error_code"] = 0
+                    resp["session_id"] = sess[0]
                 self._respond(conn, corrid, ApiKey.Fetch, resp, version=ver)
             else:
-                still.append((deadline, conn, corrid, body, ver))
+                still.append((deadline, conn, corrid, body, ver, sess))
         self._parked_fetches = still
 
     def _h_ListOffsets(self, conn, corrid, hdr, body, inject):

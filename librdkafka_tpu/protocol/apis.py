@@ -138,9 +138,14 @@ FETCH_V4_RESP = Schema(
 
 # Fetch v5-v11 evolution (KIP-227 sessions, KIP-392 follower fetching —
 # reference: rd_kafka_FetchRequest versioning in rdkafka_broker.c:3791+).
-# Schema `defaults` keep version-agnostic request bodies working: this
-# client always issues sessionless full fetches (session_id=0, epoch=-1)
-# like the reference (which doesn't implement KIP-227 sessions either).
+# Schema `defaults` keep version-agnostic request bodies working: a
+# body WITHOUT session keys serializes as a sessionless full fetch
+# (session_id=0, epoch=-1), the reference's only shape.  With
+# fetch.session.enable (default) the client goes beyond the reference:
+# client/fetch_session.py negotiates per-broker KIP-227 sessions and
+# fills session_id/session_epoch/forgotten_topics explicitly
+# (Broker._consumer_serve); the mock broker's session cache is the
+# other end (mock/cluster.py _h_Fetch).
 _FETCH_PART_V5 = Schema(
     ("partition", Int32), ("fetch_offset", Int64),
     ("log_start_offset", Int64), ("max_bytes", Int32),

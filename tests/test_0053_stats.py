@@ -139,8 +139,12 @@ def test_stats_schema_fields():
     for field in ("name", "nodeid", "state", "stateage", "connects",
                   "outbuf_cnt", "waitresp_cnt", "tx", "txbytes", "rx",
                   "rxbytes", "req_timeouts", "rtt", "outbuf_latency",
-                  "throttle", "toppars"):
+                  "throttle", "fetch_session", "toppars"):
         assert field in b, field
+    for field in ("session_id", "epoch", "partitions_sent",
+                  "partitions_total", "full_fetches", "resets",
+                  "tx_bytes", "rx_bytes"):
+        assert field in b["fetch_session"], field
     tp = s["topics"]["st"]["partitions"]["0"]
     for field in ("partition", "leader", "msgq_cnt", "msgq_bytes",
                   "xmit_msgq_cnt", "fetchq_cnt", "fetch_state",
@@ -275,6 +279,12 @@ def test_stats_schema_matches_statistics_md():
     b = next(iter(pb["brokers"].values()))
     assert set(b) == doc["brokers.{name}"], (
         set(b) ^ doc["brokers.{name}"])
+
+    # ISSUE 14: the KIP-227 session snapshot is itself a documented
+    # sub-section — schema-checked field for field
+    fs = b["fetch_session"]
+    want_fs = doc["brokers.{name}.fetch_session"]
+    assert set(fs) == want_fs, set(fs) ^ want_fs
 
     tp = next(iter(pb["topics"].values()))["partitions"]
     part = next(iter(tp.values()))

@@ -37,6 +37,9 @@ def _fake_broker(budget_kb: int = 0) -> Broker:
     b.toppars = set()
     b._fetch_deferred = deque()
     b._fetch_pending = deque()
+    # the budget walk is O(active) since ISSUE 14 — the shell's active
+    # set is simply whatever the broker owns
+    b.rk.active_toppars = lambda: list(b.toppars)
     return b
 
 

@@ -23,6 +23,7 @@ from librdkafka_tpu.chaos.scenarios import (SCENARIOS,
                                             external_kill9_eos,
                                             fast_external_kill9,
                                             fast_group_churn,
+                                            fast_session_kill9,
                                             group_churn_coordinator_storm,
                                             soak_kill9_txn_storm)
 from librdkafka_tpu.mock.cluster import MockCluster
@@ -317,6 +318,22 @@ class TestFastExternalScenarios:
         assert m["recovery_ms"]["p99"] is not None
         assert m["recovery_ms"]["unrecovered"] == 0
         assert time.monotonic() - t0 < 25, "fast-tier budget blown"
+
+    def test_fast_session_kill9(self):
+        """ISSUE 14: the KIP-227 session cache dies with the SIGKILLed
+        broker process; the client renegotiates (epoch-0 full fetch)
+        and keeps delivering with zero acked loss."""
+        t0 = time.monotonic()
+        r = fast_session_kill9()
+        assert r["ok"], r["violations"]
+        assert r["external"] and not r["errors"]
+        kills = r["pids_killed"]
+        assert len(kills) == 2 and all(e["verified_dead"] for e in kills)
+        assert r["consumed"] == r["acked"] > 0
+        b1 = next(s for n, s in r["fetch_sessions"].items()
+                  if n.endswith("/1"))
+        assert b1["resets"] >= 1 and b1["full_fetches"] >= 2
+        assert time.monotonic() - t0 < 35, "fast-tier budget blown"
 
     def test_fast_group_churn(self):
         t0 = time.monotonic()

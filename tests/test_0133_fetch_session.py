@@ -1,0 +1,379 @@
+"""KIP-227 incremental fetch sessions + interest-set metadata
+(ISSUE 14): the client-side ``FetchSession`` epoch protocol, the mock
+broker's session cache (create / incremental / forgotten / error
+codes / eviction), the fallback-and-renegotiate paths for both
+top-level session errors, session survival across an incremental
+cooperative rebalance, the sessionless opt-out knob, and the
+Metadata v1+ null-vs-empty topic-list semantics."""
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.consumer import TopicPartition
+from librdkafka_tpu.client.errors import Err, KafkaException
+from librdkafka_tpu.client.fetch_session import (INITIAL_EPOCH,
+                                                 SESSIONLESS_EPOCH,
+                                                 FetchSession)
+from librdkafka_tpu.mock.cluster import MockCluster
+
+TOPIC = "fs"
+
+
+@pytest.fixture
+def cluster():
+    c = MockCluster(num_brokers=1, topics={TOPIC: 2})
+    yield c
+    c.stop()
+
+
+def _produce(cluster, n, start=0, topic=TOPIC, parts=2):
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 2})
+    for i in range(start, start + n):
+        p.produce(topic, value=b"m%04d" % i, partition=i % parts)
+    assert p.flush(10.0) == 0
+    p.close()
+
+
+def _consume(c, n, timeout=15.0):
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < n and time.monotonic() < deadline:
+        m = c.poll(0.2)
+        if m is not None and m.error is None:
+            got.append(m)
+    return got
+
+
+def _data_sessions(c):
+    """The consumer's non-bootstrap broker FetchSessions."""
+    rk = c._rk
+    with rk._brokers_lock:
+        return [b._fetch_session for b in rk.brokers.values()]
+
+
+# ===================================================== unit: the FSM ==
+class TestFetchSessionUnit:
+    def test_epoch0_sends_everything(self):
+        fs = FetchSession()
+        wanted = {("t", 0): (0, 1 << 20), ("t", 1): (5, 1 << 20)}
+        epoch, to_send, forgotten = fs.build(wanted)
+        assert epoch == INITIAL_EPOCH
+        assert set(to_send) == set(wanted) and forgotten == []
+        fs.on_success(77)
+        assert fs.session_id == 77 and fs.epoch == 1
+        assert fs.book == wanted
+
+    def test_incremental_sends_only_changes(self):
+        fs = FetchSession()
+        wanted = {("t", 0): (0, 1), ("t", 1): (0, 1)}
+        fs.build(wanted)
+        fs.on_success(9)
+        # only partition 0 moved; partition 1 is unchanged
+        wanted2 = {("t", 0): (10, 1), ("t", 1): (0, 1)}
+        epoch, to_send, forgotten = fs.build(wanted2)
+        assert epoch == 1 and to_send == [("t", 0)] and forgotten == []
+        fs.on_success(9)
+        # partition 1 dropped from the interest set -> forgotten
+        epoch, to_send, forgotten = fs.build({("t", 0): (10, 1)})
+        assert epoch == 2 and to_send == [] and forgotten == [("t", 1)]
+        fs.on_success(9)
+        assert fs.book == {("t", 0): (10, 1)}
+
+    def test_epoch_wraps_past_int32(self):
+        fs = FetchSession()
+        fs.build({("t", 0): (0, 1)})
+        fs.on_success(3)
+        fs.epoch = 0x7FFFFFFF
+        fs.build({("t", 0): (0, 1)})
+        fs.on_success(3)
+        assert fs.epoch == 1          # wraps to 1, never back to 0/-1
+
+    def test_reset_noop_before_first_negotiation(self):
+        fs = FetchSession()
+        fs.reset("disconnect")        # nothing negotiated: not a reset
+        assert fs.stats()["resets"] == 0
+        fs.build({("t", 0): (0, 1)})
+        fs.on_success(4)
+        fs.reset("disconnect")
+        assert fs.stats()["resets"] == 1
+        assert fs.session_id == 0 and fs.epoch == INITIAL_EPOCH
+        assert fs.book == {} and not fs.inflight
+        assert SESSIONLESS_EPOCH == -1
+
+
+# ============================================== e2e: session lifecycle ==
+def test_session_negotiated_and_epoch_increments(cluster):
+    """Consuming negotiates a session (broker-assigned id), epochs
+    increment per fetch, and the mock caches the partition book."""
+    _produce(cluster, 20)
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "fs-g", "auto.offset.reset": "earliest"})
+    c.assign([TopicPartition(TOPIC, 0), TopicPartition(TOPIC, 1)])
+    got = _consume(c, 20)
+    assert len(got) == 20
+    # steady state: a few long-poll cycles advance the epoch
+    for _ in range(5):
+        c.poll(0.1)
+    fss = [fs for fs in _data_sessions(c) if fs.session_id > 0]
+    assert fss, "no fetch session negotiated"
+    fs = fss[0]
+    assert fs.epoch >= 2 and fs.stats()["full_fetches"] == 1
+    assert fs.stats()["partitions_total"] == 2
+    sids = cluster.fetch_session_ids()
+    assert fs.session_id in sids
+    with cluster._lock:
+        book = cluster._fetch_sessions[fs.session_id]["book"]
+        assert set(book) == {(TOPIC, 0), (TOPIC, 1)}
+    # steady state is incremental: far fewer partition entries were
+    # serialized than fetches were sent
+    assert fs.stats()["partitions_sent"] < fs.epoch * 2
+    c.close()
+
+
+def test_forgotten_partitions_on_incremental_unassign(cluster):
+    """Dropping a partition from the assignment rides the next fetch's
+    forgotten_topics — the mock's session book shrinks; the kept
+    partition keeps delivering on the SAME session."""
+    _produce(cluster, 10)
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "fs-g2", "auto.offset.reset": "earliest"})
+    c.assign([TopicPartition(TOPIC, 0), TopicPartition(TOPIC, 1)])
+    assert len(_consume(c, 10)) == 10
+    fs = next(f for f in _data_sessions(c) if f.session_id > 0)
+    sid = fs.session_id
+    c.incremental_unassign([TopicPartition(TOPIC, 1)])
+    _produce(cluster, 5, start=100, parts=1)   # partition 0 only
+    got = _consume(c, 5)
+    assert [m.value for m in got] == [b"m%04d" % i for i in range(100, 105)]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with cluster._lock:
+            book = dict(cluster._fetch_sessions.get(sid, {}).get("book", {}))
+        if set(book) == {(TOPIC, 0)}:
+            break
+        c.poll(0.1)
+    assert set(book) == {(TOPIC, 0)}, book
+    assert fs.session_id == sid and fs.stats()["resets"] == 0
+    c.close()
+
+
+def test_seek_relists_partition_in_session(cluster):
+    """seek() moves the fetch offset -> the partition no longer matches
+    the session book and must be re-listed: the data is redelivered
+    from the seek point without any session reset."""
+    _produce(cluster, 8)
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "fs-g3", "auto.offset.reset": "earliest"})
+    c.assign([TopicPartition(TOPIC, 0), TopicPartition(TOPIC, 1)])
+    first = _consume(c, 8)
+    assert len(first) == 8
+    fs = next(f for f in _data_sessions(c) if f.session_id > 0)
+    sent_before = fs.stats()["partitions_sent"]
+    c.seek(TopicPartition(TOPIC, 0, 0))
+    again = _consume(c, 4)
+    assert sorted(m.offset for m in again) == [0, 1, 2, 3]
+    assert fs.stats()["partitions_sent"] > sent_before
+    assert fs.stats()["resets"] == 0
+    c.close()
+
+
+@pytest.mark.parametrize("corrupt", ["evict", "epoch"])
+def test_session_error_falls_back_and_renegotiates(cluster, corrupt):
+    """Both top-level session errors force renegotiation: the broker
+    forgetting the session (FETCH_SESSION_ID_NOT_FOUND) and an epoch
+    mismatch (INVALID_FETCH_SESSION_EPOCH).  Either way the client
+    resets, full-fetches from epoch 0, and delivery continues."""
+    _produce(cluster, 6)
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": f"fs-e-{corrupt}",
+                  "auto.offset.reset": "earliest"})
+    c.assign([TopicPartition(TOPIC, 0), TopicPartition(TOPIC, 1)])
+    assert len(_consume(c, 6)) == 6
+    fs = next(f for f in _data_sessions(c) if f.session_id > 0)
+    old_sid = fs.session_id
+    if corrupt == "evict":
+        assert cluster.evict_fetch_sessions() >= 1
+    else:
+        with cluster._lock:
+            cluster._fetch_sessions[old_sid]["epoch"] += 7
+    _produce(cluster, 6, start=50)
+    got = _consume(c, 6)
+    assert len(got) == 6, "delivery stalled after session error"
+    assert fs.stats()["resets"] >= 1
+    assert fs.stats()["full_fetches"] >= 2, "no epoch-0 renegotiation"
+    assert fs.session_id > 0, "no new session after renegotiation"
+    if corrupt == "evict":
+        assert fs.session_id != old_sid
+    c.close()
+
+
+def test_session_survives_cooperative_rebalance(cluster):
+    """KIP-429 + KIP-227: an incremental cooperative rebalance revokes
+    only the moved partitions — the incumbent's fetch session is NOT
+    reset; the revoked partitions leave via forgotten_topics while the
+    kept ones keep flowing on the same session id."""
+    _produce(cluster, 16)
+    conf = {"bootstrap.servers": cluster.bootstrap_servers(),
+            "group.id": "fs-coop", "auto.offset.reset": "earliest",
+            "partition.assignment.strategy": "cooperative-sticky",
+            "heartbeat.interval.ms": 300, "session.timeout.ms": 6000}
+    c1 = Consumer(dict(conf, **{"client.id": "c1"}))
+    c1.subscribe([TOPIC])
+    got1 = _consume(c1, 16)
+    assert len(got1) == 16
+    fs = next(f for f in _data_sessions(c1) if f.session_id > 0)
+    sid = fs.session_id
+    c2 = Consumer(dict(conf, **{"client.id": "c2"}))
+    c2.subscribe([TOPIC])
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        c1.poll(0.1)
+        c2.poll(0.1)
+        if len(c1.assignment()) == 1 and len(c2.assignment()) == 1:
+            break
+    assert len(c1.assignment()) == 1 and len(c2.assignment()) == 1
+    # the rebalance moved one partition off c1 WITHOUT a session reset
+    assert fs.session_id == sid, "cooperative rebalance reset the session"
+    assert fs.stats()["resets"] == 0
+    _produce(cluster, 10, start=200)
+    got = _consume(c1, 1, timeout=10) + _consume(c2, 1, timeout=10)
+    assert got, "no delivery after cooperative handoff"
+    c1.close()
+    c2.close()
+
+
+def test_sessionless_when_disabled(cluster):
+    """fetch.session.enable=false: every fetch goes out with epoch -1,
+    no session is negotiated on either side, delivery unaffected."""
+    _produce(cluster, 10)
+    c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "group.id": "fs-off", "auto.offset.reset": "earliest",
+                  "fetch.session.enable": False})
+    c.assign([TopicPartition(TOPIC, 0), TopicPartition(TOPIC, 1)])
+    assert len(_consume(c, 10)) == 10
+    for fs in _data_sessions(c):
+        s = fs.stats()
+        assert s["session_id"] == 0 and s["epoch"] == 0
+        assert s["full_fetches"] == 0 and s["partitions_total"] == 0
+    assert cluster.fetch_session_ids() == []
+    c.close()
+
+
+# ======================================================= conf knobs ==
+class TestConfKnobs:
+    def test_defaults_on(self):
+        from librdkafka_tpu.client.conf import Conf
+        conf = Conf()
+        assert conf.get("fetch.session.enable") is True
+        assert conf.get("topic.metadata.interest.only") is True
+
+    @pytest.mark.parametrize("knob", ["fetch.session.enable",
+                                      "topic.metadata.interest.only"])
+    def test_set_time_validation(self, knob):
+        from librdkafka_tpu.client.conf import Conf
+        conf = Conf()
+        conf.set(knob, "false")
+        assert conf.get(knob) is False
+        conf.set(knob, True)
+        assert conf.get(knob) is True
+        with pytest.raises(KafkaException) as ei:
+            conf.set(knob, "not-a-bool")
+        assert ei.value.error.code == Err._INVALID_ARG
+
+
+# =========================================== mock: session cache rules ==
+class TestMockSessionCache:
+    def _fetch(self, cluster, body, ver=11, broker=1):
+        conn = SimpleNamespace(broker_id=broker, closed=False)
+        hdr = {"api_version": ver}
+        return cluster._h_Fetch(conn, 1, hdr, dict(body), None)
+
+    @staticmethod
+    def _body(epoch, sid=0, topics=(), forgotten=()):
+        return {"replica_id": -1, "max_wait_time": 0, "min_bytes": 1,
+                "max_bytes": 1 << 20, "isolation_level": 0,
+                "session_id": sid, "session_epoch": epoch,
+                "topics": [{"topic": t, "partitions": [
+                    {"partition": p, "fetch_offset": o,
+                     "max_bytes": 1 << 20}]} for t, p, o in topics],
+                "forgotten_topics": [{"topic": t, "partitions": ps}
+                                     for t, ps in forgotten]}
+
+    def test_unknown_session_id(self, cluster):
+        r = self._fetch(cluster, self._body(5, sid=424242))
+        assert r["error_code"] == Err.FETCH_SESSION_ID_NOT_FOUND.wire
+        assert r["topics"] == [] and r["session_id"] == 0
+
+    def test_epoch_mismatch(self, cluster):
+        _produce(cluster, 2, parts=1)   # data -> immediate responses
+        r = self._fetch(cluster,
+                        self._body(0, topics=[(TOPIC, 0, 0)]))
+        sid = r["session_id"]
+        assert sid > 0
+        r = self._fetch(cluster, self._body(3, sid=sid))  # expected 1
+        assert r["error_code"] == Err.INVALID_FETCH_SESSION_EPOCH.wire
+
+    def test_lru_eviction_caps_cache(self, cluster):
+        _produce(cluster, 2, parts=1)   # data -> immediate responses
+        cluster.fetch_session_slots = 4
+        for _ in range(7):
+            self._fetch(cluster, self._body(0, topics=[(TOPIC, 0, 0)]))
+        sids = cluster.fetch_session_ids()
+        assert len(sids) == 4
+        # oldest sessions were the victims
+        assert min(sids) == 4 and max(sids) == 7
+
+    def test_incremental_omits_empty_partitions(self, cluster):
+        _produce(cluster, 4, parts=1)        # data on partition 0 only
+        r = self._fetch(cluster, self._body(
+            0, topics=[(TOPIC, 0, 0), (TOPIC, 1, 0)]))
+        sid = r["session_id"]
+        # full response (epoch 0) lists BOTH partitions
+        assert sum(len(t["partitions"]) for t in r["topics"]) == 2
+        # incremental with new data on p0 only: p1 is omitted
+        _produce(cluster, 2, start=10, parts=1)
+        r = self._fetch(cluster, self._body(
+            1, sid=sid, topics=[(TOPIC, 0, 4)]))
+        assert r["error_code"] == 0 and r["session_id"] == sid
+        listed = [(t["topic"], p["partition"]) for t in r["topics"]
+                  for p in t["partitions"]]
+        assert listed == [(TOPIC, 0)]
+
+    def test_session_dies_with_broker(self, cluster):
+        _produce(cluster, 2, parts=1)   # data -> immediate responses
+        r = self._fetch(cluster, self._body(0, topics=[(TOPIC, 0, 0)]))
+        sid = r["session_id"]
+        assert sid in cluster.fetch_session_ids()
+        cluster.set_broker_down(1, True)
+        assert cluster.fetch_session_ids() == []
+        cluster.set_broker_down(1, False)
+        r = self._fetch(cluster, self._body(1, sid=sid))
+        assert r["error_code"] == Err.FETCH_SESSION_ID_NOT_FOUND.wire
+
+
+# ================================= metadata: null vs empty topic list ==
+class TestMetadataInterestSet:
+    def _md(self, cluster, names):
+        conn = SimpleNamespace(broker_id=1, closed=False)
+        return cluster._h_Metadata(conn, 1, {"api_version": 4},
+                                   {"topics": names}, None)
+
+    def test_null_list_is_full_enumeration(self, cluster):
+        r = self._md(cluster, None)
+        assert [t["topic"] for t in r["topics"]] == [TOPIC]
+
+    def test_empty_list_is_no_topics(self, cluster):
+        """The brokers-only probe: an empty topic array must NOT
+        enumerate the cluster's topic table (KIP-227's metadata twin —
+        interest-set clients rely on it at 100k-topic scale)."""
+        r = self._md(cluster, [])
+        assert r["topics"] == []
+        assert r["brokers"], "broker list must still be served"
+
+    def test_named_list_is_sparse(self, cluster):
+        cluster.create_topic("other", partitions=1)
+        r = self._md(cluster, [TOPIC])
+        assert [t["topic"] for t in r["topics"]] == [TOPIC]
