@@ -116,6 +116,52 @@ def _external_storm_leg() -> None:
         f"external leg: SIGKILL not pid-verified: {pids}"
 
 
+def _fastlane_leg() -> None:
+    """ISSUE 16: the widened arena fast lane under instrumented locks —
+    app-thread produce() appends (murmur2 auto-partition + explicit
+    timestamps + headers riding the C lane) race the broker thread's
+    run take at linger.ms=0, while an interleaved shape-ineligible
+    produce (per-message on_delivery) claims a hot toppar mid-stream so
+    demote_arena's drain races concurrent appends (the broker-side
+    "race" demotion path)."""
+    from .. import Producer
+
+    drs: list = []
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "linger.ms": 0,
+                  "dr_msg_cb": lambda e, m: drs.append(e)})
+    try:
+        p.set_topic_conf("lockdep-lane", {"partitioner": "murmur2"})
+        # metadata warm-up: auto-partition needs the partition count
+        t = p._rk.get_topic("lockdep-lane")
+        deadline = time.monotonic() + 30
+        while t.partition_cnt <= 0 and time.monotonic() < deadline:
+            p.poll(0.05)
+        assert t.partition_cnt > 0, "fastlane leg: no metadata"
+        hdrs = [("k", b"v")]
+        now_ms = int(time.time() * 1000)
+        for i in range(400):
+            p.produce("lockdep-lane", value=b"x%03d" % i,
+                      key=b"k%02d" % (i % 37), timestamp=now_ms + i,
+                      headers=hdrs)
+            if i == 250:
+                # shape-ineligible produce claims a toppar mid-run: if
+                # the broker is mid-take this exercises the "race"
+                # demotion, else the "ineligible" drain — both contend
+                # with live appends
+                p.produce("lockdep-lane", value=b"slow", partition=0,
+                          on_delivery=lambda e, m: None)
+            if i % 64 == 0:
+                p.poll(0)
+        assert p.flush(60.0) == 0, "fastlane leg: flush left messages"
+        # the on_delivery produce routes to its own callback, not the
+        # global dr_msg_cb: exactly the 400 lane messages land here
+        assert len(drs) == 400 and all(e is None for e in drs), \
+            f"fastlane leg: DRs {len(drs)}/400"
+    finally:
+        p.close()
+
+
 def _session_leg() -> None:
     """ISSUE 14: incremental fetch sessions under instrumented locks —
     a 16-partition interest set negotiates a session, runs incremental
@@ -202,6 +248,7 @@ def run_stress() -> dict:
         _external_storm_leg()
         _fleet_leg()
         _session_leg()
+        _fastlane_leg()
     finally:
         lockdep.disable()
     return lockdep.report()
@@ -222,6 +269,7 @@ def run_races(seeds=SCHEDULE_SEEDS) -> tuple:
         _chaos_leg()
         _fleet_leg()
         _session_leg()
+        _fastlane_leg()
         for seed in seeds:
             fz = interleave.SchedFuzzer(seed)
             keys.append(fz.replay_key())
@@ -241,7 +289,7 @@ def races_main() -> int:
     rep, keys = run_races()
     print(races.format_report(rep))
     print(f"races: lockset sweep (engine pipeline + txn + fast chaos "
-          f"storm + fleet smoke + fetch sessions) + {len(keys)} seeded "
+          f"storm + fleet smoke + fetch sessions + fast lane) + {len(keys)} seeded "
           f"schedules {[k for k in keys]} "
           f"in {time.perf_counter() - t0:.1f}s")
     return 0 if races.clean(rep) else 1
@@ -253,7 +301,7 @@ def main() -> int:
     print(lockdep.format_report(rep))
     print(f"stress: engine pipeline + txn commit/abort + fast chaos "
           f"storm + external SIGKILL storm + fleet smoke + fetch "
-          f"sessions in {time.perf_counter() - t0:.1f}s")
+          f"sessions + fast lane in {time.perf_counter() - t0:.1f}s")
     return 0 if lockdep.clean(rep) else 1
 
 

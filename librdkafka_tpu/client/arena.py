@@ -9,9 +9,13 @@ take()s contiguous runs that the native framer consumes directly —
 ``ArenaBatch`` is that run flowing through the same produce pipeline as
 a ``list[Message]`` batch (codec phase → send → response → retry/DR).
 
-Eligibility (checked in Kafka.produce): no interceptors (on_send must
-fire per message at produce() time), explicit partition, bytes/None
-key+value, no headers/on_delivery/opaque/timestamp.  DR consumers
+Eligibility (checked in Kafka.produce / the native Lane): no
+interceptors (on_send must fire per message at produce() time),
+bytes/None key+value, no on_delivery/opaque.  Widened in PR 16:
+explicit partition OR murmur2 auto-partition (native hash, bit-exact
+vs utils/hash.murmur2), explicit timestamps (per-record int64 side
+array, 0 = batch build time), and record headers (pre-encoded wire
+blobs in a side arena — the framer memcpys them).  DR consumers
 (dr_msg_cb/dr_cb/"dr" events/background) do NOT demote: delivery
 reports materialize Message objects from the arena run at DR time
 (dr_msgq → to_messages → materialize_arena), off the produce() path.
@@ -92,6 +96,20 @@ class _PyLane:  # lint: ok shared-state
     def map_del(self, topic, partition):
         return self.map.pop((topic, partition), None)
 
+    def part_set(self, topic, partition_cnt, mode):
+        """No-op: the stand-in never auto-partitions natively."""
+
+    def part_del(self, topic):
+        """No-op counterpart of part_set."""
+
+    def counters(self):
+        """Same shape as the native Lane.counters() — all zero (every
+        produce() routed to the fallback)."""
+        return {"engaged": 0,
+                "fallback": {"disabled": 0, "shape": 0, "oversize": 0,
+                             "queue_full": 0, "no_entry": 0,
+                             "auto_partition": 0}}
+
     def produce(self, *args, **kwargs):
         return self._fallback(*args, **kwargs)
 
@@ -103,23 +121,71 @@ def lane_new():
     return m.Lane() if m else _PyLane()
 
 
+def encode_headers(hdrs) -> Optional[bytes]:
+    """Pre-encode a headers sequence into the arena side-blob framing —
+    varint(nh) + per-header varint(len(key))+key + varint(len(val)|-1)
+    [+val] — exactly the record-tail bytes the native framer memcpys.
+    Returns None when the shape is fast-lane ineligible (non-str/bytes
+    keys, non-bytes values, not a sequence of 2-tuples)."""
+    from ..utils import varint
+    enc = varint.enc_i64
+    try:
+        out = bytearray(enc(len(hdrs)))
+        for hk, hv in hdrs:
+            hkb = hk.encode() if isinstance(hk, str) else hk
+            if not isinstance(hkb, bytes):
+                return None
+            out += enc(len(hkb))
+            out += hkb
+            if hv is None:
+                out.append(1)                   # varint(-1)
+            elif isinstance(hv, bytes):
+                out += enc(len(hv))
+                out += hv
+            else:
+                return None
+        return bytes(out)
+    except (TypeError, ValueError):
+        return None
+
+
+def decode_hblob(blob) -> list:
+    """Inverse of encode_headers: [(str key, bytes|None value)] —
+    demotion drains and DR materialization rebuild Message.headers
+    from the side-arena blob."""
+    from ..utils.buf import Slice
+    sl = Slice(bytes(blob))
+    out = []
+    for _ in range(sl.read_varint()):
+        hk = sl.read(sl.read_varint()).decode("utf-8", "replace")
+        vl = sl.read_varint()
+        out.append((hk, None if vl < 0 else sl.read(vl)))
+    return out
+
+
 class ArenaBatch:
     """One taken arena run: the fast-lane analog of list[Message].
 
     ``base`` is the concatenated key||value payload bytes; ``klens`` /
     ``vlens`` are raw little-endian int32 arrays (-1 = null) that
-    tk_frame_v2 reads in place.  msgid_base is assigned at take() time
+    tk_frame_v2 reads in place.  Widened runs additionally carry
+    ``tss`` (raw int64 per-record create timestamps, 0 = batch build
+    time), and ``hbuf``/``hlens`` (concatenated pre-encoded header
+    blobs + raw int32 per-record blob lengths); all three are None for
+    the all-default hot shape.  msgid_base is assigned at take() time
     under the toppar lock — idempotent sequence numbering is identical
     to the Message path's per-enqueue assignment because takes are
     FIFO and exclusive."""
 
     __slots__ = ("base", "klens", "vlens", "count", "nbytes",
                  "msgid_base", "enq_first", "enq_last", "retries",
-                 "possibly_persisted")
+                 "possibly_persisted", "tss", "hbuf", "hlens")
 
     def __init__(self, base: bytes, klens: bytes, vlens: bytes,
                  count: int, nbytes: int, enq_first_us: int,
-                 enq_last_us: int):
+                 enq_last_us: int, tss: Optional[bytes] = None,
+                 hbuf: Optional[bytes] = None,
+                 hlens: Optional[bytes] = None):
         self.base = base
         self.klens = klens
         self.vlens = vlens
@@ -127,6 +193,9 @@ class ArenaBatch:
         self.nbytes = nbytes
         self.enq_first = enq_first_us / 1e6     # time.monotonic() seconds
         self.enq_last = enq_last_us / 1e6
+        self.tss = tss
+        self.hbuf = hbuf
+        self.hlens = hlens
         self.msgid_base = 0
         self.retries = 0
         self.possibly_persisted = False
@@ -145,7 +214,9 @@ class ArenaBatch:
 
         m_ = _mod()
         mat = getattr(m_, "materialize_arena_lazy", None) if m_ else None
-        if mat is not None:
+        # widened runs (explicit ts / headers) take the eager path so
+        # every Message carries its real timestamp + decoded headers
+        if mat is not None and self.tss is None and self.hbuf is None:
             out = mat(FetchMessage, self.base, self.klens, self.vlens,
                       self.count, topic, partition, base_offset,
                       int(time.time() * 1000), proto.TSTYPE_CREATE_TIME,
@@ -166,7 +237,7 @@ class ArenaBatch:
 
         m_ = _mod()
         mat = getattr(m_, "materialize_arena", None) if m_ else None
-        if mat is not None:
+        if (mat is not None and self.tss is None and self.hbuf is None):
             out = mat(Message, self.base, self.klens, self.vlens,
                       self.count, topic, partition, base_offset,
                       self.msgid_base, self.enq_first, self.retries,
@@ -179,8 +250,13 @@ class ArenaBatch:
 
         kl = np.frombuffer(self.klens, np.int32)
         vl = np.frombuffer(self.vlens, np.int32)
+        tsv = (np.frombuffer(self.tss, np.int64)
+               if self.tss is not None else None)
+        hl = (np.frombuffer(self.hlens, np.int32)
+              if self.hbuf is not None else None)
         out = []
         off = 0
+        hoff = 0
         for i in range(self.count):
             k = v = None
             if kl[i] >= 0:
@@ -189,7 +265,14 @@ class ArenaBatch:
             if vl[i] >= 0:
                 v = self.base[off:off + vl[i]]
                 off += int(vl[i])
-            m = Message(topic, value=v, key=k, partition=partition)
+            hdrs = ()
+            if hl is not None and hl[i] > 0:
+                hdrs = decode_hblob(
+                    self.hbuf[hoff:hoff + int(hl[i])])
+                hoff += int(hl[i])
+            ts = int(tsv[i]) if tsv is not None else 0
+            m = Message(topic, value=v, key=k, partition=partition,
+                        headers=hdrs, timestamp=ts)
             m.msgid = self.msgid_base + i
             m.enq_time = self.enq_first
             m.retries = self.retries

@@ -217,7 +217,8 @@ def _begin_codec_phase(rk, ready: list):
                 t0 = _trace.now() if _trace.enabled else 0
                 wire = build(msgs.base, msgs.klens, msgs.vlens,
                              msgs.count, w.now_ms, w.pid, w.epoch,
-                             w.base_seq, w.codec_id, w.attrs)
+                             w.base_seq, w.codec_id, w.attrs,
+                             msgs.tss, msgs.hbuf, msgs.hlens)
                 if t0:
                     # the one-call frame+compress+CRC fast lane
                     _trace.complete("produce", "fused_build", t0,
@@ -1303,7 +1304,7 @@ class Broker:
                 if not tp.arena_ok:
                     # records appended concurrently with a demotion:
                     # convert them so the Message path below carries them
-                    rk._demote(tp)
+                    rk._demote(tp, "race")
                     tp.xmit_move()
                 elif not tp.xmit_msgq:
                     if now < tp.retry_backoff_until:
@@ -1320,6 +1321,7 @@ class Broker:
                                 and now - first_us / 1e6 >= linger)
                     if not (full or lingered or flush_forced):
                         continue
+                    t0 = _trace.now() if _trace.enabled else 0
                     with tp.lock:
                         run = tp.arena.take(
                             batch_max, rk.conf.get("message.max.bytes"))
@@ -1333,6 +1335,13 @@ class Broker:
                         tp.next_msgid += b.count
                         tp.inflight_msgids.add(b.msgid_base)
                         tp.inflight += 1
+                    if t0:
+                        # per-stage attribution: broker-thread run take
+                        # (arena → ArenaBatch descriptor, under tp.lock)
+                        _trace.complete("produce", "run_take", t0,
+                                        {"topic": tp.topic,
+                                         "partition": tp.partition,
+                                         "msgs": b.count})
                     ready.append((tp, b,
                                   None if legacy else
                                   self._make_writer(tp, b, self._codec_for(tp, codec))))
@@ -1509,7 +1518,14 @@ class Broker:
                            codec=None if codec == "none" else codec)
         if isinstance(msgs, ArenaBatch):
             # fast lane: ONE native call straight off the arena buffers
+            t0 = _trace.now() if _trace.enabled else 0
             w.build_arena(msgs, now_ms)
+            if t0:
+                # per-stage attribution: arena run → framed records
+                _trace.complete("produce", "native_frame", t0,
+                                {"topic": tp.topic,
+                                 "partition": tp.partition,
+                                 "msgs": msgs.count})
         else:
             # Message duck-types Record (key/value/headers/timestamp) —
             # no per-message conversion on the hot path

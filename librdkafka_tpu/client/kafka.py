@@ -27,7 +27,9 @@ from ..protocol.msgset import (iter_batches, parse_fetch_messages_v2,
                                parse_msgset_v01, parse_records_v2,
                                verify_crc_v2)
 from ..protocol.proto import ApiKey
-from .arena import ArenaBatch, arena_new, batch_msgids, lane_new
+from ..utils.hash import murmur2_partition
+from .arena import (ArenaBatch, arena_new, batch_msgids, decode_hblob,
+                    encode_headers, lane_new)
 from .broker import Broker, Request
 from .conf import Conf, TopicConf
 from .errors import Err, KafkaError, KafkaException
@@ -166,6 +168,11 @@ class Kafka:  # lint: ok shared-state
     # metadata cache: mutations happen under kafka.metadata on
     # rdk:main; declared so the sweep sees its access pattern
     metadata = shared("kafka.metadata_cache")
+    # fast-lane demotion breakdown: RMW'd under kafka.msg_cnt from the
+    # app thread (_produce_slow/_partition_and_enq) AND the broker
+    # serve thread (concurrent-append race demote); the stats emitter
+    # snapshot-reads it
+    _demote_reasons = shared("kafka.demote_reasons")
 
     def __init__(self, conf: Conf, client_type: str):
         self.conf = conf
@@ -281,6 +288,8 @@ class Kafka:  # lint: ok shared-state
         # dict hit replaces topic lookup + partition check + toppar
         # lookup on the produce hot path
         self._fast_tp: dict = {}
+        # per-reason demotion counts (stats arena.demoted breakdown)
+        self._demote_reasons: dict = {}
         # the lane's C produce() is the public entry point: eligible
         # records never touch a Python frame; everything else tails into
         # _produce_slow (the Message pipeline + first-sight setup)
@@ -701,6 +710,10 @@ class Kafka:  # lint: ok shared-state
             if topic is not None:
                 with topic.lock:
                     topic.partition_cnt = len(t["partitions"])
+                # partition count changed ⇒ the lane's cached native
+                # auto-partition entry is stale; drop it and let the
+                # next produce() re-register via _fast_partition
+                self._lane.part_del(name)
                 if self.is_producer:
                     self._fail_unknown_partitions(name, len(t["partitions"]))
             for p in t["partitions"]:
@@ -882,9 +895,11 @@ class Kafka:  # lint: ok shared-state
                 tp.retry_batches.clear()
                 if tp.arena is not None:
                     if dr_wanted:
-                        for k, v in tp.arena.drain_records():
-                            failed.append(Message(tp.topic, value=v, key=k,
-                                                  partition=tp.partition))
+                        for k, v, mts, hb in tp.arena.drain_records():
+                            failed.append(Message(
+                                tp.topic, value=v, key=k,
+                                partition=tp.partition, timestamp=mts,
+                                headers=decode_hblob(hb) if hb else ()))
                     else:
                         c, nb = tp.arena.clear()
                         fast_cnt += c
@@ -932,8 +947,11 @@ class Kafka:  # lint: ok shared-state
         of the default topic conf for this topic only."""
         t = self.get_topic(name)
         t.conf.update(conf)
-        if "partitioner" in conf:
+        if "partitioner" in conf or "partitioner_cb" in conf:
             t.partitioner = partitioner_fn(t.conf.get("partitioner"))
+            # invalidate the lane's cached native auto-partition entry;
+            # the next UA produce re-registers via _fast_partition
+            self._lane.part_del(name)
 
     def get_toppar(self, topic: str, partition: int,
                    create: bool = True) -> Optional[Toppar]:
@@ -1003,13 +1021,27 @@ class Kafka:  # lint: ok shared-state
             self._lane.acct(1, sz)
         # native enqueue fast lane: no Message object, one C call into
         # the per-toppar arena (queue accounting above is shared;
-        # _fast_lane stays fresh via the conf.add_listener hook)
-        if (self._fast_lane and partition >= 0 and not headers
-                and on_delivery is None and opaque is None and not timestamp
+        # _fast_lane stays fresh via the conf.add_listener hook).
+        # Widened eligibility (PR 16): explicit timestamps ride a side
+        # int64 array, headers pre-encode into a wire blob here (the
+        # framer memcpys it), and PARTITION_UA engages via the native
+        # murmur2 map when the topic's partitioner is murmur2-family.
+        if (self._fast_lane and on_delivery is None and opaque is None
                 and (value is None or type(value) is bytes)
                 and (key is None or type(key) is bytes)
-                and self._produce_fast(topic, key, value, partition, sz)):
-            return
+                and type(timestamp) is int and timestamp >= 0):
+            hblob = encode_headers(headers) if headers else None
+            if not headers or hblob is not None:
+                if partition >= 0:
+                    if self._produce_fast(topic, key, value, partition,
+                                          sz, timestamp, hblob):
+                        return
+                elif partition == PARTITION_UA:
+                    p = self._fast_partition(topic, key)
+                    if (p >= 0
+                            and self._produce_fast(topic, key, value, p,
+                                                   sz, timestamp, hblob)):
+                        return
         m = Message(topic, value=value, key=key, partition=partition,
                     headers=headers, timestamp=timestamp, opaque=opaque)
         if on_delivery is not None:
@@ -1040,7 +1072,10 @@ class Kafka:  # lint: ok shared-state
             if tp is None:
                 tp = self.get_toppar(topic, partition)
             if tp.arena_ok:
-                self._demote(tp)    # Message path claims this toppar
+                # Message path claims this toppar (shape-ineligible
+                # produce: interceptors, on_delivery/opaque, str value
+                # kept as Message, oversize, ...)
+                self._demote(tp, "ineligible")
             if tp.enq_msg(m):
                 self._wake_leader(tp)
 
@@ -1077,15 +1112,40 @@ class Kafka:  # lint: ok shared-state
         except AttributeError:
             pass                        # lane not constructed yet
 
+    def _fast_partition(self, topic: str, key) -> int:
+        """Auto-partition for the fast lane: murmur2-family partitioners
+        compute natively-reproducible partitions (bit-exact vs
+        utils/hash.murmur2), so PARTITION_UA produces stay eligible.
+        Registers (partition_cnt, mode) with the C lane so subsequent
+        UA produces never enter a Python frame.  Returns -1 (fall back
+        to the Message path / Python partitioner) for partitioner_cb,
+        non-murmur2 partitioners, unknown partition counts, and
+        murmur2_random with a falsy key (random must stay Python's
+        RNG)."""
+        t = self.topics.get(topic)
+        if t is None:
+            t = self.get_topic(topic)
+        if t.conf.get("partitioner_cb"):
+            return -1
+        mode = {"murmur2": 1,
+                "murmur2_random": 2}.get(t.conf.get("partitioner"), 0)
+        cnt = t.partition_cnt           # int read: GIL-atomic, no lock
+        if mode == 0 or cnt <= 0:
+            return -1
+        self._lane.part_set(topic, cnt, mode)
+        if mode == 2 and not key:
+            return -1                   # falsy key → random partitioner
+        return murmur2_partition(key or b"", cnt)
+
     def _produce_fast(self, topic: str, key, value, partition: int,
-                      sz: int) -> bool:
+                      sz: int, timestamp: int = 0, hblob=None) -> bool:
         """Fast-lane enqueue; False = caller falls back to the Message
         path (queue accounting stays — both paths share it)."""
         tp = self._fast_tp.get((topic, partition))
         if tp is not None:
             if not tp.arena_ok:         # demoted since caching
                 return False
-            if tp.arena.append(key, value) == 1:
+            if tp.arena.append(key, value, timestamp, hblob) == 1:
                 self._wake_leader(tp)   # wake on empty→non-empty only
             return True
         # ---- first sight: validate, create the arena, cache ------------
@@ -1122,7 +1182,7 @@ class Kafka:  # lint: ok shared-state
         # toppar never enter a Python frame (map_set keeps the lane's
         # last-topic lookup cache coherent — never mutate map directly)
         self._lane.map_set(topic, partition, (a, tp))
-        if a.append(key, value) == 1:
+        if a.append(key, value, timestamp, hblob) == 1:
             self._wake_leader(tp)
         return True
 
@@ -1136,17 +1196,24 @@ class Kafka:  # lint: ok shared-state
         if tp is None:
             tp = self.get_toppar(topic.name, m.partition)
         if tp.arena_ok:
-            self._demote(tp)        # Message path claims this toppar
+            # a Python-partitioned message (random/consistent family,
+            # partitioner_cb, or murmur2_random falsy key) claims this
+            # toppar for the Message path
+            self._demote(tp, "partitioner")
         if tp.enq_msg(m):
             self._wake_leader(tp)
 
-    def _demote(self, tp: Toppar) -> None:
+    def _demote(self, tp: Toppar, reason: str = "ineligible") -> None:
         """Permanently route a toppar through the Message path: remove
         it from the C entry's map FIRST so no new fast-lane records land
-        while the arena drains into the msgq (FIFO preserved)."""
+        while the arena drains into the msgq (FIFO preserved).
+        ``reason`` feeds the stats ``arena.demoted`` breakdown."""
         key = (tp.topic, tp.partition)
         self._lane.map_del(tp.topic, tp.partition)
         self._fast_tp.pop(key, None)
+        with self._msg_cnt_lock:
+            self._demote_reasons[reason] = (
+                self._demote_reasons.get(reason, 0) + 1)
         tp.demote_arena()
 
     def _wake_leader(self, tp: Toppar):
@@ -1434,10 +1501,11 @@ class Kafka:  # lint: ok shared-state
                     tp.retry_batches.clear()
                     if tp.arena is not None:
                         if dr_wanted:
-                            for k, v in tp.arena.drain_records():
-                                purged.append(
-                                    Message(tp.topic, value=v, key=k,
-                                            partition=tp.partition))
+                            for k, v, mts, hb in tp.arena.drain_records():
+                                purged.append(Message(
+                                    tp.topic, value=v, key=k,
+                                    partition=tp.partition, timestamp=mts,
+                                    headers=decode_hblob(hb) if hb else ()))
                         else:
                             c, nb = tp.arena.clear()
                             fast_cnt += c
@@ -1509,10 +1577,11 @@ class Kafka:  # lint: ok shared-state
                     cutoff = int((now - tmo) * 1e6)
                     if dr_wanted:
                         # materialize for error DRs (dr_msgq accounts)
-                        for k, v in tp.arena.expire_records(cutoff):
-                            expired.append(
-                                Message(tp.topic, value=v, key=k,
-                                        partition=tp.partition))
+                        for k, v, mts, hb in tp.arena.expire_records(cutoff):
+                            expired.append(Message(
+                                tp.topic, value=v, key=k,
+                                partition=tp.partition, timestamp=mts,
+                                headers=decode_hblob(hb) if hb else ()))
                     else:
                         c, nb = tp.arena.expire(cutoff)
                         fast_cnt += c

@@ -165,10 +165,12 @@ class Toppar:
             self.arena_ok = False
             if self.arena is None or len(self.arena) == 0:
                 return
+            from .arena import decode_hblob
             recs = self.arena.drain_records()
-            for k, v in recs:
+            for k, v, mts, hb in recs:
                 m = Message(self.topic, value=v, key=k,
-                            partition=self.partition)
+                            partition=self.partition, timestamp=mts,
+                            headers=decode_hblob(hb) if hb else ())
                 m.msgid = self.next_msgid
                 self.next_msgid += 1
                 self.msgq.append(m)

@@ -79,7 +79,10 @@ class Producer:
             if batch_c is not None and isinstance(msgs, list):
                 # native run: eligible records append straight into
                 # their arenas with no Python frame per record; the C
-                # side stops at the first item needing this path
+                # side stops at the first item needing the per-item
+                # path below — which itself stays on the (widened)
+                # fast lane for explicit timestamps, headers, and
+                # murmur2 auto-partition via Kafka._produce_slow
                 nxt, appended = batch_c(topic, msgs, i, partition)
                 n += appended
                 i = nxt

@@ -205,6 +205,13 @@ class StatsCollector:
             "brokers": brokers,
             "topics": topics,
         }
+        if rk.type == "producer":
+            # fast-lane engagement: cumulative native-lane appends plus
+            # the per-reason fallback/demotion breakdown — "workloads
+            # actually ride it" is machine-checkable (ISSUE 16)
+            with rk._msg_cnt_lock:
+                demoted = dict(rk._demote_reasons)
+            blob["arena"] = {**rk._lane.counters(), "demoted": demoted}
         # adaptive offload governor decisions (ISSUE 3): launch /
         # merge / fallback / warmup counters plus the cost-model gauges
         # from the async engine, when the tpu backend has spun one up

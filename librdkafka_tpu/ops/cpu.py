@@ -61,6 +61,10 @@ def lib() -> ctypes.CDLL:
         L.tk_frame_v2.restype = i64
         L.tk_frame_v2.argtypes = [ctypes.c_char_p, i32p, i32p, i64p,
                                   ctypes.c_int, u8p, i64]
+        L.tk_frame_v2_run.restype = i64
+        L.tk_frame_v2_run.argtypes = [ctypes.c_char_p, i32p, i32p, i64p,
+                                      i64, ctypes.c_char_p, i32p,
+                                      ctypes.c_int, u8p, i64, i64p, i64p]
         for name in ("tk_lz4f_bound", "tk_snappy_bound", "tk_lz4_block_bound",
                      "tk_snappy_uncompressed_length"):
             fn = getattr(L, name)
@@ -247,6 +251,37 @@ def frame_v2_raw(base: bytes, klens: bytes, vlens: bytes,
     if r < 0:
         raise ValueError("tk_frame_v2 capacity shortfall")
     return ctypes.string_at(buf.ctypes.data, int(r))
+
+
+def frame_v2_run(base: bytes, klens: bytes, vlens: bytes, count: int,
+                 now_ms: int, tss: bytes | None = None,
+                 hbuf: bytes | None = None, hlens: bytes | None = None,
+                 ) -> tuple[bytes, int, int]:
+    """Run-native framing for widened arena runs: per-record explicit
+    timestamps (raw int64 array; 0 = unset -> now_ms) and pre-encoded
+    header blobs (hbuf concatenation + raw int32 lens) straight from the
+    arena side buffers.  Returns (records, first_ts, max_ts) — the
+    header timestamps the batch assembler needs."""
+    L = lib()
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    ta = np.frombuffer(tss, dtype=np.int64) if tss is not None else None
+    ha = np.frombuffer(hlens, dtype=np.int32) if hlens is not None else None
+    cap = L.tk_frame_v2_bound(len(base) + (len(hbuf) if hbuf else 0), count)
+    buf, p = _frame_outbuf(cap)
+    ka = np.frombuffer(klens, dtype=np.int32)
+    va = np.frombuffer(vlens, dtype=np.int32)
+    first = ctypes.c_int64(now_ms)
+    last = ctypes.c_int64(now_ms)
+    r = L.tk_frame_v2_run(
+        base, ka.ctypes.data_as(i32p), va.ctypes.data_as(i32p),
+        ta.ctypes.data_as(i64p) if ta is not None else None,
+        now_ms, hbuf, ha.ctypes.data_as(i32p) if ha is not None else None,
+        count, p, cap, ctypes.byref(first), ctypes.byref(last))
+    if r < 0:
+        raise ValueError("tk_frame_v2_run capacity shortfall")
+    return (ctypes.string_at(buf.ctypes.data, int(r)),
+            int(first.value), int(last.value))
 
 
 # ------------------------------------------------------------- gzip/zstd ---

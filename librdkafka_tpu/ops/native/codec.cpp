@@ -791,6 +791,53 @@ EXPORT int64_t tk_frame_v2(const uint8_t *base, const int32_t *klens,
     return p - out;
 }
 
+// Run-native framer for the widened fast lane: per-record timestamps
+// (0 = unset -> now_ms, matching the slow path's "timestamp and
+// timestamp > 0 else now" rule) and PRE-ENCODED header blobs (each
+// blob already carries its header-count varint + per-header framing —
+// the enqueue lane encodes them once at produce() time).  tss/hbuf/
+// hlens may be NULL: NULL tss means every record stamps now_ms (zero
+// deltas), NULL hlens means every record writes varint(0) headers.
+// first/max effective timestamps come back for the v2 batch header.
+EXPORT int64_t tk_frame_v2_run(const uint8_t *base, const int32_t *klens,
+                               const int32_t *vlens, const int64_t *tss,
+                               int64_t now_ms, const uint8_t *hbuf,
+                               const int32_t *hlens, int count,
+                               uint8_t *out, int64_t cap,
+                               int64_t *first_ts, int64_t *max_ts) {
+    uint8_t *p = out;
+    const uint8_t *end = out + cap;
+    const uint8_t *src = base;
+    const uint8_t *hsrc = hbuf;
+    int64_t f = now_ms, mx = now_ms;
+    for (int i = 0; i < count; i++) {
+        int64_t ts = (tss && tss[i] > 0) ? tss[i] : now_ms;
+        if (i == 0) { f = ts; mx = ts; }
+        else if (ts > mx) mx = ts;
+        int64_t d = ts - f;                     // may be negative
+        int64_t kl = klens[i], vl = vlens[i];
+        int64_t hl = hlens ? hlens[i] : 0;
+        int64_t body = 1 + vi_size(d) + vi_size(i)
+                     + vi_size(kl) + (kl > 0 ? kl : 0)
+                     + vi_size(vl) + (vl > 0 ? vl : 0)
+                     + (hl > 0 ? hl : 1);
+        if (p + vi_size(body) + body > end) return -1;
+        p = vi_put(p, body);
+        *p++ = 0;                               // record attributes
+        p = vi_put(p, d);
+        p = vi_put(p, i);                       // offset delta
+        p = vi_put(p, kl);
+        if (kl > 0) { memcpy(p, src, kl); p += kl; src += kl; }
+        p = vi_put(p, vl);
+        if (vl > 0) { memcpy(p, src, vl); p += vl; src += vl; }
+        if (hl > 0) { memcpy(p, hsrc, hl); p += hl; hsrc += hl; }
+        else *p++ = 0;                          // varint(0) headers
+    }
+    if (first_ts) *first_ts = f;
+    if (max_ts) *max_ts = mx;
+    return p - out;
+}
+
 // ------------------------------------------------------ batched parallel --
 //
 // The provider seam (SURVEY.md §3.2) hands MANY independent per-partition

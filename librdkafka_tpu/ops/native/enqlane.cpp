@@ -14,9 +14,11 @@
 // key/value straight into it in ONE C call; the broker thread take()s a
 // contiguous run — base bytes + length arrays — that tk_frame_v2
 // consumes directly with no per-record Python work on either side.
-// Record timestamps are the batch build time (fast-lane messages carry
-// timestamp=0, i.e. "now"), so no per-record wall clock is stored; the
-// monotonic enq_us feeds message.timeout.ms and latency stats.
+// Records default to the batch build time (timestamp=0 = "now"); an
+// explicit produce(timestamp=) is stored per record, and headers are
+// pre-encoded into a side arena — the framer (tk_frame_v2_run) walks
+// all of it natively.  The monotonic enq_us feeds message.timeout.ms
+// and latency stats.
 //
 // Thread contract: every method holds the GIL for its entire (short)
 // duration — the GIL is the lock, exactly like the Python deques it
@@ -50,6 +52,16 @@ typedef struct {
     int32_t *vlens;      // -1 = null value
     int64_t *enq;        // CLOCK_MONOTONIC µs at append
     int64_t *boff;       // boff[i] = payload offset of record i; boff[count] = len
+    // widened eligibility (explicit timestamps + record headers):
+    // tss[i] is the record's CreateTime ms (0 = unset -> batch build
+    // time); hbuf is a side arena of PRE-ENCODED wire header blobs
+    // (count varint + per-header framing, encoded once at produce()
+    // time), hoff[i]..hoff[i+1] delimiting record i's blob (empty =
+    // no headers).  take() hands the framer these arrays verbatim.
+    int64_t *tss;
+    uint8_t *hbuf;
+    int64_t hcap;
+    int64_t *hoff;       // hoff[i] = header-blob offset; hoff[count] = used
     int32_t count, rcap;
     int32_t start;       // first un-taken record (partial takes)
 } Arena;
@@ -77,10 +89,28 @@ static int arena_grow_recs(Arena *a) {
     int64_t *ne = (int64_t *)PyMem_Realloc(a->enq, ncap * 8);
     if (!ne) { PyErr_NoMemory(); return -1; }
     a->enq = ne;
+    int64_t *nt = (int64_t *)PyMem_Realloc(a->tss, ncap * 8);
+    if (!nt) { PyErr_NoMemory(); return -1; }
+    a->tss = nt;
     int64_t *nb = (int64_t *)PyMem_Realloc(a->boff, (ncap + 1) * 8);
     if (!nb) { PyErr_NoMemory(); return -1; }
     a->boff = nb;
+    int64_t *nh = (int64_t *)PyMem_Realloc(a->hoff, (ncap + 1) * 8);
+    if (!nh) { PyErr_NoMemory(); return -1; }
+    a->hoff = nh;
     a->rcap = ncap;
+    return 0;
+}
+
+static int arena_grow_hbuf(Arena *a, int64_t need) {
+    int64_t used = a->hoff[a->count];
+    if (used + need <= a->hcap) return 0;
+    int64_t ncap = a->hcap ? a->hcap : 1 << 12;
+    while (used + need > ncap) ncap *= 2;
+    uint8_t *nb = (uint8_t *)PyMem_Realloc(a->hbuf, ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    a->hbuf = nb;
+    a->hcap = ncap;
     return 0;
 }
 
@@ -89,6 +119,7 @@ static void arena_reset(Arena *a) {
     a->start = 0;
     a->len = 0;
     a->boff[0] = 0;
+    a->hoff[0] = 0;
 }
 
 // Reclaim the consumed prefix: partial takes leave [0, boff[start])
@@ -98,47 +129,67 @@ static void arena_reset(Arena *a) {
 static void arena_compact(Arena *a) {
     int32_t live = a->count - a->start;
     int64_t base = a->boff[a->start];
+    int64_t hbase = a->hoff[a->start];
     if (live > 0) {
         memmove(a->buf, a->buf + base, (size_t)(a->len - base));
         memmove(a->klens, a->klens + a->start, (size_t)live * 4);
         memmove(a->vlens, a->vlens + a->start, (size_t)live * 4);
         memmove(a->enq, a->enq + a->start, (size_t)live * 8);
-        for (int32_t i = 0; i <= live; i++)
+        memmove(a->tss, a->tss + a->start, (size_t)live * 8);
+        if (hbase > 0)
+            memmove(a->hbuf, a->hbuf + hbase,
+                    (size_t)(a->hoff[a->count] - hbase));
+        for (int32_t i = 0; i <= live; i++) {
             a->boff[i] = a->boff[a->start + i] - base;
+            a->hoff[i] = a->hoff[a->start + i] - hbase;
+        }
         a->len -= base;
     } else {
         a->len = 0;
         a->boff[0] = 0;
+        a->hoff[0] = 0;
     }
     a->count = live;
     a->start = 0;
 }
 
 // Shared append body (arena_append + lane_produce): grow, compact a
-// large consumed prefix, copy payloads, stamp the record.
+// large consumed prefix, copy payloads, stamp the record.  ts_ms is
+// the record's CreateTime (0 = unset); hp/hl the pre-encoded header
+// blob (hl = 0: no headers).
 static int arena_do_append(Arena *a, const char *kp, int64_t kl,
-                           const char *vp, int64_t vl) {
+                           const char *vp, int64_t vl, int64_t ts_ms,
+                           const uint8_t *hp, int64_t hl) {
     int64_t need = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
     if (a->start > 0
         && (a->boff[a->start] >= (1 << 20) || a->start >= 8192))
         arena_compact(a);
     if (arena_grow_buf(a, need) < 0 || arena_grow_recs(a) < 0) return -1;
+    if (hl > 0 && arena_grow_hbuf(a, hl) < 0) return -1;
     if (kl > 0) { memcpy(a->buf + a->len, kp, kl); a->len += kl; }
     if (vl > 0) { memcpy(a->buf + a->len, vp, vl); a->len += vl; }
     int32_t i = a->count;
     a->klens[i] = (int32_t)kl;
     a->vlens[i] = (int32_t)vl;
     a->enq[i] = now_us();
+    a->tss[i] = ts_ms;
+    int64_t hused = a->hoff[i];
+    if (hl > 0) { memcpy(a->hbuf + hused, hp, hl); hused += hl; }
     a->count = i + 1;
     a->boff[a->count] = a->len;
+    a->hoff[a->count] = hused;
     return 0;
 }
 
-// append(key: bytes|None, value: bytes|None) -> remaining count
+// append(key: bytes|None, value: bytes|None[, ts_ms: int,
+//        hblob: bytes|None]) -> remaining count
+// ts_ms = 0 means "unset" (batch build time); hblob is a pre-encoded
+// wire header blob (see client/arena.py encode_headers).
 static PyObject *arena_append(Arena *a, PyObject *const *args,
                               Py_ssize_t nargs) {
-    if (nargs != 2) {
-        PyErr_SetString(PyExc_TypeError, "append(key, value)");
+    if (nargs < 2 || nargs > 4) {
+        PyErr_SetString(PyExc_TypeError,
+                        "append(key, value[, ts_ms, hblob])");
         return NULL;
     }
     PyObject *key = args[0], *val = args[1];
@@ -160,13 +211,35 @@ static PyObject *arena_append(Arena *a, PyObject *const *args,
         vl = PyBytes_GET_SIZE(val);
         vp = PyBytes_AS_STRING(val);
     }
-    if (arena_do_append(a, kp, kl, vp, vl) < 0) return NULL;
+    int64_t ts_ms = 0;
+    if (nargs >= 3) {
+        ts_ms = PyLong_AsLongLong(args[2]);
+        if (PyErr_Occurred()) return NULL;
+    }
+    const uint8_t *hp = NULL;
+    int64_t hl = 0;
+    if (nargs == 4 && args[3] != Py_None) {
+        if (!PyBytes_Check(args[3])) {
+            PyErr_SetString(PyExc_TypeError, "hblob must be bytes or None");
+            return NULL;
+        }
+        hl = PyBytes_GET_SIZE(args[3]);
+        hp = (const uint8_t *)PyBytes_AS_STRING(args[3]);
+    }
+    if (arena_do_append(a, kp, kl, vp, vl, ts_ms, hp, hl) < 0) return NULL;
     return PyLong_FromLong(a->count - a->start);
 }
 
 // take(max_count, max_bytes)
-//   -> (base, klens, vlens, count, nbytes, enq_first_us, enq_last_us)
+//   -> (base, klens, vlens, count, nbytes, enq_first_us, enq_last_us,
+//       tss|None, hbuf|None, hlens|None)
 //      | None when empty
+// tss is raw int64 timestamps (ms, 0 = unset) ONLY when some record in
+// the run carries an explicit timestamp; hbuf/hlens (concatenated
+// pre-encoded header blobs + raw int32 per-record blob lengths) ONLY
+// when some record carries headers.  The all-default run — the hot
+// shape — keeps the original 3-buffer descriptor (plus three Nones) so
+// the framer's zero-delta path stays allocation-minimal.
 static PyObject *arena_take(Arena *a, PyObject *const *args,
                             Py_ssize_t nargs) {
     if (nargs != 2) {
@@ -180,28 +253,51 @@ static PyObject *arena_take(Arena *a, PyObject *const *args,
     if (avail <= 0) Py_RETURN_NONE;
     int32_t n = 0;
     int64_t nb = 0;
+    int ts_any = 0;
     while (n < avail && n < max_count) {
         int64_t rl = a->boff[a->start + n + 1] - a->boff[a->start + n];
         if (n > 0 && nb + rl > max_bytes) break;
         nb += rl;
+        if (a->tss[a->start + n]) ts_any = 1;
         n++;
     }
     int32_t s = a->start;
+    int64_t h_total = a->hoff[s + n] - a->hoff[s];
     PyObject *base = PyBytes_FromStringAndSize(
         (const char *)(a->buf + a->boff[s]), nb);
     PyObject *kb = PyBytes_FromStringAndSize((const char *)(a->klens + s),
                                              (Py_ssize_t)n * 4);
     PyObject *vb = PyBytes_FromStringAndSize((const char *)(a->vlens + s),
                                              (Py_ssize_t)n * 4);
-    if (!base || !kb || !vb) {
+    PyObject *tsb = NULL, *hb = NULL, *hlb = NULL;
+    if (ts_any)
+        tsb = PyBytes_FromStringAndSize((const char *)(a->tss + s),
+                                        (Py_ssize_t)n * 8);
+    if (h_total > 0) {
+        hb = PyBytes_FromStringAndSize(
+            (const char *)(a->hbuf + a->hoff[s]), (Py_ssize_t)h_total);
+        hlb = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)n * 4);
+        if (hlb) {
+            int32_t *hl = (int32_t *)PyBytes_AS_STRING(hlb);
+            for (int32_t i = 0; i < n; i++)
+                hl[i] = (int32_t)(a->hoff[s + i + 1] - a->hoff[s + i]);
+        }
+    }
+    if (!base || !kb || !vb || (ts_any && !tsb)
+        || (h_total > 0 && (!hb || !hlb))) {
         Py_XDECREF(base); Py_XDECREF(kb); Py_XDECREF(vb);
+        Py_XDECREF(tsb); Py_XDECREF(hb); Py_XDECREF(hlb);
         return NULL;
     }
     int64_t ef = a->enq[s], el = a->enq[s + n - 1];
     a->start = s + n;
     if (a->start == a->count) arena_reset(a);
-    PyObject *r = Py_BuildValue("(NNNiLLL)", base, kb, vb, (int)n,
-                                (long long)nb, (long long)ef, (long long)el);
+    if (!tsb) { tsb = Py_None; Py_INCREF(tsb); }
+    if (!hb) { hb = Py_None; Py_INCREF(hb); }
+    if (!hlb) { hlb = Py_None; Py_INCREF(hlb); }
+    PyObject *r = Py_BuildValue("(NNNiLLLNNN)", base, kb, vb, (int)n,
+                                (long long)nb, (long long)ef, (long long)el,
+                                tsb, hb, hlb);
     return r;
 }
 
@@ -221,23 +317,17 @@ static PyObject *arena_expire(Arena *a, PyObject *arg) {
     return Py_BuildValue("(iL)", (int)n, (long long)nb);
 }
 
-// expire_records(cutoff_us) -> [(key|None, value|None), ...]: drop the
-// prefix enqueued at or before cutoff_us, MATERIALIZED — the
-// message.timeout.ms scan uses this instead of expire() when a
-// delivery-report consumer needs the records for error DRs
-static PyObject *arena_expire_records(Arena *a, PyObject *arg) {
-    int64_t cutoff = PyLong_AsLongLong(arg);
-    if (PyErr_Occurred()) return NULL;
-    int32_t n = 0;
-    while (a->start + n < a->count && a->enq[a->start + n] <= cutoff)
-        n++;
+// Materialize records [start, start+n) as (key|None, value|None, ts_ms,
+// hblob|None) tuples — shared by expire_records and drain_records.
+static PyObject *arena_record_tuples(Arena *a, int32_t n) {
     PyObject *list = PyList_New(n);
     if (!list) return NULL;
     for (int32_t i = 0; i < n; i++) {
         int32_t r = a->start + i;
         int64_t off = a->boff[r];
         int32_t kl = a->klens[r], vl = a->vlens[r];
-        PyObject *k, *v;
+        int64_t hl = a->hoff[r + 1] - a->hoff[r];
+        PyObject *k, *v, *ts, *h;
         if (kl < 0) { k = Py_None; Py_INCREF(k); }
         else {
             k = PyBytes_FromStringAndSize((const char *)(a->buf + off), kl);
@@ -246,15 +336,36 @@ static PyObject *arena_expire_records(Arena *a, PyObject *arg) {
         if (vl < 0) { v = Py_None; Py_INCREF(v); }
         else
             v = PyBytes_FromStringAndSize((const char *)(a->buf + off), vl);
-        if (!k || !v) {
-            Py_XDECREF(k); Py_XDECREF(v); Py_DECREF(list);
+        ts = PyLong_FromLongLong(a->tss[r]);
+        if (hl > 0)
+            h = PyBytes_FromStringAndSize(
+                (const char *)(a->hbuf + a->hoff[r]), (Py_ssize_t)hl);
+        else { h = Py_None; Py_INCREF(h); }
+        if (!k || !v || !ts || !h) {
+            Py_XDECREF(k); Py_XDECREF(v); Py_XDECREF(ts); Py_XDECREF(h);
+            Py_DECREF(list);
             return NULL;
         }
-        PyObject *t = PyTuple_Pack(2, k, v);
-        Py_DECREF(k); Py_DECREF(v);
+        PyObject *t = PyTuple_Pack(4, k, v, ts, h);
+        Py_DECREF(k); Py_DECREF(v); Py_DECREF(ts); Py_DECREF(h);
         if (!t) { Py_DECREF(list); return NULL; }
         PyList_SET_ITEM(list, i, t);
     }
+    return list;
+}
+
+// expire_records(cutoff_us) -> [(key, value, ts_ms, hblob|None), ...]:
+// drop the prefix enqueued at or before cutoff_us, MATERIALIZED — the
+// message.timeout.ms scan uses this instead of expire() when a
+// delivery-report consumer needs the records for error DRs
+static PyObject *arena_expire_records(Arena *a, PyObject *arg) {
+    int64_t cutoff = PyLong_AsLongLong(arg);
+    if (PyErr_Occurred()) return NULL;
+    int32_t n = 0;
+    while (a->start + n < a->count && a->enq[a->start + n] <= cutoff)
+        n++;
+    PyObject *list = arena_record_tuples(a, n);
+    if (!list) return NULL;
     a->start += n;
     if (a->start == a->count) arena_reset(a);
     return list;
@@ -268,35 +379,13 @@ static PyObject *arena_clear(Arena *a, PyObject *Py_UNUSED(ignored)) {
     return Py_BuildValue("(iL)", (int)n, (long long)nb);
 }
 
-// drain_records() -> [(key|None, value|None), ...]: demotion path when a
-// toppar mixes fast-lane and Message traffic (rare; FIFO preserved by
-// converting the arena prefix into Message objects)
+// drain_records() -> [(key, value, ts_ms, hblob|None), ...]: demotion
+// path when a toppar mixes fast-lane and Message traffic (rare; FIFO
+// preserved by converting the arena prefix into Message objects)
 static PyObject *arena_drain_records(Arena *a, PyObject *Py_UNUSED(ig)) {
     int32_t n = a->count - a->start;
-    PyObject *list = PyList_New(n);
+    PyObject *list = arena_record_tuples(a, n);
     if (!list) return NULL;
-    for (int32_t i = 0; i < n; i++) {
-        int32_t r = a->start + i;
-        int64_t off = a->boff[r];
-        int32_t kl = a->klens[r], vl = a->vlens[r];
-        PyObject *k, *v;
-        if (kl < 0) { k = Py_None; Py_INCREF(k); }
-        else {
-            k = PyBytes_FromStringAndSize((const char *)(a->buf + off), kl);
-            off += kl;
-        }
-        if (vl < 0) { v = Py_None; Py_INCREF(v); }
-        else
-            v = PyBytes_FromStringAndSize((const char *)(a->buf + off), vl);
-        if (!k || !v) {
-            Py_XDECREF(k); Py_XDECREF(v); Py_DECREF(list);
-            return NULL;
-        }
-        PyObject *t = PyTuple_Pack(2, k, v);
-        Py_DECREF(k); Py_DECREF(v);
-        if (!t) { Py_DECREF(list); return NULL; }
-        PyList_SET_ITEM(list, i, t);
-    }
     arena_reset(a);
     return list;
 }
@@ -321,9 +410,12 @@ static PyObject *arena_new(PyTypeObject *type, PyObject *args,
     if (!a) return NULL;
     a->buf = NULL; a->cap = 0; a->len = 0;
     a->klens = NULL; a->vlens = NULL; a->enq = NULL;
+    a->tss = NULL; a->hbuf = NULL; a->hcap = 0;
     a->boff = (int64_t *)PyMem_Malloc(8);
-    if (!a->boff) { Py_DECREF(a); return PyErr_NoMemory(); }
+    a->hoff = (int64_t *)PyMem_Malloc(8);
+    if (!a->boff || !a->hoff) { Py_DECREF(a); return PyErr_NoMemory(); }
     a->boff[0] = 0;
+    a->hoff[0] = 0;
     a->count = 0; a->rcap = 0; a->start = 0;
     return (PyObject *)a;
 }
@@ -333,7 +425,10 @@ static void arena_dealloc(Arena *a) {
     PyMem_Free(a->klens);
     PyMem_Free(a->vlens);
     PyMem_Free(a->enq);
+    PyMem_Free(a->tss);
+    PyMem_Free(a->hbuf);
     PyMem_Free(a->boff);
+    PyMem_Free(a->hoff);
     Py_TYPE(a)->tp_free((PyObject *)a);
 }
 
@@ -361,6 +456,12 @@ typedef struct {
     PyObject *cache_topic;    // strong ref, may be NULL
     PyObject *cache_entries;  // strong PyList of entry|None, may be NULL
     PyObject *cache_map;      // strong dict {topic -> PyList}, may be NULL
+    // native auto-partition: {topic -> (partition_cnt, mode)} installed
+    // by Python once metadata is known (part_set) and invalidated on
+    // metadata change (part_del).  mode 1 = "murmur2" (null/empty key
+    // hashes as b""), mode 2 = "murmur2_random" (falsy key falls back
+    // to the Python random partitioner).
+    PyObject *part_map;
     int64_t msg_cnt, msg_bytes;
     int64_t max_msgs, max_bytes;
     int64_t copy_max;     // message.copy.max.bytes: larger values keep a
@@ -368,6 +469,15 @@ typedef struct {
                           // being copied into the arena
     int enabled;          // conf-level eligibility (no DR consumers)
     int fatal;            // set_fatal_error happened: produce must raise
+    // engagement accounting (satellite: arena.engaged / per-reason
+    // fallback breakdown in stats JSON) — GIL-atomic like msg_cnt
+    int64_t c_engaged;       // records appended via the fast lane
+    int64_t c_fb_disabled;   // lane disabled / fatal / bad call shape
+    int64_t c_fb_shape;      // non-bytes payloads, callbacks, opaque...
+    int64_t c_fb_oversize;   // payload or header blob > copy_max
+    int64_t c_fb_qfull;      // queue-full: slow path raises
+    int64_t c_fb_noent;      // toppar not registered yet (first sight)
+    int64_t c_fb_autopart;   // partition=UA with no native partitioner
 } Lane;
 
 static PyObject *lane_new(PyTypeObject *type, PyObject *args,
@@ -381,10 +491,15 @@ static PyObject *lane_new(PyTypeObject *type, PyObject *args,
     l->cache_topic = NULL;
     l->cache_entries = NULL;
     l->cache_map = NULL;
+    l->part_map = PyDict_New();
+    if (!l->part_map) { Py_DECREF(l); return NULL; }
     l->msg_cnt = 0; l->msg_bytes = 0;
     l->max_msgs = 100000; l->max_bytes = 1LL << 30;
     l->copy_max = 65535;
     l->enabled = 0; l->fatal = 0;
+    l->c_engaged = 0;
+    l->c_fb_disabled = 0; l->c_fb_shape = 0; l->c_fb_oversize = 0;
+    l->c_fb_qfull = 0; l->c_fb_noent = 0; l->c_fb_autopart = 0;
     return (PyObject *)l;
 }
 
@@ -398,6 +513,7 @@ static int lane_traverse(Lane *l, visitproc visit, void *arg) {
     Py_VISIT(l->cache_topic);
     Py_VISIT(l->cache_entries);
     Py_VISIT(l->cache_map);
+    Py_VISIT(l->part_map);
     return 0;
 }
 
@@ -408,6 +524,7 @@ static int lane_clear(Lane *l) {
     Py_CLEAR(l->cache_topic);
     Py_CLEAR(l->cache_entries);
     Py_CLEAR(l->cache_map);
+    Py_CLEAR(l->part_map);
     return 0;
 }
 
@@ -457,6 +574,52 @@ static PyObject *lane_map_del(Lane *l, PyObject *const *args,
     Py_DECREF(key);
     lane_cache_invalidate(l);
     return ent;
+}
+
+// part_set(topic, partition_cnt, mode): enable native auto-partition
+// for the topic.  mode 1 = "murmur2", mode 2 = "murmur2_random" (falsy
+// keys still fall back to the Python random partitioner).
+static PyObject *lane_part_set(Lane *l, PyObject *const *args,
+                               Py_ssize_t nargs) {
+    if (nargs != 3) {
+        PyErr_SetString(PyExc_TypeError,
+                        "part_set(topic, partition_cnt, mode)");
+        return NULL;
+    }
+    if (!PyLong_Check(args[1]) || !PyLong_Check(args[2])) {
+        PyErr_SetString(PyExc_TypeError, "cnt and mode must be int");
+        return NULL;
+    }
+    PyObject *ent = PyTuple_Pack(2, args[1], args[2]);
+    if (!ent) return NULL;
+    int r = PyDict_SetItem(l->part_map, args[0], ent);
+    Py_DECREF(ent);
+    if (r < 0) return NULL;
+    Py_RETURN_NONE;
+}
+
+// part_del(topic): drop the topic's auto-partition entry (metadata
+// change invalidates the cached partition count)
+static PyObject *lane_part_del(Lane *l, PyObject *arg) {
+    if (PyDict_Contains(l->part_map, arg) == 1
+        && PyDict_DelItem(l->part_map, arg) < 0)
+        return NULL;
+    if (PyErr_Occurred()) return NULL;
+    Py_RETURN_NONE;
+}
+
+// counters() -> {"engaged": n, "fallback": {reason: n, ...}}
+static PyObject *lane_counters(Lane *l, PyObject *Py_UNUSED(ig)) {
+    return Py_BuildValue(
+        "{s:L,s:{s:L,s:L,s:L,s:L,s:L,s:L}}",
+        "engaged", (long long)l->c_engaged,
+        "fallback",
+        "disabled", (long long)l->c_fb_disabled,
+        "shape", (long long)l->c_fb_shape,
+        "oversize", (long long)l->c_fb_oversize,
+        "queue_full", (long long)l->c_fb_qfull,
+        "no_entry", (long long)l->c_fb_noent,
+        "auto_partition", (long long)l->c_fb_autopart);
 }
 
 static void lane_dealloc(Lane *l) {
@@ -569,10 +732,102 @@ static PyObject *lane_lookup(Lane *l, PyObject *topic, int64_t part,
     return ent;
 }
 
+// Java-compatible murmur2 (utils/hash.py murmur2; reference
+// rd_murmur2, rdmurmur2.c:19) — trailing bytes read as SIGNED chars,
+// exactly like org.apache.kafka.common.utils.Utils.murmur2.
+static uint32_t tk_murmur2(const uint8_t *data, int64_t n) {
+    const uint32_t M = 0x5BD1E995u;
+    uint32_t h = 0x9747B28Cu ^ (uint32_t)n;
+    int64_t i = 0;
+    for (; n - i >= 4; i += 4) {
+        uint32_t k = (uint32_t)data[i] | ((uint32_t)data[i + 1] << 8)
+                   | ((uint32_t)data[i + 2] << 16)
+                   | ((uint32_t)data[i + 3] << 24);
+        k *= M;
+        k ^= k >> 24;
+        k *= M;
+        h *= M;
+        h ^= k;
+    }
+    switch (n - i) {
+    case 3: h ^= (uint32_t)(int8_t)data[i + 2] << 16; /* fallthrough */
+    case 2: h ^= (uint32_t)(int8_t)data[i + 1] << 8;  /* fallthrough */
+    case 1: h ^= (uint32_t)(int8_t)data[i];
+            h *= M;
+    }
+    h ^= h >> 13;
+    h *= M;
+    h ^= h >> 15;
+    return h;
+}
+
+// zigzag varint append (protocol/varint.enc_i64 semantics)
+static void hv_varint(std::vector<uint8_t> &v, int64_t val) {
+    uint64_t z = ((uint64_t)val << 1) ^ (uint64_t)(val >> 63);
+    while (z >= 0x80) { v.push_back((uint8_t)(z | 0x80)); z >>= 7; }
+    v.push_back((uint8_t)z);
+}
+
+// Encode produce(headers=...) into the record's wire header framing
+// (count varint + per-header key/value framing) — the exact bytes
+// MsgsetWriterV2._build_py emits.  Accepts a tuple/list of (str|bytes,
+// bytes|None) 2-tuples; anything else returns -1 with NO exception
+// pending (the caller falls back to the Python Message path, which
+// owns the full normalization/raising semantics).
+static int encode_headers_blob(PyObject *hdrs, std::vector<uint8_t> &out) {
+    int is_tuple = PyTuple_Check(hdrs);
+    if (!is_tuple && !PyList_Check(hdrs)) return -1;
+    Py_ssize_t nh = is_tuple ? PyTuple_GET_SIZE(hdrs)
+                             : PyList_GET_SIZE(hdrs);
+    out.clear();
+    hv_varint(out, nh);
+    for (Py_ssize_t i = 0; i < nh; i++) {
+        PyObject *it = is_tuple ? PyTuple_GET_ITEM(hdrs, i)
+                                : PyList_GET_ITEM(hdrs, i);
+        if (!PyTuple_Check(it) || PyTuple_GET_SIZE(it) != 2) return -1;
+        PyObject *hk = PyTuple_GET_ITEM(it, 0);
+        PyObject *hv = PyTuple_GET_ITEM(it, 1);
+        const char *kp;
+        Py_ssize_t kl;
+        if (PyUnicode_Check(hk)) {
+            kp = PyUnicode_AsUTF8AndSize(hk, &kl);
+            if (!kp) { PyErr_Clear(); return -1; }
+        } else if (PyBytes_Check(hk)) {
+            kp = PyBytes_AS_STRING(hk);
+            kl = PyBytes_GET_SIZE(hk);
+        } else {
+            return -1;
+        }
+        hv_varint(out, kl);
+        out.insert(out.end(), (const uint8_t *)kp,
+                   (const uint8_t *)kp + kl);
+        if (hv == Py_None) {
+            hv_varint(out, -1);
+        } else if (PyBytes_Check(hv)) {
+            Py_ssize_t vl = PyBytes_GET_SIZE(hv);
+            hv_varint(out, vl);
+            const char *vp = PyBytes_AS_STRING(hv);
+            out.insert(out.end(), (const uint8_t *)vp,
+                       (const uint8_t *)vp + vl);
+        } else {
+            return -1;
+        }
+    }
+    return 0;
+}
+
+// per-thread header-blob scratch for lane_produce (file scope so the
+// eligibility gotos never jump over its declaration)
+static thread_local std::vector<uint8_t> lane_hscratch;
+
 // produce(topic, value=None, key=None, partition=-1, on_delivery=None,
 //         timestamp=0, headers=(), opaque=None)
 // The public producer entry point.  Eligible records append straight
 // into the per-toppar arena; everything else tail-calls the fallback.
+// Widened eligibility (ISSUE 16): explicit non-negative timestamps,
+// record headers (pre-encoded into the side arena), and partition=UA
+// via native murmur2 auto-partition when Python installed a part_map
+// entry for the topic.
 static PyObject *lane_produce(Lane *l, PyObject *const *args,
                               Py_ssize_t nargs, PyObject *kwnames) {
     PyObject *argv[8] = {NULL, NULL, NULL, NULL, NULL, NULL, NULL, NULL};
@@ -612,57 +867,124 @@ static PyObject *lane_produce(Lane *l, PyObject *const *args,
     }
     PyObject *topic = argv[0], *value = argv[1], *key = argv[2];
     PyObject *partition = argv[3];
-    int eligible =
-        eligible_kw && l->enabled && !l->fatal && topic != NULL
-        && PyUnicode_Check(topic)
-        && (value == NULL || value == Py_None || PyBytes_Check(value))
-        && (key == NULL || key == Py_None || PyBytes_Check(key))
-        && partition != NULL && PyLong_Check(partition)
-        && (argv[4] == NULL || argv[4] == Py_None)      // on_delivery
-        && (argv[5] == NULL                              // timestamp
-            || (PyLong_Check(argv[5]) && PyLong_AsLongLong(argv[5]) == 0))
-        && (argv[6] == NULL || argv[6] == Py_None        // headers
-            || (PyTuple_Check(argv[6]) && PyTuple_GET_SIZE(argv[6]) == 0)
-            || (PyList_Check(argv[6]) && PyList_GET_SIZE(argv[6]) == 0))
-        && (argv[7] == NULL || argv[7] == Py_None);      // opaque
-    if (eligible) {
-        long long part = PyLong_AsLongLong(partition);
-        if (part >= 0) {
-            // last-topic cache: pointer-identity topic + partition index
-            // replaces tuple-pack + dict-hash on the steady-state path
-            PyObject *ent = lane_lookup(l, topic, part, partition);
-            if (!ent && PyErr_Occurred()) return NULL;
-            if (ent) {
-                Arena *a = (Arena *)PyTuple_GET_ITEM(ent, 0);
-                int64_t kl = (key && key != Py_None)
-                                 ? PyBytes_GET_SIZE(key) : -1;
-                int64_t vl = (value && value != Py_None)
-                                 ? PyBytes_GET_SIZE(value) : -1;
-                int64_t sz = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
-                if (sz > l->copy_max)
-                    goto fallback;      // message.copy.max.bytes (and the
-                                        // message.max.bytes cap the caller
-                                        // folds in): keep a reference /
-                                        // let the slow path size-check
-                if (l->msg_cnt >= l->max_msgs
-                    || l->msg_bytes + sz > l->max_bytes)
-                    goto fallback;      // slow path raises _QUEUE_FULL
-                if (arena_do_append(
-                        a, kl >= 0 ? PyBytes_AS_STRING(key) : NULL, kl,
-                        vl >= 0 ? PyBytes_AS_STRING(value) : NULL, vl) < 0)
-                    return NULL;
-                l->msg_cnt += 1;
-                l->msg_bytes += sz;
-                if (a->count - a->start == 1 && l->wake) {
-                    // empty -> non-empty: wake the leader broker
-                    PyObject *tp = PyTuple_GET_ITEM(ent, 1);
-                    PyObject *r = PyObject_CallOneArg(l->wake, tp);
-                    if (!r) return NULL;
-                    Py_DECREF(r);
-                }
-                Py_RETURN_NONE;
-            }
+    PyObject *part_o = NULL;     // PyLong for lane_lookup (may be arg)
+    const uint8_t *hp = NULL;
+    int64_t hl = 0;
+    int64_t ts_ms = 0;
+    long long part = -1;
+    if (!l->enabled || l->fatal) { l->c_fb_disabled++; goto fallback; }
+    if (!eligible_kw || topic == NULL || !PyUnicode_Check(topic)
+        || !(value == NULL || value == Py_None || PyBytes_Check(value))
+        || !(key == NULL || key == Py_None || PyBytes_Check(key))
+        || (partition != NULL && !PyLong_Check(partition))
+        || !(argv[4] == NULL || argv[4] == Py_None)      // on_delivery
+        || !(argv[7] == NULL || argv[7] == Py_None)) {   // opaque
+        l->c_fb_shape++;
+        goto fallback;
+    }
+    if (argv[5] != NULL) {                               // timestamp
+        if (!PyLong_Check(argv[5])) { l->c_fb_shape++; goto fallback; }
+        ts_ms = PyLong_AsLongLong(argv[5]);
+        if (ts_ms < 0 || PyErr_Occurred()) {
+            PyErr_Clear();
+            l->c_fb_shape++;
+            goto fallback;
         }
+    }
+    if (argv[6] != NULL && argv[6] != Py_None) {         // headers
+        int empty =
+            (PyTuple_Check(argv[6]) && PyTuple_GET_SIZE(argv[6]) == 0)
+            || (PyList_Check(argv[6]) && PyList_GET_SIZE(argv[6]) == 0);
+        if (!empty) {
+            if (encode_headers_blob(argv[6], lane_hscratch) < 0) {
+                l->c_fb_shape++;
+                goto fallback;
+            }
+            hp = lane_hscratch.data();
+            hl = (int64_t)lane_hscratch.size();
+        }
+    }
+    if (partition != NULL) {
+        part = PyLong_AsLongLong(partition);
+        if (PyErr_Occurred()) {
+            PyErr_Clear();
+            l->c_fb_shape++;
+            goto fallback;
+        }
+        part_o = partition;
+    }
+    if (part < 0) {
+        // partition=UA: native murmur2 auto-partition.  part_map is
+        // installed by Python only for the murmur2-family partitioners
+        // once the topic's partition count is known (and dropped on
+        // metadata change), so a hit here is bit-exact vs the Python
+        // partitioner.
+        PyObject *pe = PyDict_GetItemWithError(l->part_map, topic);
+        if (!pe) {
+            if (PyErr_Occurred()) return NULL;
+            l->c_fb_autopart++;
+            goto fallback;
+        }
+        long long cnt = PyLong_AsLongLong(PyTuple_GET_ITEM(pe, 0));
+        long long mode = PyLong_AsLongLong(PyTuple_GET_ITEM(pe, 1));
+        int keyed = key != NULL && key != Py_None
+                    && PyBytes_GET_SIZE(key) > 0;
+        if (cnt <= 0 || (mode == 2 && !keyed)) {
+            // murmur2_random routes falsy keys through the Python
+            // random partitioner — not reproducible here
+            l->c_fb_autopart++;
+            goto fallback;
+        }
+        const uint8_t *kd = keyed
+            ? (const uint8_t *)PyBytes_AS_STRING(key)
+            : (const uint8_t *)"";
+        int64_t kn = keyed ? PyBytes_GET_SIZE(key) : 0;
+        part = (long long)((tk_murmur2(kd, kn) & 0x7FFFFFFFu)
+                           % (uint32_t)cnt);
+        part_o = NULL;           // lane_lookup builds the PyLong
+    }
+    {
+        // last-topic cache: pointer-identity topic + partition index
+        // replaces tuple-pack + dict-hash on the steady-state path
+        PyObject *ent = lane_lookup(l, topic, part, part_o);
+        if (!ent) {
+            if (PyErr_Occurred()) return NULL;
+            l->c_fb_noent++;
+            goto fallback;       // first sight: Python sets the entry up
+        }
+        Arena *a = (Arena *)PyTuple_GET_ITEM(ent, 0);
+        int64_t kl = (key && key != Py_None) ? PyBytes_GET_SIZE(key) : -1;
+        int64_t vl = (value && value != Py_None)
+                         ? PyBytes_GET_SIZE(value) : -1;
+        int64_t sz = (kl > 0 ? kl : 0) + (vl > 0 ? vl : 0);
+        if (sz > l->copy_max || hl > l->copy_max) {
+            l->c_fb_oversize++;
+            goto fallback;      // message.copy.max.bytes (and the
+                                // message.max.bytes cap the caller
+                                // folds in): keep a reference /
+                                // let the slow path size-check
+        }
+        if (l->msg_cnt >= l->max_msgs
+            || l->msg_bytes + sz > l->max_bytes) {
+            l->c_fb_qfull++;
+            goto fallback;      // slow path raises _QUEUE_FULL
+        }
+        if (arena_do_append(
+                a, kl >= 0 ? PyBytes_AS_STRING(key) : NULL, kl,
+                vl >= 0 ? PyBytes_AS_STRING(value) : NULL, vl,
+                ts_ms, hp, hl) < 0)
+            return NULL;
+        l->msg_cnt += 1;
+        l->msg_bytes += sz;
+        l->c_engaged += 1;
+        if (a->count - a->start == 1 && l->wake) {
+            // empty -> non-empty: wake the leader broker
+            PyObject *tp = PyTuple_GET_ITEM(ent, 1);
+            PyObject *r = PyObject_CallOneArg(l->wake, tp);
+            if (!r) return NULL;
+            Py_DECREF(r);
+        }
+        Py_RETURN_NONE;
     }
     // slow path: the Python Message pipeline (also first-sight setup)
 fallback:
@@ -756,10 +1078,12 @@ static PyObject *lane_produce_batch(Lane *l, PyObject *const *args,
         Arena *a = (Arena *)PyTuple_GET_ITEM(ent, 0);
         if (arena_do_append(
                 a, kl >= 0 ? PyBytes_AS_STRING(key) : NULL, kl,
-                vl >= 0 ? PyBytes_AS_STRING(value) : NULL, vl) < 0)
+                vl >= 0 ? PyBytes_AS_STRING(value) : NULL, vl,
+                0, NULL, 0) < 0)
             return NULL;
         l->msg_cnt += 1;
         l->msg_bytes += sz;
+        l->c_engaged += 1;
         appended++;
         if (a->count - a->start == 1 && l->wake) {
             PyObject *tp = PyTuple_GET_ITEM(ent, 1);
@@ -819,10 +1143,11 @@ static PyObject *lane_produce_raw(Lane *l, PyObject *const *args,
         const uint8_t *vp = vl > 0 ? src : NULL;
         if (vl > 0) src += vl;
         if (arena_do_append(a, (const char *)kp, kl,
-                            (const char *)vp, vl) < 0)
+                            (const char *)vp, vl, 0, NULL, 0) < 0)
             return NULL;
         l->msg_cnt += 1;
         l->msg_bytes += sz;
+        l->c_engaged += 1;
     }
     if (i > 0 && was_empty && l->wake) {
         PyObject *tp = PyTuple_GET_ITEM(ent, 1);
@@ -831,6 +1156,28 @@ static PyObject *lane_produce_raw(Lane *l, PyObject *const *args,
         Py_DECREF(r);
     }
     return PyLong_FromLongLong(i);
+}
+
+// murmur2_partition(key: bytes, partition_cnt: int) -> int
+// Module-level parity hook: the exact partition lane_produce computes
+// natively, exported so tests can sweep it against utils/hash.py.
+static PyObject *mod_murmur2_partition(PyObject *Py_UNUSED(self),
+                                       PyObject *const *args,
+                                       Py_ssize_t nargs) {
+    if (nargs != 2 || !PyBytes_Check(args[0])) {
+        PyErr_SetString(PyExc_TypeError,
+                        "murmur2_partition(key: bytes, cnt: int)");
+        return NULL;
+    }
+    long long cnt = PyLong_AsLongLong(args[1]);
+    if (PyErr_Occurred()) return NULL;
+    if (cnt <= 0) {
+        PyErr_SetString(PyExc_ValueError, "partition_cnt must be > 0");
+        return NULL;
+    }
+    uint32_t h = tk_murmur2((const uint8_t *)PyBytes_AS_STRING(args[0]),
+                            PyBytes_GET_SIZE(args[0]));
+    return PyLong_FromUnsignedLong((h & 0x7FFFFFFFu) % (uint32_t)cnt);
 }
 
 // ==================================================== fused builder =====
@@ -853,6 +1200,12 @@ int64_t tk_frame_v2_bound(int64_t payload_bytes, int count);
 int64_t tk_frame_v2(const uint8_t *base, const int32_t *klens,
                     const int32_t *vlens, const int64_t *ts_deltas,
                     int count, uint8_t *out, int64_t cap);
+int64_t tk_frame_v2_run(const uint8_t *base, const int32_t *klens,
+                        const int32_t *vlens, const int64_t *tss,
+                        int64_t now_ms, const uint8_t *hbuf,
+                        const int32_t *hlens, int count,
+                        uint8_t *out, int64_t cap,
+                        int64_t *first_ts, int64_t *max_ts);
 int64_t tk_lz4f_bound(int64_t n);
 int64_t tk_lz4f_compress_fast(const uint8_t *src, int64_t n,
                               uint8_t *dst, int64_t cap);
@@ -886,23 +1239,28 @@ static inline void be64(uint8_t *p, uint64_t v) {
 }
 
 // build_batch(base, klens, vlens, count, now_ms, pid, epoch, base_seq,
-//             codec_id[, attr_flags]) -> bytes
+//             codec_id[, attr_flags[, tss, hbuf, hlens]]) -> bytes
 // codec_id: 0 none, 2 snappy, 3 lz4 (the wire attribute values).
 // attr_flags: extra v2 attribute bits OR'd into the attribute word
 // (the transactional bit 0x10 for EOS batches; codec bits still come
 // from the compression outcome).
-// All records carry timestamp now_ms (fast-lane contract: timestamp=0 =
-// batch build time), so first=max=now_ms and every delta is 0 — exactly
-// what MsgsetWriterV2.build_arena emits.
+// tss/hbuf/hlens (each bytes|None) are the arena run's per-record
+// explicit-timestamp int64s and pre-encoded header blobs; with all
+// three None every record carries now_ms (fast-lane default) so
+// first=max=now_ms and every delta is 0 — exactly what
+// MsgsetWriterV2._build_py emits for the same records.
 static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
                                  PyObject *const *args, Py_ssize_t nargs) {
-    if (nargs != 9 && nargs != 10) {
+    if (nargs != 9 && nargs != 10 && nargs != 13) {
         PyErr_SetString(PyExc_TypeError,
                         "build_batch(base, klens, vlens, count, now_ms, "
-                        "pid, epoch, base_seq, codec_id[, attr_flags])");
+                        "pid, epoch, base_seq, codec_id[, attr_flags"
+                        "[, tss, hbuf, hlens]])");
         return NULL;
     }
     Py_buffer base, kb, vb;
+    Py_buffer tsb = {0}, hb = {0}, hlb = {0};
+    int has_ts = 0, has_h = 0;
     if (PyObject_GetBuffer(args[0], &base, PyBUF_SIMPLE) < 0) return NULL;
     if (PyObject_GetBuffer(args[1], &kb, PyBUF_SIMPLE) < 0) {
         PyBuffer_Release(&base); return NULL;
@@ -916,17 +1274,39 @@ static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
     int64_t epoch = PyLong_AsLongLong(args[6]);
     int64_t base_seq = PyLong_AsLongLong(args[7]);
     int64_t codec = PyLong_AsLongLong(args[8]);
-    int64_t attr_flags = nargs == 10 ? PyLong_AsLongLong(args[9]) : 0;
+    int64_t attr_flags = nargs >= 10 ? PyLong_AsLongLong(args[9]) : 0;
     PyObject *out = NULL;
     if (PyErr_Occurred()) goto done;
+    if (nargs == 13) {
+        if (args[10] != Py_None) {
+            if (PyObject_GetBuffer(args[10], &tsb, PyBUF_SIMPLE) < 0)
+                goto done;
+            has_ts = 1;
+        }
+        if (args[11] != Py_None) {
+            if (PyObject_GetBuffer(args[11], &hb, PyBUF_SIMPLE) < 0)
+                goto done;
+            has_h = 1;
+            if (args[12] == Py_None
+                || PyObject_GetBuffer(args[12], &hlb, PyBUF_SIMPLE) < 0) {
+                if (!PyErr_Occurred())
+                    PyErr_SetString(PyExc_ValueError,
+                                    "build_batch: hbuf without hlens");
+                goto done;
+            }
+        }
+    }
     if (count <= 0 || (int64_t)kb.len < count * 4
         || (int64_t)vb.len < count * 4
+        || (has_ts && (int64_t)tsb.len < count * 8)
+        || (has_h && (int64_t)hlb.len < count * 4)
         || (codec != 0 && codec != 2 && codec != 3)) {
         PyErr_SetString(PyExc_ValueError, "build_batch: bad arguments");
         goto done;
     }
     {
-        int64_t fbound = tk_frame_v2_bound(base.len, (int)count);
+        int64_t fbound = tk_frame_v2_bound(
+            base.len + (has_h ? (int64_t)hb.len : 0), (int)count);
         // worst-case payload: compressed bound, or the raw records when
         // incompressible (stored plain, attributes codec bits = 0)
         int64_t cap;
@@ -938,29 +1318,33 @@ static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
         if (!out) goto done;
         uint8_t *o = (uint8_t *)PyBytes_AS_STRING(out);
         int64_t rlen = -1, plen = -1;
+        int64_t first_ts = now_ms, max_ts = now_ms;
         int attr_codec = 0;
+        const int64_t *tss_p =
+            has_ts ? (const int64_t *)tsb.buf : NULL;
+        const uint8_t *hbuf_p = has_h ? (const uint8_t *)hb.buf : NULL;
+        const int32_t *hlens_p = has_h ? (const int32_t *)hlb.buf : NULL;
         // per-thread scratch for the uncompressed records (reused
         // across batches; freed when the thread exits)
         static thread_local std::vector<uint8_t> scratch;
-        static thread_local std::vector<int64_t> zero_deltas;
         Py_BEGIN_ALLOW_THREADS
-        if ((int64_t)zero_deltas.size() < count)
-            zero_deltas.assign((size_t)count, 0);
         if (codec == 0) {
-            rlen = tk_frame_v2((const uint8_t *)base.buf,
-                               (const int32_t *)kb.buf,
-                               (const int32_t *)vb.buf,
-                               zero_deltas.data(), (int)count,
-                               o + V2_HDR, cap);
+            rlen = tk_frame_v2_run((const uint8_t *)base.buf,
+                                   (const int32_t *)kb.buf,
+                                   (const int32_t *)vb.buf,
+                                   tss_p, now_ms, hbuf_p, hlens_p,
+                                   (int)count, o + V2_HDR, cap,
+                                   &first_ts, &max_ts);
             plen = rlen;
         } else {
             if ((int64_t)scratch.size() < fbound)
                 scratch.resize((size_t)fbound);
-            rlen = tk_frame_v2((const uint8_t *)base.buf,
-                               (const int32_t *)kb.buf,
-                               (const int32_t *)vb.buf,
-                               zero_deltas.data(), (int)count,
-                               scratch.data(), fbound);
+            rlen = tk_frame_v2_run((const uint8_t *)base.buf,
+                                   (const int32_t *)kb.buf,
+                                   (const int32_t *)vb.buf,
+                                   tss_p, now_ms, hbuf_p, hlens_p,
+                                   (int)count, scratch.data(), fbound,
+                                   &first_ts, &max_ts);
             if (rlen >= 0) {
                 int64_t clen =
                     codec == 3
@@ -987,8 +1371,8 @@ static PyObject *mod_build_batch(PyObject *Py_UNUSED(self),
             be32(o + V2_OF_CRC, 0);                   // CRC placeholder
             be16(o + V2_OF_ATTR, (uint16_t)(attr_codec | attr_flags));
             be32(o + 23, (uint32_t)(count - 1));      // LastOffsetDelta
-            be64(o + 27, (uint64_t)now_ms);           // FirstTimestamp
-            be64(o + 35, (uint64_t)now_ms);           // MaxTimestamp
+            be64(o + 27, (uint64_t)first_ts);         // FirstTimestamp
+            be64(o + 35, (uint64_t)max_ts);           // MaxTimestamp
             be64(o + 43, (uint64_t)pid);
             be16(o + 51, (uint16_t)epoch);
             be32(o + 53, (uint32_t)base_seq);
@@ -1009,6 +1393,11 @@ done:
     PyBuffer_Release(&base);
     PyBuffer_Release(&kb);
     PyBuffer_Release(&vb);
+    if (has_ts) PyBuffer_Release(&tsb);
+    if (has_h) {
+        PyBuffer_Release(&hb);
+        if (hlb.obj) PyBuffer_Release(&hlb);
+    }
     return out;
 }
 
@@ -1867,7 +2256,8 @@ static PyMethodDef module_methods[] = {
     {"build_batch", (PyCFunction)(void (*)(void))mod_build_batch,
      METH_FASTCALL,
      "build_batch(base, klens, vlens, count, now_ms, pid, epoch, "
-     "base_seq, codec_id[, attr_flags]) -> wire RecordBatch bytes"},
+     "base_seq, codec_id[, attr_flags[, tss, hbuf, hlens]]) -> wire "
+     "RecordBatch bytes"},
     {"materialize_arena",
      (PyCFunction)(void (*)(void))mod_materialize_arena, METH_FASTCALL,
      "materialize_arena(...) -> list[Message] (arena layout)"},
@@ -1888,6 +2278,9 @@ static PyMethodDef module_methods[] = {
      "key/value created lazily from the arena base buffer)"},
     {"crc32c_many", (PyCFunction)(void (*)(void))mod_crc32c_many,
      METH_FASTCALL, "crc32c_many(buffers) -> list[int] (no join copy)"},
+    {"murmur2_partition",
+     (PyCFunction)(void (*)(void))mod_murmur2_partition, METH_FASTCALL,
+     "murmur2_partition(key, cnt) -> int (Java-compatible parity hook)"},
     {"decompress_many", (PyCFunction)(void (*)(void))mod_decompress_many,
      METH_FASTCALL,
      "decompress_many(codec_id, buffers, hints) -> list[bytes|None]"},
@@ -1932,6 +2325,13 @@ static PyMethodDef lane_methods[] = {
     {"produce_raw", (PyCFunction)(void (*)(void))lane_produce_raw,
      METH_FASTCALL,
      "produce_raw(topic, part, base_addr, klens_addr, vlens_addr, n)"},
+    {"part_set", (PyCFunction)(void (*)(void))lane_part_set,
+     METH_FASTCALL,
+     "part_set(topic, partition_cnt, mode): native auto-partition"},
+    {"part_del", (PyCFunction)lane_part_del, METH_O,
+     "part_del(topic): drop the auto-partition entry"},
+    {"counters", (PyCFunction)lane_counters, METH_NOARGS,
+     "counters() -> {'engaged': n, 'fallback': {reason: n}}"},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject LaneType = {
@@ -1942,17 +2342,17 @@ static PyTypeObject LaneType = {
 
 static PyMethodDef arena_methods[] = {
     {"append", (PyCFunction)(void (*)(void))arena_append, METH_FASTCALL,
-     "append(key, value) -> remaining record count"},
+     "append(key, value[, ts_ms, hblob]) -> remaining record count"},
     {"take", (PyCFunction)(void (*)(void))arena_take, METH_FASTCALL,
      "take(max_count, max_bytes) -> run tuple or None"},
     {"expire", (PyCFunction)arena_expire, METH_O,
      "expire(cutoff_us) -> (count, nbytes) dropped"},
     {"expire_records", (PyCFunction)arena_expire_records, METH_O,
-     "expire_records(cutoff_us) -> [(key, value), ...] dropped"},
+     "expire_records(cutoff_us) -> [(key, value, ts, hblob), ...]"},
     {"clear", (PyCFunction)arena_clear, METH_NOARGS,
      "clear() -> (count, nbytes) dropped"},
     {"drain_records", (PyCFunction)arena_drain_records, METH_NOARGS,
-     "drain_records() -> [(key, value), ...] and reset"},
+     "drain_records() -> [(key, value, ts, hblob), ...] and reset"},
     {"first_enq_us", (PyCFunction)arena_first_enq_us, METH_NOARGS,
      "first_enq_us() -> int64 (-1 when empty)"},
     {"nbytes", (PyCFunction)arena_nbytes, METH_NOARGS,

@@ -156,15 +156,24 @@ class MsgsetWriterV2:
     def build_arena(self, batch, now_ms: int) -> "MsgsetWriterV2":
         """Frame a fast-lane ArenaBatch: ONE native call straight off the
         arena's buffers, zero per-record Python work (the reference's
-        zero-allocation hot loop, rdkafka_msgset_writer.c:653).  All
-        records carry the batch build timestamp (fast-lane messages have
-        timestamp=0 = now), so every delta is zero."""
-        from ..ops.cpu import frame_v2_raw
-        self.records_bytes = frame_v2_raw(batch.base, batch.klens,
-                                          batch.vlens, batch.count)
+        zero-allocation hot loop, rdkafka_msgset_writer.c:653).  The
+        all-default shape (no explicit timestamps, no headers) frames
+        with every delta zero; widened runs carry per-record timestamps
+        (0 = batch build time) and pre-encoded header blobs in side
+        arrays, framed by the run-native framer in one call."""
+        if batch.tss is None and batch.hbuf is None:
+            from ..ops.cpu import frame_v2_raw
+            self.records_bytes = frame_v2_raw(batch.base, batch.klens,
+                                              batch.vlens, batch.count)
+            self.first_timestamp = now_ms
+            self.max_timestamp = now_ms
+        else:
+            from ..ops.cpu import frame_v2_run
+            (self.records_bytes, self.first_timestamp,
+             self.max_timestamp) = frame_v2_run(
+                batch.base, batch.klens, batch.vlens, batch.count, now_ms,
+                batch.tss, batch.hbuf, batch.hlens)
         self.record_count = batch.count
-        self.first_timestamp = now_ms
-        self.max_timestamp = now_ms
         return self
 
     def _build_py(self, msgs, now_ms: int) -> "MsgsetWriterV2":

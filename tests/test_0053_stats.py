@@ -294,6 +294,20 @@ def test_stats_schema_matches_statistics_md():
     assert set(cb["cgrp"]) == doc["cgrp"], set(cb["cgrp"]) ^ doc["cgrp"]
     assert set(pb["eos"]) == doc["eos"], set(pb["eos"]) ^ doc["eos"]
 
+    # ISSUE 16: fast-lane engagement counters — producer-only section,
+    # bidirectional like the rest (fallback reasons field-for-field)
+    assert "arena" not in cb, "arena blob must be producer-only"
+    ar = pb["arena"]
+    assert set(ar) == doc["arena"], set(ar) ^ doc["arena"]
+    assert set(ar["fallback"]) == doc["arena.fallback"], \
+        set(ar["fallback"]) ^ doc["arena.fallback"]
+    # every one of the 30 transactional produces is visible to the
+    # gate: PARTITION_UA + the default consistent_random partitioner
+    # counts as auto_partition fallback, and the Python-partitioned
+    # messages demote their toppars with reason "partitioner"
+    assert ar["fallback"]["auto_partition"] == 30, ar
+    assert ar["demoted"].get("partitioner", 0) >= 1, ar
+
     ce = pb["codec_engine"]
     assert set(ce) == doc["codec_engine"], set(ce) ^ doc["codec_engine"]
     assert set(ce["governor"]) == doc["codec_engine.governor"], \

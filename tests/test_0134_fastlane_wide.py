@@ -1,0 +1,385 @@
+"""Widened fast-lane eligibility (ISSUE 16): native murmur2
+auto-partition, explicit timestamps, and record headers ride the
+zero-Python-per-message arena lane.
+
+Pinned here:
+- murmur2 parity sweep: the native hash (tk_enqlane / rd_murmur2
+  analog) is bit-exact vs the Python partitioner for empty, NUL-laden,
+  sign-extension-sensitive and long keys across partition counts;
+- keyed-run demotion regression: a mixed keyed/unkeyed murmur2_random
+  run must not scramble partition routing (unkeyed records take the
+  Python random partitioner and demote THEIR toppar only);
+- wire bit-exactness: the fused run-native build equals the pure-Python
+  writer byte for byte across headers x timestamps x idempotence x
+  codec combinations;
+- DR/demotion fidelity: timestamps and headers survive materialization
+  out of the arena (delivery reports, demotion drains, expiry).
+"""
+import itertools
+import time
+
+import numpy as np
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.client.arena import _mod, decode_hblob, encode_headers
+from librdkafka_tpu.mock.cluster import MockCluster
+from librdkafka_tpu.ops.cpu import CpuCodecProvider
+from librdkafka_tpu.ops.packing import iter_run_records
+from librdkafka_tpu.protocol.msgset import MsgsetWriterV2, Record
+from librdkafka_tpu.utils.hash import murmur2_partition
+
+NOW_MS = 1722900000123
+
+
+def _native():
+    m = _mod()
+    if m is None or not hasattr(m, "murmur2_partition"):
+        pytest.skip("tk_enqlane unavailable")
+    return m
+
+
+# ------------------------------------------------------- murmur2 parity --
+
+KEY_SWEEP = [
+    b"",                                   # empty key (murmur2 of b"")
+    b"\x00", b"\x00\x00\x00\x00",          # NUL-containing
+    b"key", b"kafka-key", b"a" * 3,        # trailing-byte paths (1..3)
+    bytes(range(256)),                     # every byte value
+    b"\x7f\x80\xff\x01",                   # sign-extension sensitive
+    b"\x80" * 7, b"\xff" * 9,              # negative signed chars
+    b"k" * 1000, b"\xfe\xdc\xba" * 333,    # long keys
+    "héllo-wörld".encode(), "キー".encode(),  # multibyte utf-8
+]
+CNT_SWEEP = [1, 2, 3, 7, 16, 100, 12345]
+
+
+def test_murmur2_native_parity_sweep():
+    m = _native()
+    for key, cnt in itertools.product(KEY_SWEEP, CNT_SWEEP):
+        assert m.murmur2_partition(key, cnt) == murmur2_partition(key, cnt), \
+            (key[:16], cnt)
+    # randomized fuzz on top of the fixed sweep
+    rng = np.random.default_rng(16)
+    for _ in range(300):
+        key = rng.integers(0, 256, int(rng.integers(0, 64)),
+                           dtype=np.uint8).tobytes()
+        for cnt in (3, 12, 31):
+            assert (m.murmur2_partition(key, cnt)
+                    == murmur2_partition(key, cnt)), (key, cnt)
+
+
+def test_murmur2_partitioner_none_key_semantics():
+    """The 'murmur2' partitioner hashes a None/empty key as b'' (the
+    confluent semantics partitioner_fn pins) — the native lane must
+    route a keyless produce to the same partition."""
+    m = _native()
+    for cnt in CNT_SWEEP:
+        assert m.murmur2_partition(b"", cnt) == murmur2_partition(b"", cnt)
+
+
+# ------------------------------------------- end-to-end auto-partition --
+
+def test_auto_partition_routes_like_python_partitioner():
+    """PARTITION_UA + partitioner=murmur2: every record lands on the
+    partition the Python partitioner would pick, and the lane stays
+    engaged (no demotions)."""
+    cluster = MockCluster(num_brokers=1, topics={"ap": 5})
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5,
+                  "dr_msg_cb": lambda e, mm: drs.append((e, mm))})
+    p.set_topic_conf("ap", {"partitioner": "murmur2"})
+    try:
+        p.rk.get_topic("ap")
+        deadline = time.monotonic() + 5
+        while (p.rk.topics["ap"].partition_cnt <= 0
+               and time.monotonic() < deadline):
+            p.poll(0.05)
+        keys = [b"k-%03d" % i for i in range(120)] + [b"", None]
+        for k in keys:
+            p.produce("ap", value=b"v", key=k)
+        assert p.flush(20.0) == 0
+        assert len(drs) == len(keys)
+        for e, mm in drs:
+            assert e is None
+            assert mm.partition == murmur2_partition(mm.key or b"", 5)
+        assert p.rk._demote_reasons == {}, p.rk._demote_reasons
+        ctrs = p.rk._lane.counters()
+        # everything after the per-toppar first sights ran natively
+        assert ctrs["engaged"] >= len(keys) - 6, ctrs
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_murmur2_random_mixed_keyed_unkeyed_routing():
+    """Keyed-run demotion regression: with murmur2_random, unkeyed
+    records fall back to the Python random partitioner (demoting only
+    the toppars they land on) while keyed records keep murmur2 routing
+    — the mixed run must not scramble keyed partition assignment."""
+    cluster = MockCluster(num_brokers=1, topics={"mr": 4})
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5,
+                  "dr_msg_cb": lambda e, mm: drs.append((e, mm))})
+    p.set_topic_conf("mr", {"partitioner": "murmur2_random"})
+    try:
+        p.rk.get_topic("mr")
+        deadline = time.monotonic() + 5
+        while (p.rk.topics["mr"].partition_cnt <= 0
+               and time.monotonic() < deadline):
+            p.poll(0.05)
+        for i in range(200):
+            if i % 5 == 0:
+                p.produce("mr", value=b"u%03d" % i)         # unkeyed
+            else:
+                p.produce("mr", value=b"v%03d" % i, key=b"k%03d" % i)
+        assert p.flush(20.0) == 0
+        assert len(drs) == 200
+        for e, mm in drs:
+            assert e is None
+            if mm.key:      # keyed: murmur2 routing, bit-exact
+                assert mm.partition == murmur2_partition(mm.key, 4), \
+                    (mm.key, mm.partition)
+        # unkeyed records demote via the Python random partitioner
+        assert set(p.rk._demote_reasons) <= {"partitioner"}, \
+            p.rk._demote_reasons
+        ctrs = p.rk._lane.counters()
+        assert ctrs["fallback"]["auto_partition"] >= 40, ctrs
+    finally:
+        p.close()
+        cluster.stop()
+
+
+# ---------------------------------------------------- wire bit-exactness --
+
+def _run_from(recs):
+    """Arena-run descriptor (ArenaBatch layout) for logical records."""
+    parts, klens, vlens, tss, hbufs, hlens = [], [], [], [], [], []
+    for k, v, ts, hdrs in recs:
+        klens.append(-1 if k is None else len(k))
+        vlens.append(-1 if v is None else len(v))
+        if k is not None:
+            parts.append(k)
+        if v is not None:
+            parts.append(v)
+        tss.append(ts)
+        hb = encode_headers(hdrs) if hdrs else b""
+        hbufs.append(hb)
+        hlens.append(len(hb))
+    ts_any = any(tss)
+    h_any = any(hlens)
+    return (b"".join(parts),
+            np.array(klens, np.int32).tobytes(),
+            np.array(vlens, np.int32).tobytes(),
+            np.array(tss, np.int64).tobytes() if ts_any else None,
+            b"".join(hbufs) if h_any else None,
+            np.array(hlens, np.int32).tobytes() if h_any else None)
+
+
+def _combo_records(with_hdrs, with_ts):
+    recs = []
+    for i in range(7):
+        k = b"k%d" % i if i % 2 == 0 else None
+        v = (b"v" * (i * 13 + 1)) if i != 3 else None
+        ts = (NOW_MS - 500 + i * 37) if (with_ts and i % 3 != 1) else 0
+        hdrs = ([("hk%d" % i, b"hv%d" % i), ("null", None), ("", b"")]
+                if (with_hdrs and i % 2 == 0) else ())
+        recs.append((k, v, ts, hdrs))
+    return recs
+
+
+CODEC_ID = {"none": 0, "snappy": 2, "lz4": 3}
+
+
+@pytest.mark.parametrize("codec", ["none", "lz4", "snappy"])
+@pytest.mark.parametrize("idem", [False, True])
+@pytest.mark.parametrize("with_ts", [False, True])
+@pytest.mark.parametrize("with_hdrs", [False, True])
+def test_wire_bit_identical_fast_vs_slow(with_hdrs, with_ts, idem, codec):
+    m = _native()
+    if not hasattr(m, "build_batch"):
+        pytest.skip("fused builder unavailable")
+    prov = CpuCodecProvider()
+    recs = _combo_records(with_hdrs, with_ts)
+    pid, epoch, seq = (1234, 7, 99) if idem else (-1, -1, -1)
+    # slow path: pure-Python framer + writer + provider codec/CRC
+    msgs = [Record(key=k, value=v, timestamp=ts if ts else -1, headers=h)
+            for k, v, ts, h in recs]
+    w = MsgsetWriterV2(producer_id=pid, producer_epoch=epoch,
+                       base_sequence=seq,
+                       codec=None if codec == "none" else codec)
+    w._build_py(msgs, NOW_MS)
+    comp = None
+    if codec != "none":
+        c = prov.compress_many(codec, [w.records_bytes])[0]
+        if len(c) < len(w.records_bytes):
+            comp = c
+        else:
+            w.codec = None
+    region = w.assemble(comp)
+    slow = w.patch_crc(int(prov.crc32c_many([region])[0]))
+    # fast path: ONE fused native call off the run descriptor
+    base, kl, vl, tsb, hb, hlb = _run_from(recs)
+    fast = m.build_batch(base, kl, vl, len(recs), NOW_MS, pid, epoch,
+                         seq, CODEC_ID[codec], 0, tsb, hb, hlb)
+    assert bytes(fast) == slow
+
+
+def test_run_descriptor_walk_round_trips():
+    """iter_run_records (ops/packing.py) inverts the descriptor: the
+    inspection seam the wire gates rely on must see exactly the logical
+    records that went in."""
+    recs = _combo_records(True, True)
+    base, kl, vl, tsb, hb, hlb = _run_from(recs)
+    walked = list(iter_run_records(base, kl, vl, len(recs), tsb, hb, hlb))
+    assert len(walked) == len(recs)
+    for (k, v, ts, hdrs), (wk, wv, wts, whb) in zip(recs, walked):
+        assert wk == k and wv == v and wts == ts
+        assert (decode_hblob(whb) if whb else []) == list(hdrs)
+
+
+def test_headers_blob_codec_round_trip():
+    cases = [
+        [],
+        [("a", b"1")],
+        [("key", None), ("", b""), ("utf8-ключ", b"\x00\xff")],
+        [("h%d" % i, b"v" * i) for i in range(40)],
+    ]
+    for hdrs in cases:
+        blob = encode_headers(hdrs)
+        assert blob is not None
+        assert decode_hblob(blob) == [(k, v) for k, v in hdrs]
+    # ineligible shapes return None (fast lane falls back, not crash)
+    assert encode_headers([("k", "str-not-bytes")]) is None
+    assert encode_headers([(1, b"v")]) is None
+    assert encode_headers("not-a-seq-of-pairs") is None
+
+
+# ----------------------------------------------- materialization fidelity --
+
+def test_dr_carries_timestamps_and_headers():
+    cluster = MockCluster(num_brokers=1, topics={"drw": 1})
+    drs = []
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5,
+                  "dr_msg_cb": lambda e, mm: drs.append((e, mm))})
+    try:
+        hdrs = [("trace", b"abc"), ("nil", None)]
+        for i in range(30):
+            p.produce("drw", value=b"v%02d" % i, partition=0,
+                      timestamp=NOW_MS + i, headers=hdrs)
+        assert p.flush(20.0) == 0
+        tp = p.rk._toppars[("drw", 0)]
+        assert tp.arena_ok, "widened shapes must not demote"
+        assert len(drs) == 30
+        for i, (e, mm) in enumerate(sorted(drs, key=lambda x: x[1].offset)):
+            assert e is None
+            assert mm.value == b"v%02d" % i
+            assert mm.timestamp == NOW_MS + i
+            assert list(mm.headers) == hdrs
+    finally:
+        p.close()
+        cluster.stop()
+
+
+def test_demotion_drain_preserves_ts_and_headers():
+    """An arena holding widened records demotes into Messages with
+    timestamps and headers intact (FIFO preserved)."""
+    p = Producer({"bootstrap.servers": "127.0.0.1:1", "linger.ms": 5})
+    try:
+        t = p.rk.get_topic("dm")
+        t.partition_cnt = 1
+        p.rk.get_toppar("dm", 0)
+        hdrs = [("h", b"x")]
+        for i in range(10):
+            p.produce("dm", value=b"w%d" % i, partition=0,
+                      timestamp=NOW_MS + i, headers=hdrs)
+        tp = p.rk._toppars[("dm", 0)]
+        assert tp.arena is not None and len(tp.arena) == 10
+        p.rk._demote(tp, "ineligible")
+        assert not tp.arena_ok
+        assert len(tp.msgq) == 10
+        for i, mm in enumerate(tp.msgq):
+            assert mm.value == b"w%d" % i
+            assert mm.timestamp == NOW_MS + i
+            assert list(mm.headers) == hdrs
+        assert p.rk._demote_reasons.get("ineligible") == 1
+    finally:
+        p.rk.purge(in_queue=True)
+        p.close()
+
+
+def test_expiry_drs_carry_ts_and_headers():
+    drs = []
+    p = Producer({"bootstrap.servers": "127.0.0.1:1",
+                  "message.timeout.ms": 600, "linger.ms": 5,
+                  "dr_msg_cb": lambda e, mm: drs.append((e, mm))})
+    try:
+        t = p.rk.get_topic("ex")
+        t.partition_cnt = 1
+        p.rk.get_toppar("ex", 0)
+        hdrs = [("why", b"expired")]
+        for i in range(5):
+            p.produce("ex", value=b"e%d" % i, partition=0,
+                      timestamp=NOW_MS + i, headers=hdrs)
+        deadline = time.monotonic() + 10
+        while len(drs) < 5 and time.monotonic() < deadline:
+            p.poll(0.1)
+        assert len(drs) == 5
+        for i, (e, mm) in enumerate(drs):
+            assert e is not None
+            assert mm.value == b"e%d" % i
+            assert mm.timestamp == NOW_MS + i
+            assert list(mm.headers) == hdrs
+    finally:
+        p.rk.conf.set("message.timeout.ms", 300000)
+        p.close()
+
+
+def test_consume_round_trip_widened():
+    """Produce (headers + explicit ts + murmur2 auto-partition, fast
+    lane engaged) then consume: the app sees exactly what was sent."""
+    cluster = MockCluster(num_brokers=1, topics={"rt": 3})
+    p = Producer({"bootstrap.servers": cluster.bootstrap_servers(),
+                  "linger.ms": 5})
+    p.set_topic_conf("rt", {"partitioner": "murmur2"})
+    sent = {}
+    try:
+        p.rk.get_topic("rt")
+        deadline = time.monotonic() + 5
+        while (p.rk.topics["rt"].partition_cnt <= 0
+               and time.monotonic() < deadline):
+            p.poll(0.05)
+        for i in range(90):
+            key = b"rk%03d" % i
+            hdrs = [("seq", b"%d" % i)] if i % 2 else ()
+            ts = NOW_MS + i if i % 3 else 0
+            p.produce("rt", value=b"rv%03d" % i, key=key,
+                      timestamp=ts, headers=hdrs)
+            sent[key] = (b"rv%03d" % i, ts, list(hdrs))
+        assert p.flush(20.0) == 0
+        assert p.rk._demote_reasons == {}
+        c = Consumer({"bootstrap.servers": cluster.bootstrap_servers(),
+                      "group.id": "rtg",
+                      "auto.offset.reset": "earliest"})
+        c.subscribe(["rt"])
+        got = {}
+        deadline = time.monotonic() + 20
+        while len(got) < 90 and time.monotonic() < deadline:
+            mm = c.poll(0.2)
+            if mm and not mm.error:
+                got[mm.key] = mm
+        c.close()
+        assert len(got) == 90
+        for key, (val, ts, hdrs) in sent.items():
+            mm = got[key]
+            assert mm.value == val
+            assert mm.partition == murmur2_partition(key, 3)
+            if ts:
+                assert mm.timestamp == ts
+            assert list(mm.headers) == hdrs
+    finally:
+        p.close()
+        cluster.stop()
