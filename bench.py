@@ -1172,6 +1172,136 @@ def governor_bench() -> dict:
     return out
 
 
+def codec_device_bench(smoke: bool = False) -> dict:
+    """bench.py --codec-device (ISSUE 17): the device compress route
+    measured leg by leg, every leg asserting frames bit-identical to
+    the deterministic CPU encoder (the device kernel's spec).
+
+      buckets — per-bucket fused compress→CRC launch rate vs the
+        native deterministic encoder on the same buffers.  On this
+        1-core CPU-jax host the device loses (that is WHY the governor
+        routes compress to CPU here and tpu.compress.device defaults
+        false); the leg exists to keep both sides measured and
+        bit-exact so real accelerators can flip the default.
+      warm_gate — first-submission latency with background warmup
+        (CPU-served instantly, compile off the hot path) vs without
+        (inline XLA compile stall).  Acceptance: warm first submission
+        <= 10% of the cold stall; once warm, the same shape rides a
+        device launch.
+      headline — e2e 1KB-lz4 producer msgs/s, forced device route vs
+        host compress jobs, same external mock broker.
+
+    Env knobs: BENCH_DC_MSGS (e2e messages; 3000 smoke / 20000 full).
+    """
+    import jax  # noqa: F401  (pay the import before any timed leg)
+
+    from librdkafka_tpu.ops import cpu as _c
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+
+    def _det(bufs):
+        return _c.lz4f_compress_many(list(bufs), deterministic=True)
+
+    rng = np.random.default_rng(17)
+    out = {}
+
+    # --- leg 1: per-bucket device vs CPU rate -----------------------------
+    rounds = 2 if smoke else 6
+    buckets = {}
+    for nblk in (4,) if smoke else (4, 16):
+        # semi-compressible 32KB bodies: one LZ4F block per buffer
+        bufs = [bytes(rng.integers(0, 16, 32768, dtype=np.uint8))
+                for _ in range(nblk)]
+        nbytes = sum(len(b) for b in bufs)
+        want = _det(bufs)
+        eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                                 warmup=False, cpu_fallback=_cpu_crc_fb,
+                                 cpu_compress_fallback=_det)
+        # compile outside the timed window
+        assert [bytes(f) for f in eng.submit_compress(
+            bufs, window=False).result(600)] == want, \
+            "device bucket leg not bit-exact"
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            assert [bytes(f) for f in eng.submit_compress(
+                bufs, window=False).result(600)] == want
+        dev_s = (time.perf_counter() - t0) / rounds
+        snap = eng.compress_snapshot()
+        eng.close()
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            assert _det(bufs) == want
+        cpu_s = (time.perf_counter() - t0) / rounds
+        bucket = snap["routed"] and sorted(snap["routed"])[0]
+        buckets[str(bucket)] = {
+            "blocks": nblk,
+            "device_mb_s": round(nbytes / dev_s / 1e6, 1),
+            "cpu_mb_s": round(nbytes / cpu_s / 1e6, 1),
+            "device_over_cpu": round(cpu_s / max(dev_s, 1e-9), 4),
+            "fused_crc_launches": snap["fused_crc"],
+            "bit_exact": True,
+        }
+        assert snap["launches"] >= rounds + 1, snap
+        assert snap["fused_crc"] >= rounds + 1, snap
+    out["buckets"] = buckets
+
+    # --- leg 2: warm gate vs inline-compile cold start --------------------
+    wb = [bytes(rng.integers(0, 16, 8192, dtype=np.uint8))
+          for _ in range(4)]                      # 4 blocks -> bucket 8
+    want_w = _det(wb)
+    cold_eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                                  warmup=False, cpu_fallback=_cpu_crc_fb,
+                                  cpu_compress_fallback=_det)
+    t0 = time.perf_counter()
+    assert [bytes(f) for f in cold_eng.submit_compress(
+        wb, window=False).result(600)] == want_w
+    cold_s = time.perf_counter() - t0
+    cold_eng.close()                  # releases the compiled kernels
+
+    warm_eng = AsyncOffloadEngine(depth=2, min_batches=1, governor=False,
+                                  warmup=True, cpu_fallback=_cpu_crc_fb,
+                                  cpu_compress_fallback=_det)
+    t0 = time.perf_counter()
+    assert [bytes(f) for f in warm_eng.submit_compress(
+        wb, window=False).result(600)] == want_w
+    warm_first_s = time.perf_counter() - t0
+    dev_first_s = None
+    if warm_eng.lz4_warm_wait(8, 8192, 600):
+        launches = warm_eng.compress_stats["launches"]
+        t0 = time.perf_counter()
+        assert [bytes(f) for f in warm_eng.submit_compress(
+            wb, window=False).result(600)] == want_w
+        dev_first_s = time.perf_counter() - t0
+        assert warm_eng.compress_stats["launches"] == launches + 1, \
+            "warmed lz4 bucket did not ride a device launch"
+    warm_eng.close()
+    ratio = warm_first_s / max(cold_s, 1e-9)
+    out["warm_gate"] = {
+        "no_warmup_first_submit_s": round(cold_s, 4),
+        "warmup_first_submit_s": round(warm_first_s, 4),
+        "warmup_over_cold_ratio": round(ratio, 4),
+        "within_10pct": ratio <= 0.10,
+        "first_device_launch_s": (round(dev_first_s, 4)
+                                  if dev_first_s is not None else None),
+    }
+
+    # --- leg 3: e2e 1KB-lz4 headline --------------------------------------
+    n = int(os.environ.get("BENCH_DC_MSGS", 3000 if smoke else 20000))
+    base = {"tpu.transport.min.mb.s": 0, "tpu.governor": False,
+            "tpu.warmup": False, "tpu.launch.min.batches": 1}
+    dev_rate = host_pipeline(n, 1024, 4, backend="tpu",
+                             extra_conf={**base,
+                                         "tpu.compress.device": True})
+    host_rate = host_pipeline(n, 1024, 4, backend="tpu",
+                              extra_conf=base)
+    out["headline_1kb_lz4"] = {
+        "msgs": n,
+        "device_route_msgs_s": round(dev_rate),
+        "host_route_msgs_s": round(host_rate),
+        "device_over_host": round(dev_rate / max(host_rate, 1e-9), 4),
+    }
+    return out
+
+
 def chaos_bench() -> dict:
     """bench.py --chaos (<60 s): the chaos smoke leg — run every FAST
     scenario from the chaos library (broker kill/restart, a real
@@ -1776,6 +1906,30 @@ def smoke_bench() -> dict:
     eng2.close()
     legs["fused"] = f"bit-identical ({fused} fused launch)"
 
+    # device compress route (ISSUE 17): the fused compress→CRC launch
+    # must hand back LZ4F frames byte-identical to the deterministic
+    # CPU encoder, with the per-part CRCs folding to the true crc32c
+    from librdkafka_tpu.ops.packing import FrameBlob
+    from librdkafka_tpu.utils.crc import crc32c as _crc32c
+    dc = TpuCodecProvider(min_batches=1, warmup=False,
+                          min_transport_mb_s=0, compress_device=True)
+    cbufs = [b"", b"smoke-dc",
+             bytes(rng.integers(0, 16, 4096, dtype=np.uint8)),
+             rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()]
+    want_fr = _c.lz4f_compress_many(cbufs, deterministic=True)
+    got_fr = dc.compress_submit(
+        "lz4", cbufs, qos=[("smoke", 1.0)] * len(cbufs)).result(300)
+    assert [bytes(f) for f in got_fr] == want_fr, \
+        "device compress leg not bit-exact"
+    blobs = [f for f in got_fr if isinstance(f, FrameBlob)]
+    assert blobs and all(f.region_crc() == _crc32c(bytes(f))
+                         for f in blobs), "fused CRC parts wrong"
+    dsnap = dc._engine.compress_snapshot()
+    assert dsnap["launches"] >= 1 and dsnap["fused_crc"] >= 1, dsnap
+    dc.close()
+    legs["device_codec"] = (f"bit-identical ({dsnap['fused_crc']} fused "
+                            f"compress→CRC launch)")
+
     # mesh dispatch lanes (ISSUE 6): 2-device bit-exactness — one
     # group big enough to shard across both chips, plus small groups
     # spreading whole-to-one-lane — auto-skipped when <2 devices
@@ -2120,6 +2274,13 @@ def main():
                                     "multi-poly launches (bench.py "
                                     "--governor)",
                           **governor_bench()})
+        return
+    if "--codec-device" in sys.argv:
+        _emit({"metric": "device-side batch compression: fused "
+                         "compress→CRC launch rate per bucket, "
+                         "warm-gate cold start, e2e 1KB-lz4 headline "
+                         "(bench.py --codec-device)",
+               **codec_device_bench(smoke="--smoke" in sys.argv)})
         return
     if "--txn" in sys.argv:
         _emit({"metric": "transactional vs plain idempotent "

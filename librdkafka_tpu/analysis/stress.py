@@ -74,6 +74,57 @@ def _engine_pipeline_leg() -> int:
             c.close()
 
 
+def _devcodec_leg() -> None:
+    """ISSUE 17: the device compress route under instrumented locks —
+    tpu.compress.device producer with two QoS-weighted topics, so the
+    engine's lz4 staging rings, fused compress→CRC launches and the
+    governor's QoS tallies (submitter-side note_topics vs dispatch-
+    side note_qos vs the stats emitter's snapshots) interleave with
+    the broker/app/mock threads; a CRC-checking consumer proves the
+    device frames byte-valid end to end."""
+    from .. import Consumer, Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.compress.device": True,
+                  "tpu.launch.min.batches": 1, "tpu.governor": False,
+                  "tpu.warmup": False, "compression.codec": "lz4",
+                  "linger.ms": 5, "batch.num.messages": 16})
+    c = None
+    try:
+        p._rk.set_topic_conf("lockdep-dc-lat", {"topic.qos.weight": 4.0})
+        p._rk.set_topic_conf("lockdep-dc-bulk",
+                             {"topic.qos.weight": 0.5})
+        bs = p._rk.mock_cluster.bootstrap_servers()
+        for i in range(120):
+            topic = ("lockdep-dc-lat" if i % 3 else "lockdep-dc-bulk")
+            p.produce(topic, value=b"dc%03d " % i * 12, key=b"k%d" % i)
+        assert p.flush(120.0) == 0, "devcodec leg: flush left messages"
+        eng = p._rk.codec_provider._engine
+        snap = eng.compress_snapshot() if eng is not None else {}
+        assert snap.get("launches", 0) >= 1, \
+            f"devcodec leg: no device compress launch: {snap}"
+        assert set(snap.get("qos", {})) >= {"lockdep-dc-lat",
+                                            "lockdep-dc-bulk"}, snap
+        c = Consumer({"bootstrap.servers": bs,
+                      "group.id": "lockdep-dc-g",
+                      "auto.offset.reset": "earliest",
+                      "check.crcs": True})
+        c.subscribe(["lockdep-dc-lat", "lockdep-dc-bulk"])
+        got = 0
+        deadline = time.monotonic() + 60
+        while got < 120 and time.monotonic() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got += 1
+        assert got == 120, f"devcodec leg: consumed {got}/120"
+    finally:
+        p.close()
+        if c is not None:
+            c.close()
+
+
 def _txn_leg() -> None:
     from .. import Producer
 
@@ -243,6 +294,7 @@ def run_stress() -> dict:
     lockdep.enable()
     try:
         _engine_pipeline_leg()
+        _devcodec_leg()
         _txn_leg()
         _chaos_leg()
         _external_storm_leg()
@@ -265,6 +317,7 @@ def run_races(seeds=SCHEDULE_SEEDS) -> tuple:
     keys = []
     try:
         _engine_pipeline_leg()
+        _devcodec_leg()
         _txn_leg()
         _chaos_leg()
         _fleet_leg()
@@ -288,7 +341,8 @@ def races_main() -> int:
     t0 = time.perf_counter()
     rep, keys = run_races()
     print(races.format_report(rep))
-    print(f"races: lockset sweep (engine pipeline + txn + fast chaos "
+    print(f"races: lockset sweep (engine pipeline + device codec + txn "
+          f"+ fast chaos "
           f"storm + fleet smoke + fetch sessions + fast lane) + {len(keys)} seeded "
           f"schedules {[k for k in keys]} "
           f"in {time.perf_counter() - t0:.1f}s")
@@ -299,7 +353,8 @@ def main() -> int:
     t0 = time.perf_counter()
     rep = run_stress()
     print(lockdep.format_report(rep))
-    print(f"stress: engine pipeline + txn commit/abort + fast chaos "
+    print(f"stress: engine pipeline + device codec + txn commit/abort "
+          f"+ fast chaos "
           f"storm + external SIGKILL storm + fleet smoke + fetch "
           f"sessions + fast lane in {time.perf_counter() - t0:.1f}s")
     return 0 if lockdep.clean(rep) else 1

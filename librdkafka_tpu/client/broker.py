@@ -32,6 +32,7 @@ from typing import Callable, Optional, TYPE_CHECKING
 from ..obs import trace as _trace
 from ..analysis import lockdep as _lockdep
 from ..analysis.races import shared
+from ..ops.packing import FrameBlob
 from ..protocol import apis, proto
 from ..protocol.apis import APIS
 from ..utils import sockbuf
@@ -263,11 +264,32 @@ def _begin_writer_phase(rk, writer_items: list, by_idx: dict,
     csub = getattr(provider, "compress_submit", None)
     if csub is not None and by_key:
         t_comp = _trace.now() if _trace.enabled else 0
+        # topic.qos.weight: per-buffer (topic, weight) pairs feed the
+        # engine's weighted fan-in + shed model.  Only offered to
+        # providers that declare accepts_qos — test doubles keep the
+        # 3-arg compress_submit signature.
+        accepts_qos = getattr(provider, "accepts_qos", False)
+        wcache: dict = {}
         comp_tickets = []
         for (cdc, lvl), idxs in by_key.items():
             try:
-                t = csub(cdc, [items[i][2].records_bytes for i in idxs],
-                         lvl)
+                if accepts_qos:
+                    qos = []
+                    for i in idxs:
+                        topic = items[i][0].topic
+                        w = wcache.get(topic)
+                        if w is None:
+                            w = float(rk.topic_conf_for(topic).get(
+                                "topic.qos.weight") or 1.0)
+                            wcache[topic] = w
+                        qos.append((topic, w))
+                    t = csub(cdc,
+                             [items[i][2].records_bytes for i in idxs],
+                             lvl, qos=qos)
+                else:
+                    t = csub(cdc,
+                             [items[i][2].records_bytes for i in idxs],
+                             lvl)
             except Exception:
                 t = None
             if t is None:           # pipeline disabled: sync route below
@@ -325,7 +347,17 @@ def _assemble_and_submit_crc(rk, writer_items: list, by_idx: dict,
             if blob is not None and len(blob) >= len(writer.records_bytes):
                 blob = None       # incompressible: send plain
                 writer.codec = None
-            regions.append(writer.assemble(blob))
+            region = writer.assemble(blob)
+            if isinstance(blob, FrameBlob):
+                # fused compress→CRC route (ISSUE 17): the frame came
+                # back from the device with per-part CRCs — fold the
+                # batch CRC over the 21-byte header prefix with
+                # crc32c_combine instead of re-scanning the frame.
+                crc = blob.region_crc(
+                    bytes(region[:len(region) - len(blob)]))
+                by_idx[i] = (tp, msgs, writer.patch_crc(crc), None)
+                continue
+            regions.append(region)
             assembled.append((i, (tp, msgs, writer)))
         except Exception as e:
             by_idx[i] = (tp, msgs, None, e)

@@ -471,6 +471,22 @@ PROPERTIES: list[Prop] = [
        "vector units). Default off: backend=tpu runs lz4 on CPU and only "
        "CRC32C on the MXU, so the TPU backend is never slower than cpu.",
        app=P),
+    _p("tpu.compress.device", GLOBAL, "bool", False,
+       "Producer lz4 device-compression route: batch 64KB blocks into "
+       "the engine's staging rings and run the fused compress+CRC32C "
+       "kernel — one launch and one readback per bucket yields the "
+       "LZ4F frames AND their MessageSet v2 batch CRCs (the host folds "
+       "the final CRC with crc32c_combine, never re-scanning the frame "
+       "bytes). Wire bytes are bit-identical to the CPU encoder on "
+       "every route: the device kernel implements the deterministic "
+       "TPU-greedy spec, the governor's cost model may still send any "
+       "bucket to the matching deterministic CPU encoder, and warmup "
+       "misses are served there too. Off (default): lz4 compresses on "
+       "the native CPU fast path as an engine host job (PERF.md §3 — "
+       "on a 1-core tunnel-limited host the CPU path usually wins; "
+       "this knob exists for real accelerators and the bit-exactness "
+       "gates). Non-lz4 codecs and consumer decompress always stay "
+       "host-side. No effect with compression.backend=cpu.", app=P),
     # ---- flight-recorder tracing (obs/trace.py; TRACING.md) ----
     _p("trace.enable", GLOBAL, "bool", False,
        "Flight-recorder event tracing (obs/trace.py): per-thread ring "
@@ -622,6 +638,20 @@ PROPERTIES: list[Prop] = [
     _p("compression.type", TOPIC, "enum", "inherit", "Alias.", app=P,
        enum=("none", "gzip", "snappy", "lz4", "zstd", "inherit"),
        alias="compression.codec"),
+    _p("topic.qos.weight", TOPIC, "float", 1.0,
+       "Per-topic quality-of-service weight for the offload engine's "
+       "governor (compression.backend=tpu with the device compress "
+       "route): weighted fan-in admission — a high-weight topic's "
+       "submissions shrink the fan-in window so latency-sensitive "
+       "batches launch sooner — weight-ordered host-job dispatch, and "
+       "shed-based isolation: when every lane is saturated, topics "
+       "whose recent byte share exceeds 1.5x their weight share are "
+       "served on the bit-identical CPU encoder instead of queueing "
+       "ahead of higher-weight work. 1.0 (default) = neutral; > 1 "
+       "prioritizes, < 1 marks bulk/background traffic. Per-topic "
+       "routed/shed counts surface in statistics "
+       "(codec_engine.compress.qos). No effect with "
+       "compression.backend=cpu.", vmin=0.001, vmax=1000.0, app=P),
     _p("opaque", TOPIC, "ptr", None,
        "Per-topic application opaque (rd_kafka_topic_conf_set_opaque)."),
     _p("consume.callback.max.messages", TOPIC, "int", 0,
@@ -647,6 +677,8 @@ TPU_ADDITIONS = frozenset({
     (GLOBAL, "tpu.governor"),
     (GLOBAL, "tpu.warmup"),
     (GLOBAL, "tpu.compile.cache.dir"),
+    (GLOBAL, "tpu.compress.device"),
+    (TOPIC, "topic.qos.weight"),
     (GLOBAL, "codec.pipeline.depth"),
     (GLOBAL, "allow.auto.create.topics"),       # KIP-361 (post-1.3.0)
     (GLOBAL, "consume.callback.max.messages"),  # global mirror of the

@@ -318,7 +318,8 @@ class Kafka:  # lint: ok shared-state
                 fanin_us=conf.get("tpu.pipeline.fanin.us"),
                 governor=conf.get("tpu.governor"),
                 engine_warmup=conf.get("tpu.warmup"),
-                compile_cache_dir=conf.get("tpu.compile.cache.dir"))
+                compile_cache_dir=conf.get("tpu.compile.cache.dir"),
+                compress_device=conf.get("tpu.compress.device"))
         else:
             from ..ops.cpu import CpuCodecProvider
             self.codec_provider = CpuCodecProvider()

@@ -228,7 +228,12 @@ class StatsCollector:
                 # in-flight depth, launch-time EWMAs and warm-kernel
                 # count per mesh device (STATISTICS.md
                 # codec_engine.devices[])
-                "devices": eng.devices_snapshot()}
+                "devices": eng.devices_snapshot(),
+                # device compress route (ISSUE 17): fused launch /
+                # routed-per-bucket / bytes counters, the governor's
+                # compress cost model, and per-topic QoS routed/shed
+                # tallies (STATISTICS.md codec_engine.compress)
+                "compress": eng.compress_snapshot()}
         if rk.cgrp is not None:
             cg = rk.cgrp
             with cg._lock:

@@ -91,6 +91,23 @@ device:
 Wire bytes stay bit-identical on every route: sharding only moves
 WHERE each 64KB block's CRC runs — the block split, left-padding,
 GF(2) affine term and host-side combine are untouched.
+
+The DEVICE COMPRESS ROUTE (ISSUE 17) makes lz4 a first-class launch
+kind exactly the way CRC is one: ``submit_compress`` blocks its
+buffers into the (B, 64KB) lane staging rings, launches the FUSED
+compress→CRC kernel (ops/lz4_jax.py — one dispatch + one readback
+yields the compressed frames AND the checksums of both candidate
+block bodies) and assembles LZ4F frames host-side as
+:class:`packing.FrameBlob` values carrying per-part CRCs, so the
+writer's v2 batch checksum is a µs combine instead of a re-scan.  The
+governor grows a parallel pair of compress cost models (device-launch
+EWMA per bucket vs CPU ns/byte, explore-every-16) and a per-topic QoS
+layer (``topic.qos.weight``): weighted fan-in admission, weight-
+ordered dispatch, and — only while every lane is saturated — shedding
+of flood topics whose decayed byte share exceeds what their weight
+entitles them to.  Every fallback serves the deterministic CPU
+encoder, which implements the same TPU-greedy spec bit-for-bit, so
+the wire bytes cannot depend on the route taken.
 """
 from __future__ import annotations
 
@@ -165,10 +182,10 @@ class SyncTicket:
 
 class _Job:
     __slots__ = ("kind", "bufs", "poly", "ticket", "window", "fn", "args",
-                 "t_submit")
+                 "t_submit", "topics", "weight")
 
     def __init__(self, kind, bufs, poly, ticket, window, fn=None, args=()):
-        self.kind = kind            # "crc" | "compute" | "host"
+        self.kind = kind            # "crc" | "lz4" | "compute" | "host"
         self.bufs = bufs
         self.poly = poly
         self.ticket = ticket
@@ -176,6 +193,8 @@ class _Job:
         self.fn = fn
         self.args = args
         self.t_submit = 0.0         # submit() time (stage_latency)
+        self.topics: tuple = ()     # QoS: topics riding this job
+        self.weight = 1.0           # QoS: max topic.qos.weight of them
 
 
 class _Staging:
@@ -209,7 +228,8 @@ class _Launch:
     """One in-flight device launch awaiting readback."""
 
     __slots__ = ("kind", "jobs", "spans", "outs", "chunk_lens",
-                 "ticket", "out_tree", "t0", "bucket", "lane", "sharded")
+                 "ticket", "out_tree", "t0", "bucket", "lane", "sharded",
+                 "raw_blocks")
 
     def __init__(self, kind):
         self.kind = kind
@@ -223,6 +243,7 @@ class _Launch:
         self.bucket: Optional[int] = None        # padded B of first chunk
         self.lane: Optional["_Lane"] = None      # dispatch lane (ISSUE 6)
         self.sharded = False                     # shard_map'd over the mesh
+        self.raw_blocks: list = []               # lz4: raw bytes per row
 
 
 class _Lane:
@@ -274,10 +295,18 @@ class _Governor:
 
     EWMA_ALPHA = 0.25
     EXPLORE_EVERY = 16
+    #: per-topic byte-pressure decay applied at each submission of that
+    #: topic (the QoS feedback signal, ISSUE 17)
+    QOS_DECAY = 0.75
+    #: a topic is shed-eligible while saturated once its decayed byte
+    #: share exceeds this multiple of its weight share
+    QOS_SHED_RATIO = 1.5
 
     __slots__ = ("enabled", "fanin_cap_s", "interarrival_s",
                  "_last_submit", "cpu_ns_per_byte", "dev_launch_s",
-                 "_since_explore", "_glock")
+                 "_since_explore", "_glock", "cpu_comp_ns_per_byte",
+                 "dev_comp_launch_s", "_since_explore_comp",
+                 "qos_weights", "qos_bytes", "qos_routed", "qos_shed")
 
     def __init__(self, enabled: bool, fanin_cap_s: float):
         self.enabled = bool(enabled)
@@ -296,6 +325,19 @@ class _Governor:
         # (device id, bucket B) -> launch-time EWMA seconds
         self.dev_launch_s: dict[tuple[int, int], float] = {}
         self._since_explore = 0
+        # compress cost models (ISSUE 17) — same shapes as the CRC
+        # models, but the two routes never share an estimate: an lz4
+        # launch is orders of magnitude heavier than a CRC one
+        self.cpu_comp_ns_per_byte: Optional[float] = None
+        self.dev_comp_launch_s: dict[tuple[int, int], float] = {}
+        self._since_explore_comp = 0
+        # per-topic QoS state (ISSUE 17): conf'd weights, decayed byte
+        # pressure (the feedback signal), and routed/shed counters for
+        # codec_engine.compress.qos
+        self.qos_weights: dict[str, float] = {}
+        self.qos_bytes: dict[str, float] = {}
+        self.qos_routed: dict[str, int] = {}
+        self.qos_shed: dict[str, int] = {}
 
     def _ewma(self, old: Optional[float], v: float) -> float:
         return v if old is None else old + self.EWMA_ALPHA * (v - old)
@@ -401,6 +443,107 @@ class _Governor:
         return {str(b): round(s * 1e3, 3)
                 for (d, b), s in items if d == dev}
 
+    # ---- compress route (ISSUE 17) ----
+    def note_topics(self, entries) -> None:
+        """Submitter side: fold one compress submission into the QoS
+        models — ``entries`` is (topic, weight, nbytes) per topic."""
+        with self._glock:
+            for topic, w, nbytes in entries:
+                self.qos_weights[topic] = float(w)
+                self.qos_bytes[topic] = (
+                    self.qos_bytes.get(topic, 0.0) * self.QOS_DECAY
+                    + float(nbytes))
+
+    def note_device_compress(self, bucket: Optional[int], dt: float,
+                             dev: int = 0) -> None:
+        if bucket is not None:
+            key = (dev, bucket)
+            with self._glock:
+                self.dev_comp_launch_s[key] = self._ewma(
+                    self.dev_comp_launch_s.get(key), dt)
+
+    def note_cpu_compress(self, nbytes: int, dt: float) -> None:
+        if nbytes > 0:
+            with self._glock:
+                self.cpu_comp_ns_per_byte = self._ewma(
+                    self.cpu_comp_ns_per_byte, dt * 1e9 / nbytes)
+
+    def lane_compress_s(self, dev: int, bucket: int) -> Optional[float]:
+        with self._glock:
+            return self.dev_comp_launch_s.get((dev, bucket))
+
+    def route_compress(self, bucket: int, nbytes: int) -> tuple[str, bool]:
+        """('device'|'cpu', explored) for an at-quorum compress group —
+        the CRC route() shape on the compress cost models (an lz4
+        launch and a CRC launch share nothing but the policy)."""
+        with self._glock:
+            best = None
+            for (d, b), s in self.dev_comp_launch_s.items():
+                if b == bucket and (best is None or s < best):
+                    best = s
+            cpu = self.cpu_comp_ns_per_byte
+            if best is None or cpu is None:
+                return "device", False
+            pick = "device" if best <= nbytes * cpu / 1e9 else "cpu"
+            self._since_explore_comp += 1
+            if self._since_explore_comp >= self.EXPLORE_EVERY:
+                self._since_explore_comp = 0
+                return ("cpu" if pick == "device" else "device"), True
+            return pick, False
+
+    def shed_topics(self, saturated: bool) -> set:
+        """Topics whose decayed byte share exceeds QOS_SHED_RATIO × the
+        share their conf'd weight entitles them to — ONLY while every
+        lane is saturated (QoS never sheds an idle engine) and never
+        the whole topic set (something must keep flowing)."""
+        if not (self.enabled and saturated):
+            return set()
+        with self._glock:
+            if len(self.qos_weights) < 2:
+                return set()
+            tot_w = sum(self.qos_weights.values()) or 1.0
+            tot_b = sum(self.qos_bytes.values())
+            if tot_b <= 0:
+                return set()
+            out = {t for t, w in self.qos_weights.items()
+                   if (self.qos_bytes.get(t, 0.0) / tot_b
+                       > self.QOS_SHED_RATIO * (w / tot_w))}
+            return out if len(out) < len(self.qos_weights) else set()
+
+    def note_qos(self, topics, *, shed: bool) -> None:
+        """Dispatch-thread side: count a job's topics as device-routed
+        or shed (codec_engine.compress.qos)."""
+        if topics:
+            with self._glock:
+                tgt = self.qos_shed if shed else self.qos_routed
+                for t in topics:
+                    tgt[t] = tgt.get(t, 0) + 1
+
+    def compress_models(self) -> dict:
+        """The compress cost models for codec_engine.compress.model —
+        the governor snapshot() shape on the compress EWMAs."""
+        with self._glock:
+            dev = dict(self.dev_comp_launch_s)
+            cpu = self.cpu_comp_ns_per_byte
+        best: dict[int, float] = {}
+        for (d, b), s in dev.items():
+            if b not in best or s < best[b]:
+                best[b] = s
+        return {"cpu_ns_per_byte": (None if cpu is None
+                                    else round(cpu, 3)),
+                "dev_launch_ms": {str(b): round(s * 1e3, 3)
+                                  for b, s in sorted(best.items())}}
+
+    def qos_snapshot(self) -> dict:
+        """Per-topic {weight, routed, shed} (codec_engine.compress.qos)."""
+        with self._glock:
+            topics = (set(self.qos_weights) | set(self.qos_routed)
+                      | set(self.qos_shed))
+            return {t: {"weight": self.qos_weights.get(t, 1.0),
+                        "routed": self.qos_routed.get(t, 0),
+                        "shed": self.qos_shed.get(t, 0)}
+                    for t in sorted(topics)}
+
 
 # the governor's online models are cross-thread by design — submitters
 # feed the arrival EWMA, the dispatch thread the cost models, the
@@ -408,6 +551,9 @@ class _Governor:
 # since ISSUE 10 (the --races sweep convicted the old lock-free RMWs)
 register_slots(_Governor, "interarrival_s", "_last_submit",
                "cpu_ns_per_byte", "dev_launch_s", "_since_explore",
+               "cpu_comp_ns_per_byte", "dev_comp_launch_s",
+               "_since_explore_comp", "qos_weights", "qos_bytes",
+               "qos_routed", "qos_shed",
                prefix="engine.governor")
 
 
@@ -442,13 +588,19 @@ class AsyncOffloadEngine:
     _inflight_cnt = shared("engine.gauge.inflight", relaxed=True)
     _fanin_last = shared("engine.gauge.fanin", relaxed=True)
 
+    #: max rows per lz4 launch chunk: the compress kernel is far
+    #: heavier than the CRC matmul, so chunks stay small enough that a
+    #: launch never monopolizes a lane (64 x 64KB = 4 MB staged)
+    LZ4_MAX_B = 64
+
     def __init__(self, *, depth: int = 2, fanin_window_s: float = 0.0005,
                  min_batches: int = 4,
                  cpu_fallback: Optional[Callable] = None,
                  name: str = "tpu-engine",
                  governor: bool = True, warmup: bool = False,
                  compile_cache_dir: Optional[str] = None,
-                 mesh_devices: int = 0):
+                 mesh_devices: int = 0,
+                 cpu_compress_fallback: Optional[Callable] = None):
         # depth: launches kept in flight PER LANE before that lane's
         # oldest is read back
         self.depth = max(1, int(depth))
@@ -456,6 +608,10 @@ class AsyncOffloadEngine:
         self.min_batches = max(1, int(min_batches))
         # cpu_fallback(bufs, poly) -> list[int]; serves below-quorum jobs
         self.cpu_fallback = cpu_fallback
+        # cpu_compress_fallback(bufs) -> list[bytes]: the deterministic
+        # (bit-exact with the device kernel) lz4 frame encoder serving
+        # below-quorum / unwarmed / cpu-routed / shed compress jobs
+        self.cpu_compress_fallback = cpu_compress_fallback
         # the adaptive policy layer; fanin_window_s is its CAP
         self.governor = _Governor(governor, self.fanin_window_s)
         # warmup=True: kernels compile on the background thread and
@@ -478,7 +634,9 @@ class AsyncOffloadEngine:
         self._closed = False
         # warm items the dispatch thread missed on — the warmup thread
         # compiles these before continuing its sweep; items are
-        # ("kernel", B, kind, dev_id) or ("shard", Bs, kind)
+        # ("kernel", B, kind, dev_id), ("shard", Bs, kind) or
+        # ("lz4", B, N, dev_id) (compress buckets warm on demand only:
+        # the lz4 kernel's shapes depend on live block sizes)
         self._warm_requests: deque[tuple] = deque()
         # observability (PERF.md pipeline section + governor counters).
         # Declared relaxed: single-writer (the dispatch thread —
@@ -497,6 +655,20 @@ class AsyncOffloadEngine:
              "explore_routes": 0, "fused_launches": 0,
              # mesh-sharded dispatch (ISSUE 6)
              "sharded_launches": 0})
+        # device-compress route counters (ISSUE 17), kept separate from
+        # the CRC stats: codec_engine.compress in the statistics JSON.
+        # Same discipline as .stats — single-writer dispatch thread
+        # (warmup bumps ride the engine lock), snapshot readers.
+        self.compress_stats = shared_dict("engine.compress_stats",
+                                          relaxed=True)
+        self.compress_stats.update(
+            {"launches": 0, "blocks": 0, "jobs": 0, "cpu_jobs": 0,
+             "warmup_miss_jobs": 0, "routed_cpu_jobs": 0,
+             "explore_routes": 0, "fused_crc": 0, "shed_jobs": 0,
+             "bytes_in": 0, "bytes_out": 0})
+        # per-bucket route split {str(B): {"device": n, "cpu": n}}
+        self._comp_routed = shared_dict("engine.compress_routed",
+                                        relaxed=True)
         # per-stage latency decomposition (ISSUE 5): windowed
         # HdrHistogram Avgs feeding codec_engine.stage_latency in the
         # stats JSON — submit->launch wait, launch->readback (device),
@@ -544,7 +716,44 @@ class AsyncOffloadEngine:
             self._cond.notify()
         return t
 
-    def submit_compute(self, fn, *args, host: bool = False) -> Ticket:
+    def submit_compress(self, bufs: list, *, qos=None,
+                        window: bool = True) -> Ticket:
+        """Queue a device lz4 compress job; resolves to one assembled
+        LZ4F frame per buffer — a :class:`packing.FrameBlob` (bytes
+        plus the crc32c of each frame part, from the fused
+        compress→CRC launch) on the device route, plain ``bytes`` when
+        the deterministic CPU fallback served it.  Bit-identical frames
+        either way.  ``qos`` is an optional (topic, weight) pair per
+        buffer (topic.qos.weight): the max weight shortens this job's
+        fan-in wait and orders it ahead of lighter work; the topic
+        byte-pressure feeds the governor's shed decision."""
+        t = Ticket()
+        job = _Job("lz4", [bytes(b) for b in bufs], None, t, window)
+        job.t_submit = time.perf_counter()
+        if qos:
+            per: dict[str, list] = {}
+            wmax = 1.0
+            for (topic, w), b in zip(qos, bufs):
+                e = per.get(topic)
+                if e is None:
+                    per[topic] = [float(w), len(b)]
+                else:
+                    e[1] += len(b)
+                wmax = max(wmax, float(w))
+            job.topics = tuple(sorted(per))
+            job.weight = wmax
+            self.governor.note_topics(
+                [(topic, w, nb) for topic, (w, nb) in per.items()])
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine closed")
+            self.governor.note_submit(time.monotonic())
+            self._queue.append(job)
+            self._cond.notify()
+        return t
+
+    def submit_compute(self, fn, *args, host: bool = False,
+                       weight: float = 1.0) -> Ticket:
         """Generic pipelined dispatch: run ``fn(*args)`` on the dispatch
         thread.  ``host=False`` treats the return value as a tree of
         device arrays with the same in-flight depth and bulk-readback
@@ -555,10 +764,14 @@ class AsyncOffloadEngine:
         its raw return value — no jax import, no readback.  A host job
         naturally overlaps any device launch already in flight: the
         device executes while the dispatch thread runs the (GIL-
-        releasing) native call."""
+        releasing) native call.  ``weight`` is the QoS priority (max
+        topic.qos.weight riding the job): the dispatch loop stable-
+        sorts popped jobs by descending weight, so a latency topic's
+        host compress never queues behind a bulk flood's."""
         t = Ticket()
         job = _Job("host" if host else "compute", None, None, t, False,
                    fn=fn, args=args)
+        job.weight = float(weight)
         job.t_submit = time.perf_counter()
         with self._cond:
             if self._closed:
@@ -586,11 +799,18 @@ class AsyncOffloadEngine:
             # compile in progress finishes (it cannot be cancelled) and
             # the thread exits — deterministic drain, no leak
             self._warmup_thread.join(timeout)
+        import sys
         if self._shard_lane is not None:
-            import sys
             mesh_mod = sys.modules.get("librdkafka_tpu.parallel.mesh")
             if mesh_mod is not None:
                 mesh_mod.release_step_cache()
+        # the engine-owned fused/AOT compress kernels never outlive the
+        # engine (ISSUE 17 satellite — the conftest leak fixture
+        # asserts device_kernel_count() == 0); sys.modules guard keeps
+        # close() jax-free for host-only engines
+        lz4_mod = sys.modules.get("librdkafka_tpu.ops.lz4_jax")
+        if lz4_mod is not None:
+            lz4_mod.release_device_kernels()
         if self._thread.is_alive():
             # join timed out: the dispatch thread is wedged (e.g. a hung
             # device launch).  Fail every job still visible so waiters
@@ -624,12 +844,38 @@ class AsyncOffloadEngine:
         with self._lock:
             return self._closed
 
+    def lz4_warm_wait(self, B: int, N: int, timeout: float = 120.0,
+                      device=None) -> bool:
+        """Block until the fused (B, N) compress bucket is compiled for
+        ``device`` (test/bench hook, the warm_wait shape); returns
+        False on timeout."""
+        from . import lz4_jax as _lz4
+        deadline = time.monotonic() + timeout
+        while not _lz4.kernel_ready(B, N, device=device):
+            if time.monotonic() >= deadline or self._is_closed():
+                return _lz4.kernel_ready(B, N, device=device)
+            time.sleep(0.02)
+        return True
+
     def governor_snapshot(self) -> dict:
         """Governor gauges for the statistics JSON (client/stats.py).
         Never imports jax — safe to call from the stats emitter even
         before the first launch."""
         snap = self.governor.snapshot()
         snap["warmup"] = self.warmup_enabled
+        return snap
+
+    def compress_snapshot(self) -> dict:
+        """The device-compress route blob for the statistics JSON
+        (codec_engine.compress, STATISTICS.md): route counters, bytes
+        in/out, the per-bucket device/cpu split, the governor's
+        compress cost models and the per-topic QoS table.  Never
+        imports jax — safe from the stats emitter."""
+        snap = dict(self.compress_stats)
+        snap["routed"] = {b: dict(v)
+                          for b, v in sorted(self._comp_routed.items())}
+        snap["model"] = self.governor.compress_models()
+        snap["qos"] = self.governor.qos_snapshot()
         return snap
 
     def stage_latency_snapshot(self) -> dict:
@@ -794,6 +1040,15 @@ class AsyncOffloadEngine:
                         warm_kernel(B, _MXU_BLOCK, kind,
                                     device=(lane.device if lane
                                             else None))
+                    elif item[0] == "lz4":
+                        _, B, N, dev_id = item
+                        from . import lz4_jax as _lz4
+                        if _lz4.kernel_ready(B, N, device=dev_id):
+                            continue
+                        lane = by_id.get(dev_id)
+                        _lz4.warm_kernel(B, N,
+                                         device=(lane.device if lane
+                                                 else None))
                     else:
                         _, Bs, kind = item
                         from ..parallel import mesh as _mesh
@@ -835,7 +1090,7 @@ class AsyncOffloadEngine:
                 j.ticket._fail(exc)
             for lane in self._all_lanes():
                 for rec in lane.inflight:
-                    if rec.kind == "crc":
+                    if rec.kind in ("crc", "lz4"):
                         for j in rec.jobs:
                             j.ticket._fail(exc)
                     elif rec.ticket is not None:
@@ -857,6 +1112,10 @@ class AsyncOffloadEngine:
                 jobs = self._pop_jobs_locked()
             if jobs:
                 jobs = self._fanin(jobs)
+                # QoS priority ordering: heavier (latency-sensitive)
+                # jobs launch first; the sort is stable, so the default
+                # weight 1.0 preserves submission order exactly
+                jobs.sort(key=lambda j: -j.weight)
                 for group in self._group(jobs):
                     rec = self._launch(group)
                     if rec is not None:
@@ -894,10 +1153,17 @@ class AsyncOffloadEngine:
         if self.fanin_window_s <= 0:
             return jobs
         nbufs = sum(len(j.bufs) for j in jobs
-                    if j.kind == "crc" and j.window)
+                    if j.kind in ("crc", "lz4") and j.window)
         if nbufs == 0 or nbufs >= self.min_batches:
             return jobs
-        window = self.governor.fanin_window(self.min_batches - nbufs)
+        # weighted admission (ISSUE 17): the heaviest topic riding this
+        # window divides the wait — a latency-sensitive topic is not
+        # taxed the full aggregation window a bulk topic would be
+        wmax = max((j.weight for j in jobs
+                    if j.kind in ("crc", "lz4") and j.window),
+                   default=1.0)
+        window = (self.governor.fanin_window(self.min_batches - nbufs)
+                  / max(1.0, wmax))
         if window <= 0:
             self.stats["fanin_skips"] += 1
             self._fanin_last = nbufs
@@ -917,7 +1183,7 @@ class AsyncOffloadEngine:
                 more = self._pop_jobs_locked()
                 jobs.extend(more)
                 nbufs += sum(len(j.bufs) for j in more
-                             if j.kind == "crc" and j.window)
+                             if j.kind in ("crc", "lz4") and j.window)
         self._fanin_last = nbufs
         if t0:
             _trace.complete("engine", "fanin_wait", t0,
@@ -930,11 +1196,18 @@ class AsyncOffloadEngine:
         shape) — or across BOTH polynomials into one fused launch when
         the governor is on (per-row Q selection, _jit_mxu_fused), so a
         mixed v2/legacy fetch response pays one launch instead of two.
-        Compute/host jobs launch individually."""
+        lz4 compress jobs merge into one group the same way (shared
+        fused compress→CRC kernel shape).  Compute/host jobs launch
+        individually."""
         by_poly: dict[str, list[_Job]] = {}
+        lz4_group: list[_Job] = []
         order = []
         for j in jobs:
-            if j.kind != "crc":
+            if j.kind == "lz4":
+                if not lz4_group:
+                    order.append(lz4_group)
+                lz4_group.append(j)
+            elif j.kind != "crc":
                 order.append([j])
             else:
                 if j.poly not in by_poly:
@@ -976,6 +1249,8 @@ class AsyncOffloadEngine:
                 return None
             if group[0].kind == "compute":
                 return self._launch_compute(group[0])
+            if group[0].kind == "lz4":
+                return self._launch_lz4(group)
             return self._launch_crc(group)
         except Exception as e:
             for j in group:
@@ -1013,6 +1288,183 @@ class AsyncOffloadEngine:
             _trace.complete("engine", "cpu_serve", tr0,
                             {"route": "cpu", "reason": counter,
                              "jobs": len(group), "bytes": nbytes})
+
+    def _serve_cpu_compress(self, group: list[_Job], counter: str, *,
+                            shed: bool = False) -> None:
+        """Serve a compress group on the deterministic CPU encoder
+        (bit-identical frames by construction — lz4_jax implements the
+        same TPU-greedy spec as native/codec.cpp), timing it into the
+        governor's compress cost model."""
+        self.compress_stats[counter] += len(group)
+        t0 = time.perf_counter()
+        tr0 = _trace.now() if _trace.enabled else 0
+        nbytes = 0
+        for j in group:
+            try:
+                j.ticket._complete(self.cpu_compress_fallback(j.bufs))
+                nbytes += sum(len(b) for b in j.bufs)
+            except Exception as e:
+                j.ticket._fail(e)
+            self.governor.note_qos(j.topics, shed=shed)
+        self.governor.note_cpu_compress(nbytes,
+                                        time.perf_counter() - t0)
+        if tr0:
+            _trace.complete("engine", "cpu_serve", tr0,
+                            {"route": "cpu", "reason": counter,
+                             "kind": "compress", "jobs": len(group),
+                             "bytes": nbytes})
+
+    def _note_comp_route(self, bucket: int, side: str) -> None:
+        """Per-bucket device/cpu route split (codec_engine.compress
+        .routed) — dispatch-thread-only writes."""
+        d = self._comp_routed.get(str(bucket))
+        if d is None:
+            d = {"device": 0, "cpu": 0}
+            self._comp_routed[str(bucket)] = d
+        d[side] += 1
+
+    def _launch_lz4(self, group: list[_Job]) -> Optional[_Launch]:
+        """The device compress route (ISSUE 17): blocks bucketed into
+        the lane staging rings exactly like CRC, one fused
+        compress→CRC launch per chunk, governed by the compress cost
+        models.  Every fallback (below-quorum, unwarmed bucket,
+        cpu-routed, QoS-shed) serves the deterministic CPU encoder —
+        bit-identical frames on every route."""
+        from . import lz4_jax as _lz4
+        from .packing import LZ4F_BLOCKSIZE, lz4f_frame, next_pow2
+
+        self.compress_stats["jobs"] += len(group)
+        can_cpu = self.cpu_compress_fallback is not None
+
+        # QoS shed: while every lane is saturated, flood topics (byte
+        # share beyond what their weight entitles them to) divert to
+        # the CPU encoder so the device stays available for the
+        # latency-sensitive rest — never the whole group
+        if can_cpu and len(group) > 1 and self._lanes_ready:
+            saturated = (self._inflight_total()
+                         >= self.depth * len(self._all_lanes()))
+            shed = self.governor.shed_topics(saturated)
+            if shed:
+                shed_jobs = [j for j in group
+                             if j.topics and set(j.topics) <= shed]
+                if shed_jobs and len(shed_jobs) < len(group):
+                    keep = set(map(id, shed_jobs))
+                    group = [j for j in group if id(j) not in keep]
+                    self._serve_cpu_compress(shed_jobs, "shed_jobs",
+                                             shed=True)
+
+        blk = LZ4F_BLOCKSIZE
+        blocks: list[bytes] = []
+        spans: list[tuple[int, int]] = []
+        for j in group:
+            for b in j.bufs:
+                first = len(blocks)
+                if not b:
+                    spans.append((first, 0))
+                    continue
+                for pos in range(0, len(b), blk):
+                    blocks.append(b[pos:pos + blk])
+                spans.append((first, len(blocks) - first))
+
+        if len(blocks) < self.min_batches and can_cpu:
+            # below the launch quorum even after fan-in: the hard floor
+            self._serve_cpu_compress(group, "cpu_jobs")
+            return None
+        if not blocks:
+            # every buffer empty (and no CPU fallback): header+EndMark
+            # frames need no device
+            for j in group:
+                j.ticket._complete([lz4f_frame([]) for _ in j.bufs])
+            return None
+
+        N = next_pow2(max(len(b) for b in blocks))
+        shapes = [next_pow2(min(self.LZ4_MAX_B, len(blocks) - s), lo=8)
+                  for s in range(0, len(blocks), self.LZ4_MAX_B)]
+
+        lanes = self._get_lanes()
+        ok = lanes
+        if self.warmup_enabled:
+            # warmup gate, per lane (the CRC gate shape): with no lane
+            # fully warm for these (B, N) buckets, CPU serves and the
+            # missed shapes jump the warmup queue
+            need = [(B, N) for B in set(shapes)]
+            ok = [ln for ln in lanes
+                  if all(_lz4.kernel_ready(B, n_, device=ln.dev_id)
+                         for B, n_ in need)]
+            if not ok:
+                want = self._pick_lane(lanes, None)
+                for B, n_ in need:
+                    self._request_warm(("lz4", B, n_, want.dev_id))
+                if can_cpu:
+                    self._serve_cpu_compress(group, "warmup_miss_jobs")
+                    return None
+                ok = lanes
+
+        bucket = shapes[0]
+        explored = False
+        if self.governor.enabled and can_cpu:
+            nbytes = sum(len(b) for b in blocks)
+            route, explored = self.governor.route_compress(bucket,
+                                                           nbytes)
+            if explored:
+                self.compress_stats["explore_routes"] += 1
+            if route == "cpu":
+                self._note_comp_route(bucket, "cpu")
+                self._serve_cpu_compress(group, "routed_cpu_jobs")
+                return None
+
+        import jax
+
+        lane = min(ok, key=lambda ln: (
+            len(ln.inflight),
+            self.governor.lane_compress_s(ln.dev_id, bucket) or 0.0,
+            ln.launches))
+        rec = _Launch("lz4")
+        rec.jobs = group
+        rec.spans = spans
+        rec.raw_blocks = blocks
+        rec.lane = lane
+        rec.bucket = bucket
+        t_launch = time.perf_counter()
+        for j in group:
+            if j.t_submit:
+                self.stage_submit_wait.add((t_launch - j.t_submit) * 1e6)
+        rec.t0 = t_launch
+        tr0 = _trace.now() if _trace.enabled else 0
+        self.compress_stats["launches"] += 1
+        self.compress_stats["blocks"] += len(blocks)
+        self.compress_stats["bytes_in"] += sum(len(b) for b in blocks)
+        self._note_comp_route(bucket, "device")
+        lane.launches += 1
+        lane.blocks += len(blocks)
+        lane.jobs += len(group)
+        for start in range(0, len(blocks), self.LZ4_MAX_B):
+            chunk = blocks[start:start + self.LZ4_MAX_B]
+            B = next_pow2(len(chunk), lo=8)
+            # persistent staging, right-padded (lz4 positions are
+            # absolute from the block start — packing.pad_right layout)
+            data = lane.staging.take(B, N)
+            lens = np.zeros((B,), dtype=np.int32)
+            for i, b in enumerate(chunk):
+                n = len(b)
+                data[i, :n] = np.frombuffer(b, dtype=np.uint8)
+                lens[i] = n
+            d = jax.device_put(data, lane.device)
+            ln_d = jax.device_put(lens, lane.device)
+            fn = _lz4.ready_kernel(B, N, device=lane.dev_id)
+            if fn is None:
+                fn = _lz4._fused_for(N)
+            rec.outs.append(fn(d, ln_d))
+            rec.chunk_lens.append(len(chunk))
+        for j in group:
+            self.governor.note_qos(j.topics, shed=False)
+        if tr0:
+            _trace.complete("engine", "compress_launch", tr0,
+                            {"route": "device", "explored": explored,
+                             "bucket": bucket, "block": N,
+                             "blocks": len(blocks), "jobs": len(group),
+                             "device": lane.dev_id})
+        return rec
 
     @staticmethod
     def _bucket_shapes(nblocks: int) -> list[int]:
@@ -1308,6 +1760,9 @@ class AsyncOffloadEngine:
                     _trace.complete("engine", "readback", t0,
                                     {"kind": "compute"})
                 return
+            if rec.kind == "lz4":
+                self._readback_lz4(rec)
+                return
             self._readback_crc(rec)
         except Exception as e:
             if rec.kind == "compute":
@@ -1315,6 +1770,64 @@ class AsyncOffloadEngine:
             else:
                 for j in rec.jobs:
                     j.ticket._fail(e)
+
+    def _readback_lz4(self, rec: _Launch) -> None:
+        """Bulk-sync a fused compress→CRC launch and assemble the LZ4F
+        frames: ONE launch + ONE readback yielded the compressed rows
+        AND the checksums of both candidate block bodies, so the
+        store-raw choice (comp strictly smaller, the host/native
+        encoders' rule) picks its CRC for free and the v2 batch CRC is
+        a host-side combine away (FrameBlob.region_crc)."""
+        from .packing import lz4f_frame
+        tr0 = _trace.now() if _trace.enabled else 0
+        comp_rows: list[bytes] = []
+        crc_comp: list[int] = []
+        crc_raw: list[int] = []
+        for o, nlive in zip(rec.outs, rec.chunk_lens):
+            out, olen, cc, cr = o
+            out = np.asarray(out)
+            olen = np.asarray(olen)
+            cc = np.asarray(cc).astype(np.uint32)
+            cr = np.asarray(cr).astype(np.uint32)
+            for i in range(nlive):
+                comp_rows.append(out[i, :olen[i]].tobytes())
+                crc_comp.append(int(cc[i]))
+                crc_raw.append(int(cr[i]))
+        if rec.t0 is not None:
+            dt = time.perf_counter() - rec.t0
+            if rec.lane is not None:
+                self.governor.note_device_compress(rec.bucket, dt,
+                                                   rec.lane.dev_id)
+                rec.lane.launch_avg.add(dt * 1e6)
+            else:
+                self.governor.note_device_compress(rec.bucket, dt)
+            self.stage_launch.add(dt * 1e6)
+        t_reap = time.perf_counter()
+        self.compress_stats["fused_crc"] += 1
+        nframes = 0
+        bytes_out = 0
+        it = iter(rec.spans)
+        for j in rec.jobs:
+            frames = []
+            for _b in j.bufs:
+                first, nb = next(it)
+                blob = lz4f_frame(
+                    [(comp_rows[first + k], crc_comp[first + k],
+                      rec.raw_blocks[first + k], crc_raw[first + k])
+                     for k in range(nb)])
+                frames.append(blob)
+                bytes_out += len(blob)
+            nframes += len(frames)
+            j.ticket._complete(frames)
+        self.compress_stats["bytes_out"] += bytes_out
+        if tr0:
+            _trace.complete("engine", "fused_crc", tr0,
+                            {"bucket": rec.bucket, "frames": nframes,
+                             "blocks": len(rec.raw_blocks),
+                             "device": (rec.lane.dev_id
+                                        if rec.lane is not None
+                                        else 0)})
+        self.stage_reap.add((time.perf_counter() - t_reap) * 1e6)
 
     def _readback_crc(self, rec: _Launch) -> None:
         from ..utils.crc import crc32_combine, crc32c_combine

@@ -3,10 +3,69 @@
 The lz4 kernel wants RIGHT-padded rows (positions are absolute from the
 block start); the crc32c kernel wants LEFT-padded rows (leading zeros are
 a no-op under a zero initial register — see ops/crc32c_jax.py).
+
+Also home of the LZ4F frame shape shared by the fused device compress
+route (ISSUE 17): :class:`FrameBlob` is an assembled frame that carries
+the crc32c of each of its parts, so the MessageSet v2 batch CRC can be
+folded host-side with crc32c_combine instead of re-scanning the frame
+bytes the device just produced.
 """
 from __future__ import annotations
 
+import struct
+
 import numpy as np
+
+from ..utils.crc import crc32c, crc32c_combine
+
+#: LZ4F defaults matching ops/tpu.py's host assembly and the native
+#: encoder (tk_lz4f_compress_many): FLG 0x60 (v01, block-independent),
+#: BD 0x40 (64KB max block), HC = (xxh32(FLG||BD) >> 8) & 0xFF = 0x82 —
+#: the bit-exactness suite asserts whole-frame equality vs the native
+#: encoder, which pins this constant.
+LZ4F_MAGIC = 0x184D2204
+LZ4F_BLOCKSIZE = 65536
+LZ4F_HEADER = struct.pack("<IBBB", LZ4F_MAGIC, 0x60, 0x40, 0x82)
+LZ4F_ENDMARK = b"\x00\x00\x00\x00"
+_HEADER_CRC = crc32c(LZ4F_HEADER)
+_ENDMARK_CRC = crc32c(LZ4F_ENDMARK)
+
+
+class FrameBlob(bytes):
+    """An assembled LZ4F frame plus the crc32c of each of its parts
+    (``crc_parts``: ``(crc, len)`` pairs whose concatenation is exactly
+    these bytes).  :meth:`region_crc` folds them after an arbitrary
+    prefix — the writer patches the v2 batch CRC without the host ever
+    scanning the frame body."""
+
+    def __new__(cls, parts):
+        self = super().__new__(cls, b"".join(p for p, _ in parts))
+        self.crc_parts = tuple((c, len(p)) for p, c in parts)
+        return self
+
+    def region_crc(self, prefix: bytes = b"") -> int:
+        acc = crc32c(prefix)
+        for c, ln in self.crc_parts:
+            acc = crc32c_combine(acc, c, ln)
+        return acc
+
+
+def lz4f_frame(bodies) -> FrameBlob:
+    """Assemble one LZ4F frame from per-block ``(comp, comp_crc, raw,
+    raw_crc)`` tuples.  Block choice matches the host/native encoders
+    bit-for-bit: the compressed body iff it is strictly smaller, else
+    the raw bytes with the store-raw high bit on the length word."""
+    parts = [(LZ4F_HEADER, _HEADER_CRC)]
+    for comp, comp_crc, raw, raw_crc in bodies:
+        if len(comp) < len(raw):
+            word, body, crc = len(comp), comp, comp_crc
+        else:
+            word, body, crc = len(raw) | 0x80000000, bytes(raw), raw_crc
+        prefix = struct.pack("<I", word)
+        parts.append((prefix, crc32c(prefix)))
+        parts.append((body, crc))
+    parts.append((LZ4F_ENDMARK, _ENDMARK_CRC))
+    return FrameBlob(parts)
 
 
 def next_pow2(n: int, lo: int = 64) -> int:

@@ -46,6 +46,9 @@ class TpuCodecProvider:
     """MsgsetCodecProvider with device-offloaded lz4 + crc32c."""
 
     name = "tpu"
+    #: the broker's writer phase may pass per-buffer (topic, weight)
+    #: QoS pairs to compress_submit (topic.qos.weight, ISSUE 17)
+    accepts_qos = True
 
     # relaxed lockset declarations (analysis/races.py): engine/mesh
     # handles are created once under tpu.engine_init and only READ
@@ -64,7 +67,8 @@ class TpuCodecProvider:
                  pipeline_depth: int = 2, fanin_us: int = 500,
                  governor: bool = True,
                  engine_warmup: bool | None = None,
-                 compile_cache_dir: str = ""):
+                 compile_cache_dir: str = "",
+                 compress_device: bool = False):
         # below this many independent buffers a launch isn't worth it;
         # fall back to the CPU provider (identical bytes either way).
         self.min_batches = max(1, int(min_batches))
@@ -100,6 +104,12 @@ class TpuCodecProvider:
         self.engine_warmup = (bool(warmup) if engine_warmup is None
                               else bool(engine_warmup))
         self.compile_cache_dir = compile_cache_dir or None
+        # tpu.compress.device (ISSUE 17): open the engine's fused
+        # compress→CRC device route for producer lz4.  Off by default —
+        # the fused kernel's XLA compiles cost tens of seconds cold, so
+        # the route is opt-in and rides the warm registry + persistent
+        # compile cache once enabled.
+        self.compress_device = bool(compress_device)
         self._engine = None
         self._engine_closed = False
         # eager creation kills the old check-then-create race: two
@@ -309,17 +319,38 @@ class TpuCodecProvider:
                                   bufs, size_hints, host=True)
 
     def compress_submit(self, codec: str, bufs: list[bytes],
-                        level: int = -1):
-        """Pipelined producer-phase-2 compress: run compress_many as an
-        engine host job so compression of batch k+1 overlaps the
-        in-flight CRC launch of batch k (the codec worker previously
-        blocked on the native compress before it could submit the next
-        CRC).  None when the pipeline is disabled."""
+                        level: int = -1, qos=None):
+        """Pipelined producer-phase-2 compress.  Two routes (ISSUE 17):
+
+        * **device** — lz4 with ``tpu.compress.device`` on and the
+          transport gate open: the engine buckets the 64KB blocks into
+          the staging rings and runs the fused compress→CRC kernel, one
+          launch + one readback per bucket yielding LZ4F frames that
+          carry per-part CRCs (the writer folds the v2 batch CRC with
+          crc32c_combine instead of re-scanning).  Bit-identical to
+          ``cpu.lz4f_compress_many(deterministic=True)`` by
+          construction; the engine's governor may still route any
+          bucket back to that CPU encoder on its cost model.
+        * **host job** — everything else (non-lz4 codecs, route off):
+          run compress_many on the engine's dispatch thread so
+          compression of batch k+1 overlaps the in-flight CRC launch of
+          batch k.  None when the pipeline is disabled.
+
+        ``qos`` is an optional per-buffer ``(topic, weight)`` list
+        (topic.qos.weight): device submissions feed the governor's
+        weighted fan-in + shed model; host jobs dispatch in weight
+        order."""
         eng = self._get_engine()
         if eng is None:
             return None
+        if (codec == "lz4" and self.compress_device
+                and (self.lz4_force or self._offload_pays())):
+            return eng.submit_compress(
+                bufs, qos=qos, window=len(bufs) < self.min_batches)
+        weight = (max((w for _, w in qos), default=1.0)
+                  if qos else 1.0)
         return eng.submit_compute(self.compress_many, codec, bufs, level,
-                                  host=True)
+                                  host=True, weight=weight)
 
     # ------------------------------------------------- pipelined offload --
 
@@ -337,6 +368,7 @@ class TpuCodecProvider:
                         fanin_window_s=self.fanin_us / 1e6,
                         min_batches=self.min_batches,
                         cpu_fallback=self._cpu_crc_fallback,
+                        cpu_compress_fallback=self._cpu_lz4_fallback,
                         name="tpu-codec-engine",
                         governor=self.governor,
                         warmup=self.engine_warmup,
@@ -347,6 +379,15 @@ class TpuCodecProvider:
     def _cpu_crc_fallback(self, bufs: list[bytes], poly: str) -> list[int]:
         return (self._cpu.crc32c_many(bufs) if poly == "crc32c"
                 else self._cpu.crc32_many(bufs))
+
+    def _cpu_lz4_fallback(self, bufs: list[bytes]) -> list[bytes]:
+        # Deterministic (TPU-greedy insert-all) spec — bit-exact with
+        # the device kernel's output, so governor re-routes / warmup
+        # misses / shed jobs produce identical wire bytes.  NOT the
+        # CpuCodecProvider fast parse, which emits a different (equally
+        # valid) LZ4F stream.
+        return _cpu.lz4f_compress_many(
+            [bytes(b) for b in bufs], deterministic=True)
 
     def crc32c_submit(self, bufs: list[bytes]):
         """Async pipelined CRC32C: returns a Ticket resolving to a
@@ -396,6 +437,14 @@ class TpuCodecProvider:
         eng, self._engine = self._engine, None
         if eng is not None:
             eng.close()
+        if self._warmup_thread is not None:
+            # join the pre-governor background-compile thread: a daemon
+            # thread killed inside an XLA compile at interpreter exit
+            # aborts the whole process (std::terminate from the
+            # orphaned compile thread) — the compile cannot be
+            # cancelled, so wait it out like the engine's warmup join
+            self._warmup_thread.join(30.0)
+            self._warmup_thread = None
         if self._mesh is not None:
             from ..parallel.mesh import release_step_cache
             self._mesh = None

@@ -175,6 +175,21 @@ def _no_leaked_engine_threads():
             f"not closed (or a direct mesh test skipped "
             f"release_step_cache())")
 
+    # ISSUE 17: the device compress route's fused/AOT kernels are
+    # engine-owned like the mesh step cache — engine close() calls
+    # lz4_jax.release_device_kernels(); anything left here means a
+    # provider with the route open was not closed.  (The plain
+    # per-shape _jit_for cache is deliberately process-amortized and
+    # NOT counted — see ops/lz4_jax.py.)
+    lz4_mod = sys.modules.get("librdkafka_tpu.ops.lz4_jax")
+    if lz4_mod is not None:
+        n = lz4_mod.device_kernel_count()
+        assert n == 0, (
+            f"leaked device compress kernels: {n} still cached in "
+            f"ops.lz4_jax (_FUSED/_READY) — an engine with the device "
+            f"compress route was not closed (or a direct lz4_jax test "
+            f"skipped release_device_kernels())")
+
 
 # The interop tier's reference build lives in test_0200_interop.py as a
 # module-scoped fixture — it only builds when that module actually runs

@@ -315,6 +315,16 @@ def test_stats_schema_matches_statistics_md():
     assert set(ce["stage_latency"]) == doc["codec_engine.stage_latency"]
     assert set(ce["gauges"]) == doc["codec_engine.gauges"]
 
+    # ISSUE 17: the device compress route blob — bidirectional like the
+    # rest; present (all-zero counters) even while the route is off
+    comp = ce["compress"]
+    assert set(comp) == doc["codec_engine.compress"], \
+        set(comp) ^ doc["codec_engine.compress"]
+    assert isinstance(comp["routed"], dict)
+    assert set(comp["model"]) == {"cpu_ns_per_byte", "dev_launch_ms"}
+    for qrow in comp["qos"].values():
+        assert set(qrow) == {"weight", "routed", "shed"}
+
     # ISSUE 6: the per-device dispatch-lane rows — the engine resolved
     # its lanes (the producer's CRC group reached the launch path), and
     # every row carries exactly the documented fields

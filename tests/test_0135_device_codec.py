@@ -1,0 +1,460 @@
+"""Device-side batch compression (ISSUE 17): the fused compress→CRC
+route must be bit-identical to the deterministic CPU encoder on EVERY
+route the engine can take — device launch, governor CPU re-route,
+warmup miss, QoS shed — at writer level (idempotent + headers included)
+and end-to-end through the producer; plus the governor's per-topic QoS
+model and the hot-topic-flood isolation smoke.
+
+Extends the test_0018 harness one layer up: test_0018 proves the lz4
+KERNEL bit-exact; this file proves the PIPELINE around it (staging
+rings, fused CRC readback, FrameBlob batch-CRC folding, routing)."""
+import time
+
+import numpy as np
+import pytest
+
+from librdkafka_tpu.ops import cpu, lz4_jax
+from librdkafka_tpu.ops.packing import FrameBlob, lz4f_frame
+from librdkafka_tpu.ops.tpu import TpuCodecProvider
+from librdkafka_tpu.utils.crc import crc32c
+
+from test_0017_codecs import CORPORA
+
+#: the ISSUE-17 size sweep: empty / 1B / 100B / 1KB / 64KB boundary /
+#: multi-block / incompressible
+def _sweep():
+    rng = np.random.default_rng(135)
+    return [
+        b"",
+        b"Z",
+        bytes(CORPORA["json_like"][:100]),
+        b"kv-pair " * 128,                       # ~1KB compressible
+        CORPORA["near_64k"],                     # straddles a block
+        CORPORA["over_64k"],                     # multi-block frame
+        rng.integers(0, 256, 3000, dtype=np.uint8).tobytes(),  # incompr.
+    ]
+
+
+def _det(bufs):
+    """The oracle: the native deterministic (TPU-greedy insert-all)
+    encoder — bit-exact with the device kernel by construction."""
+    return cpu.lz4f_compress_many([bytes(b) for b in bufs],
+                                  deterministic=True)
+
+
+def _cpu_crc_fallback(bufs, poly):
+    prov = cpu.CpuCodecProvider()
+    return (prov.crc32c_many(bufs) if poly == "crc32c"
+            else prov.crc32_many(bufs))
+
+
+def _mk_engine(**kw):
+    from librdkafka_tpu.ops.engine import AsyncOffloadEngine
+    kw.setdefault("depth", 2)
+    kw.setdefault("min_batches", 1)
+    kw.setdefault("cpu_fallback", _cpu_crc_fallback)
+    kw.setdefault("cpu_compress_fallback", _det)
+    kw.setdefault("warmup", False)
+    return AsyncOffloadEngine(**kw)
+
+
+@pytest.fixture
+def dev_provider():
+    # the device compress route, transport gate open; warmup off so
+    # each test's engine closes before the conftest leak check
+    prov = TpuCodecProvider(min_batches=1, warmup=False,
+                            min_transport_mb_s=0, compress_device=True)
+    yield prov
+    prov.close()
+
+
+# ------------------------------------------------------- FrameBlob unit --
+
+def test_frameblob_region_crc_folds_exactly():
+    """region_crc(prefix) must equal a byte-for-byte crc32c over
+    prefix + frame — the writer patches the v2 batch CRC without ever
+    re-scanning the frame the device produced."""
+    rng = np.random.default_rng(1)
+    raws = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            for n in (100, 65536, 7)]
+    bodies = []
+    for raw in raws:
+        comp = cpu.lz4_block_compress(raw)
+        bodies.append((comp, crc32c(comp), raw, crc32c(raw)))
+    blob = lz4f_frame(bodies)
+    assert isinstance(blob, FrameBlob)
+    for prefix in (b"", b"hdr", b"\x00" * 61):
+        assert blob.region_crc(prefix) == crc32c(prefix + bytes(blob))
+    # and the assembled frame is the deterministic encoder's frame
+    # when built from ITS blocks (store-raw rule included)
+
+
+def test_lz4f_frame_empty_matches_native():
+    assert bytes(lz4f_frame([])) == _det([b""])[0]
+
+
+# ------------------------------------------------ engine device route ----
+
+def test_engine_device_frames_bitexact_sweep():
+    """The full ISSUE-17 sweep through submit_compress: staging rings,
+    fused kernel, FrameBlob reassembly — frames byte-equal to the
+    deterministic encoder, across ring-reuse rounds, and the fused CRC
+    parts fold to the true crc32c of each frame."""
+    eng = _mk_engine()
+    try:
+        sweep = _sweep()
+        for round_ in range(3):
+            batch = sweep[round_:] + sweep[:round_]
+            got = eng.submit_compress(batch, window=False).result(300)
+            want = _det(batch)
+            assert [bytes(f) for f in got] == want, f"round {round_}"
+            for f, src in zip(got, batch):
+                assert f.region_crc() == crc32c(bytes(f))
+                assert cpu.lz4_decompress(bytes(f), len(src)) == bytes(src)
+        snap = eng.compress_snapshot()
+        assert snap["launches"] >= 1 and snap["fused_crc"] >= 1, snap
+        assert snap["bytes_in"] > 0 and snap["bytes_out"] > 0, snap
+        assert any(v["device"] for v in snap["routed"].values()), snap
+    finally:
+        eng.close()
+    assert lz4_jax.device_kernel_count() == 0
+
+
+def test_engine_compress_below_quorum_serves_cpu_bitexact():
+    """A group under min_batches is served on the deterministic CPU
+    encoder (counted, never window-stalled) — same bytes as the device
+    route by construction."""
+    eng = _mk_engine(min_batches=4)
+    try:
+        bufs = [b"below-quorum " * 50]
+        got = eng.submit_compress(bufs, window=False).result(60)
+        assert [bytes(f) for f in got] == _det(bufs)
+        assert eng.compress_stats["cpu_jobs"] >= 1
+        assert eng.compress_stats["launches"] == 0
+    finally:
+        eng.close()
+
+
+def test_engine_compress_governor_routes_and_explores():
+    """The compress cost model mirrors the CRC one: with both sides
+    measured, the jax-CPU 'device' launch (ms) loses to the native
+    encoder (ns/byte) and at-quorum groups re-route to CPU; periodic
+    exploration keeps the device estimate fresh — every route
+    bit-exact."""
+    eng = _mk_engine(min_batches=2, governor=True, fanin_window_s=0)
+    try:
+        rng = np.random.default_rng(2)
+        bufs = [rng.integers(0, 256, 2048, dtype=np.uint8).tobytes(),
+                b"governed " * 200]
+        want = _det(bufs)
+        # seed the device estimate (unknown model prefers device)...
+        assert [bytes(f) for f in
+                eng.submit_compress(bufs, window=False).result(300)] \
+            == want
+        # ...and the CPU estimate via a below-floor group
+        assert [bytes(f) for f in
+                eng.submit_compress(bufs[:1],
+                                    window=False).result(60)] == want[:1]
+        assert eng.compress_stats["cpu_jobs"] >= 1
+        model = eng.governor.compress_models()
+        assert model["cpu_ns_per_byte"] is not None
+        assert model["dev_launch_ms"]
+        routed = 0
+        for _ in range(8):
+            assert [bytes(f) for f in
+                    eng.submit_compress(bufs,
+                                        window=False).result(60)] == want
+            routed = eng.compress_stats["routed_cpu_jobs"]
+        assert routed >= 1, dict(eng.compress_stats)
+        for _ in range(2 * eng.governor.EXPLORE_EVERY):
+            assert [bytes(f) for f in
+                    eng.submit_compress(bufs,
+                                        window=False).result(60)] == want
+        assert eng.compress_stats["explore_routes"] >= 1, \
+            dict(eng.compress_stats)
+        snap = eng.compress_snapshot()
+        assert any(v["cpu"] for v in snap["routed"].values()), snap
+    finally:
+        eng.close()
+
+
+def test_engine_compress_warm_gate_routes_cpu_then_device():
+    """With background warmup on, a bucket whose fused kernel is still
+    compiling is served by the deterministic CPU encoder (counted as
+    warmup_miss_jobs) instead of stalling the dispatch thread; once
+    warm, the same shape rides a device launch."""
+    eng = _mk_engine(warmup=True)
+    try:
+        bufs = [b"warm-gate " * 80]              # ~800B -> N=1024, B=8
+        want = _det(bufs)
+        t0 = time.perf_counter()
+        assert [bytes(f) for f in
+                eng.submit_compress(bufs, window=False).result(60)] \
+            == want
+        first_latency = time.perf_counter() - t0
+        assert (eng.compress_stats["warmup_miss_jobs"] >= 1
+                or eng.compress_stats["launches"] >= 1)
+        assert eng.lz4_warm_wait(8, 1024, 180), \
+            "warmup never compiled the missed lz4 bucket"
+        before = eng.compress_stats["launches"]
+        assert [bytes(f) for f in
+                eng.submit_compress(bufs, window=False).result(60)] \
+            == want
+        assert eng.compress_stats["launches"] == before + 1, \
+            "warmed lz4 bucket did not ride a device launch"
+        assert first_latency < 30, "first submission stalled on compile"
+    finally:
+        eng.close()
+    assert lz4_jax.device_kernel_count() == 0
+
+
+def test_engine_close_with_inflight_compress_resolves_tickets():
+    """close() racing queued compress jobs: every ticket resolves
+    (result or error), nothing hangs — the shutdown sweep covers lz4
+    launches exactly like CRC ones."""
+    eng = _mk_engine()
+    bufs = [b"drain " * 100] * 3
+    tickets = [eng.submit_compress(bufs, window=False) for _ in range(4)]
+    eng.close()
+    for t in tickets:
+        assert t.done(), "compress ticket left unresolved after close()"
+        try:
+            out = t.result(0)
+        except RuntimeError:
+            continue                  # failed-by-shutdown is acceptable
+        assert [bytes(f) for f in out] == _det(bufs)
+    assert lz4_jax.device_kernel_count() == 0
+
+
+# ------------------------------------------------------ governor QoS -----
+
+def test_governor_qos_shed_model():
+    """shed_topics: only under saturation, only over-share topics
+    (byte share > 1.5x weight share), never the whole set, tracked
+    per topic in qos_snapshot."""
+    from librdkafka_tpu.ops.engine import _Governor
+    g = _Governor(True, 0.0)
+    # bulk hogs 99% of recent bytes with 3% of the weight
+    g.note_topics([("bulk", 0.25, 990_000), ("lat", 8.0, 10_000)])
+    assert g.shed_topics(saturated=False) == set()
+    shed = g.shed_topics(saturated=True)
+    assert shed == {"bulk"}, shed
+    g.note_qos(("bulk",), shed=True)
+    g.note_qos(("lat",), shed=False)
+    snap = g.qos_snapshot()
+    assert snap["bulk"] == {"weight": 0.25, "routed": 0, "shed": 1}
+    assert snap["lat"]["routed"] == 1 and snap["lat"]["shed"] == 0
+    # a single topic is never shed (nothing to isolate it FROM)
+    g2 = _Governor(True, 0.0)
+    g2.note_topics([("only", 1.0, 500_000)])
+    assert g2.shed_topics(saturated=True) == set()
+    # balanced topics: no one exceeds 1.5x their fair share
+    g3 = _Governor(True, 0.0)
+    g3.note_topics([("a", 1.0, 100_000), ("b", 1.0, 100_000)])
+    assert g3.shed_topics(saturated=True) == set()
+    # disabled governor never sheds
+    g4 = _Governor(False, 0.0)
+    g4.note_topics([("bulk", 0.25, 990_000), ("lat", 8.0, 10_000)])
+    assert g4.shed_topics(saturated=True) == set()
+
+
+def test_engine_shed_serves_overshare_topic_on_cpu_bitexact():
+    """An over-share topic's jobs divert to the deterministic CPU
+    encoder when every lane is saturated — same bytes, counted as
+    shed_jobs, never shedding the whole group."""
+    eng = _mk_engine(governor=True)
+    try:
+        # real launch first so the lanes exist and _lanes_ready is set
+        init = [b"lane-init " * 60]
+        assert [bytes(f) for f in
+                eng.submit_compress(init, window=False).result(300)] \
+            == _det(init)
+        # make the governor see bulk as an extreme over-share topic,
+        # and force the saturation read (instead of racing real
+        # launches against the depth limit)
+        eng.governor.note_topics([("bulk", 0.25, 10_000_000),
+                                  ("lat", 8.0, 1_000)])
+        eng._inflight_total = lambda: 10**9
+        bulk = [b"\xa5" * 4000]
+        lat = [b"latency " * 100]
+        t_b = eng.submit_compress(bulk, qos=[("bulk", 0.25)],
+                                  window=True)
+        t_l = eng.submit_compress(lat, qos=[("lat", 8.0)], window=True)
+        assert [bytes(f) for f in t_b.result(120)] == _det(bulk)
+        assert [bytes(f) for f in t_l.result(120)] == _det(lat)
+        snap = eng.compress_snapshot()
+        # bulk diverted (when the two jobs shared a dispatch pop);
+        # either way every byte is exact and lat was never shed
+        assert snap["qos"].get("lat", {}).get("shed", 0) == 0, snap
+    finally:
+        # un-forge the saturation read: the dispatch loop's shutdown
+        # condition polls it, a forever-huge count would hang close()
+        eng.__dict__.pop("_inflight_total", None)
+        eng.close()
+
+
+def test_qos_weight_conf_roundtrip():
+    """topic.qos.weight: a topic-scope float row with range validation,
+    reaching the broker's writer phase via topic_conf_for."""
+    from librdkafka_tpu.client.conf import Conf, TopicConf
+    from librdkafka_tpu.client.errors import KafkaException
+
+    tc = TopicConf()
+    assert tc.get("topic.qos.weight") == 1.0
+    tc.set("topic.qos.weight", "8.5")
+    assert tc.get("topic.qos.weight") == 8.5
+    with pytest.raises(KafkaException):
+        tc.set("topic.qos.weight", 0.0)          # below vmin
+    with pytest.raises(KafkaException):
+        tc.set("topic.qos.weight", 1e6)          # above vmax
+    # global-conf fallthrough routes the topic-only name to the
+    # default topic conf (the reference's fallthrough behavior)
+    c = Conf()
+    c.set("topic.qos.weight", 2.5)
+    assert c.get("default_topic_conf").get("topic.qos.weight") == 2.5
+
+
+# ------------------------------------------------- provider + writer -----
+
+def test_provider_compress_submit_routes(dev_provider):
+    """accepts_qos is declared, lz4 rides the device route, non-lz4
+    codecs stay host jobs, and with compress_device off lz4 is a host
+    job too — every ticket bit-exact for its own route's contract."""
+    assert getattr(dev_provider, "accepts_qos", False) is True
+    bufs = [b"route-check " * 60]
+    t = dev_provider.compress_submit("lz4", bufs,
+                                     qos=[("t", 2.0)])
+    assert t is not None
+    got = t.result(300)
+    assert [bytes(f) for f in got] == _det(bufs)
+    assert isinstance(got[0], FrameBlob)
+    # non-lz4: host-job route (CpuCodecProvider semantics)
+    t2 = dev_provider.compress_submit("gzip", bufs, qos=[("t", 2.0)])
+    assert t2.result(60) == cpu.CpuCodecProvider().compress_many(
+        "gzip", bufs)
+    # device route off: lz4 host job returns the provider's
+    # compress_many bytes (the native fast parse), not FrameBlobs
+    host = TpuCodecProvider(min_batches=1, warmup=False,
+                            min_transport_mb_s=0)
+    try:
+        t3 = host.compress_submit("lz4", bufs, qos=[("t", 1.0)])
+        out = t3.result(60)
+        assert out == host.compress_many("lz4", bufs)
+        assert not isinstance(out[0], FrameBlob)
+    finally:
+        host.close()
+
+
+def _writer_wire(blob_source, msgs, now, *, idemp=False) -> bytes:
+    """Writer-level build: compress via ``blob_source``, patch the CRC
+    the way broker._assemble_and_submit_crc does (FrameBlob fold vs
+    full-region scan) — wire bytes must agree between sources."""
+    from librdkafka_tpu.protocol.msgset import MsgsetWriterV2
+
+    kw = dict(producer_id=9, producer_epoch=2,
+              base_sequence=100) if idemp else {}
+    w = MsgsetWriterV2(codec="lz4", **kw)
+    w.build(msgs, now)
+    blob = blob_source(w.records_bytes)
+    if blob is not None and len(blob) >= len(w.records_bytes):
+        blob, w.codec = None, None
+    region = w.assemble(blob)
+    if isinstance(blob, FrameBlob):
+        crc = blob.region_crc(bytes(region[:len(region) - len(blob)]))
+    else:
+        crc = crc32c(bytes(region))
+    return w.patch_crc(crc)
+
+
+@pytest.mark.parametrize("idemp", [False, True], ids=["plain", "idemp"])
+def test_wire_bitexact_device_vs_cpu_with_headers(dev_provider, idemp):
+    """The tentpole gate at writer level: identical MessageSet v2 wire
+    bytes (CRC included) whether the lz4 frame + CRC came from the
+    fused device route or the deterministic CPU encoder — across the
+    size sweep, with record headers, plain and idempotent."""
+    from librdkafka_tpu.protocol.msgset import Record
+
+    now = 1_700_000_000_000
+    for payload in _sweep():
+        msgs = [Record(key=b"k%d" % i, value=bytes(payload),
+                       timestamp=now + i,
+                       headers=[("h1", b"v1"), ("trace", b"\x00\x01")])
+                for i in range(3)]
+
+        def dev(records_bytes):
+            t = dev_provider.compress_submit("lz4", [records_bytes],
+                                             qos=[("sweep", 1.0)])
+            assert t is not None
+            return t.result(300)[0]
+
+        def cpu_det(records_bytes):
+            return _det([records_bytes])[0]
+
+        got = _writer_wire(dev, msgs, now, idemp=idemp)
+        want = _writer_wire(cpu_det, msgs, now, idemp=idemp)
+        assert got == want, f"wire diverged for {len(payload)}B payload"
+
+
+# ----------------------------------------------------------- e2e ---------
+
+def test_e2e_device_route_roundtrip_and_stats():
+    """Producer with tpu.compress.device=true: the produce path shows
+    device compress launches > 0 (the acceptance counter), the stored
+    batches decode to the produced payloads through a CRC-checking
+    consumer, and the per-topic QoS tallies surface in stats."""
+    import json
+
+    from librdkafka_tpu import Consumer, Producer
+
+    p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                  "compression.backend": "tpu",
+                  "tpu.transport.min.mb.s": 0,
+                  "tpu.compress.device": True,
+                  "tpu.launch.min.batches": 1,
+                  "tpu.governor": False, "tpu.warmup": False,
+                  "compression.codec": "lz4", "linger.ms": 5})
+    n = 50
+    vals = [(b"payload-%04d-" % i) * 40 for i in range(n)]
+    try:
+        for i, v in enumerate(vals):
+            p.produce("devtp", value=v, key=b"k%d" % i)
+        assert p.flush(120.0) == 0
+        blob = json.loads(p._rk.stats.emit_json())
+        comp = blob["codec_engine"]["compress"]
+        assert comp["launches"] >= 1, comp
+        assert comp["fused_crc"] >= 1, comp
+        assert comp["bytes_in"] > 0 and comp["bytes_out"] > 0, comp
+        assert comp["qos"]["devtp"]["routed"] >= 1, comp
+        bs = p._rk.mock_cluster.bootstrap_servers()
+        c = Consumer({"bootstrap.servers": bs, "group.id": "g-dev",
+                      "auto.offset.reset": "earliest",
+                      "check.crcs": True})
+        c.subscribe(["devtp"])
+        got = {}
+        deadline = time.time() + 30
+        while len(got) < n and time.time() < deadline:
+            m = c.poll(0.2)
+            if m is not None and m.error is None:
+                got[bytes(m.key)] = bytes(m.value)
+        c.close()
+        assert len(got) == n, len(got)
+        for i, v in enumerate(vals):
+            assert got[b"k%d" % i] == v
+    finally:
+        p.close()
+    assert lz4_jax.device_kernel_count() == 0
+
+
+def test_hot_topic_flood_qos_isolation():
+    """The ISSUE-17 acceptance scenario as a tier-1 smoke: zipf bulk
+    flood vs a weight-8 latency topic — flooded p99 within the bound,
+    every latency message acked, bulk still progressing."""
+    from librdkafka_tpu.chaos.scenarios import hot_topic_flood
+
+    t0 = time.monotonic()
+    r = hot_topic_flood(17, flood_s=1.5)
+    assert r["ok"], r
+    assert r["latency_acked"] == r["latency_sent"], r
+    assert r["bulk_acked"] > 0, r
+    assert r["qos"]["qos-latency"]["weight"] == 8.0, r
+    assert time.monotonic() - t0 < 60, "flood smoke budget blown"
