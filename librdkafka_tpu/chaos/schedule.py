@@ -39,6 +39,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..obs import metrics as _metrics
+
 
 # ------------------------------------------------------------- actions --
 class Action:
@@ -688,6 +690,8 @@ class ChaosScheduler:  # lint: ok shared-state
                 resolved = step.action.resolve(self.ctx, rng)
                 entry["resolved"] = resolved
                 step.action.apply(self.ctx, resolved)
+                if _metrics.enabled:
+                    _metrics.counter("chaos.faults_fired").inc()
             except Exception as e:          # record, don't kill the storm
                 entry["error"] = repr(e)
             self.timeline.append(entry)

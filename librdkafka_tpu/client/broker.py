@@ -1650,7 +1650,7 @@ class Broker:
                              "err": (err.code.name if err is not None
                                      else None)})
         try:
-            self._handle_produce0(tp, msgs, err, resp)
+            self._handle_produce0(tp, msgs, err, resp, t_tx_ns)
         finally:
             tp.release_inflight(msgs)
 
@@ -1670,7 +1670,8 @@ class Broker:
         rk.set_fatal_error(fatal)
         return fatal
 
-    def _handle_produce0(self, tp, msgs: list[Message], err, resp):
+    def _handle_produce0(self, tp, msgs: list[Message], err, resp,
+                         t_tx_ns: int = 0):
         rk = self.rk
         ut = rk.conf.get("ut_handle_ProduceResponse")
         if ut is not None:
@@ -1685,6 +1686,22 @@ class Broker:
             ec = Err.from_wire(pres["error_code"])
             if ec == Err.NO_ERROR:
                 base = pres["base_offset"]
+                if _trace.enabled and _trace.flow_sample_every and base >= 0:
+                    # cross-process flow points (ISSUE 20): offsets are
+                    # only known HERE, at ack time — emit the sampled
+                    # produce point back-dated to the request tx stamp
+                    # and the ack point at now; obs/collect.py stitches
+                    # them to the consumer's fetch/deliver points by
+                    # (topic, partition, offset)
+                    n = msgs.count if fast else len(msgs)
+                    step = _trace.flow_sample_every
+                    for off in range(base + (-base) % step, base + n,
+                                     step):
+                        a = {"topic": tp.topic, "partition": tp.partition,
+                             "offset": off}
+                        _trace.evt("flow", "flow_produce", "i",
+                                   t_tx_ns or None, 0, a)
+                        _trace.instant("flow", "flow_ack", a)
                 if not fast and (rk.interceptors or rk.conf.get("dr_msg_cb")
                                  or rk.conf.get("dr_cb")
                                  or any(m.on_delivery is not None

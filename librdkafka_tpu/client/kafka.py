@@ -1809,6 +1809,17 @@ class Kafka:  # lint: ok shared-state
                 # walk (no intermediate Record; ~1.5 us/msg on this path)
                 ms, mbytes = parse_fetch_messages_v2(
                     info, payload, tp.topic, tp.partition, fo)
+                if _trace.enabled and _trace.flow_sample_every and ms:
+                    # flow point 3/4 (ISSUE 20): sampled offsets now
+                    # back on the wire consumer-side
+                    step = _trace.flow_sample_every
+                    lo = ms[0].offset
+                    for off in range(lo + (-lo) % step,
+                                     ms[-1].offset + 1, step):
+                        _trace.instant("flow", "flow_fetch",
+                                       {"topic": tp.topic,
+                                        "partition": tp.partition,
+                                        "offset": off})
                 msgs.extend(ms)
                 msgs_bytes += mbytes
                 next_offset = last + 1
@@ -1842,6 +1853,16 @@ class Kafka:  # lint: ok shared-state
             tp.fetchq_cnt += len(msgs)
             tp.fetchq_bytes += msgs_bytes
         if msgs:
+            if _trace.enabled and _trace.flow_sample_every:
+                # flow point 4/4: handed to the app-facing fetch queue
+                step = _trace.flow_sample_every
+                lo = msgs[0].offset
+                for off in range(lo + (-lo) % step,
+                                 msgs[-1].offset + 1, step):
+                    _trace.instant("flow", "flow_deliver",
+                                   {"topic": tp.topic,
+                                    "partition": tp.partition,
+                                    "offset": off})
             # ONE op per parsed partition response (per-message op
             # push/pop dominated the consume profile)
             tp.fetchq.push(Op(OpType.FETCH,

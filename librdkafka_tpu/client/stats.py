@@ -14,6 +14,7 @@ from typing import TYPE_CHECKING
 from ..utils.hdrhistogram import HdrHistogram
 from ..analysis.locks import new_lock
 from ..analysis.races import register_slots, shared
+from ..obs import metrics as _metrics
 
 if TYPE_CHECKING:
     from .kafka import Kafka
@@ -204,6 +205,11 @@ class StatsCollector:
             "codec_latency": self.codec_latency.rollover(),
             "brokers": brokers,
             "topics": topics,
+            # unified metrics registry (ISSUE 20): every process-wide
+            # counter/gauge/window any subsystem registered — always
+            # present (a disabled registry snapshots as empty maps) so
+            # stats consumers never branch on its existence
+            "obs": _metrics.snapshot(),
         }
         if rk.type == "producer":
             # fast-lane engagement: cumulative native-lane appends plus

@@ -22,8 +22,22 @@ Line protocol (one JSON object per line):
            "p50_ms", "p99_ms", "max_ms"}                  periodic
           {"type": "done",  "name", "summary": {...}}     final
           {"type": "error", "name", "error": repr}
+          {"type": "clock", "token", "mono_ns"}           clock reply
+          {"type": "flight", "path"}              flight-recorder dump
+          {"type": "trace", "name", "pid", "mono_ns",
+           "events": [...]}                     ring dump at exit
   stdin   {"cmd": "start", "bootstrap": "...", "spec": {...}}
+          {"cmd": "clock", "token": ...}
           {"cmd": "stop"}
+
+Observability (ISSUE 20): when ``spec["trace"]`` is set the worker
+enables its own obs/trace.py rings (flight dumps land in
+``spec["flight_dir"]``), answers the driver's ``clock`` offset
+exchange with ``time.monotonic_ns()``, streams flight-dump paths the
+moment they appear (so a worker that dies mid-storm already shipped
+its evidence), and ships its whole ring dump inline as the final
+``trace`` line before exiting — the driver merges every process's
+dump into one timeline (obs/collect.py).
 
 ``ts`` stamps are ``time.monotonic()`` — on Linux CLOCK_MONOTONIC is
 machine-wide, so the driver can correlate them with the chaos
@@ -45,10 +59,38 @@ FLUSH_EVERY_S = 0.25        # ledger/stats streaming cadence
 POLL_EVERY_S = 0.4          # group-liveness heartbeat cadence
 ROW_CAP = 400               # max ledger rows per stdout line
 
+_TR = None                  # obs.trace module when spec["trace"] is set
+_last_flight = None
+
 
 def _emit(obj) -> None:
     sys.stdout.write(json.dumps(obj, separators=(",", ":")) + "\n")
     sys.stdout.flush()
+
+
+def _poll_ctl(cmd) -> bool:
+    """Dispatch one driver command; True means stop.  The clock reply
+    is stamped HERE, as close to the read as possible, so the driver's
+    half-round-trip error bound stays honest."""
+    if not cmd:
+        return False
+    c = cmd.get("cmd")
+    if c == "stop":
+        return True
+    if c == "clock":
+        _emit({"type": "clock", "token": cmd.get("token"),
+               "mono_ns": time.monotonic_ns()})
+    return False
+
+
+def _flight_watch() -> None:
+    """Stream any new flight-recorder dump path immediately — the
+    driver must hold the evidence BEFORE a chaos verdict (or a worker
+    death) needs it."""
+    global _last_flight
+    if _TR is not None and _TR.last_flight_path != _last_flight:
+        _last_flight = _TR.last_flight_path
+        _emit({"type": "flight", "path": _last_flight})
 
 
 class _Stdin:
@@ -139,7 +181,7 @@ def _run_producer(spec: dict, bootstrap: str, ctl: _Stdin) -> dict:
             if now >= deadline:
                 break
             cmd = ctl.next_cmd(0.0)
-            if ctl.eof or (cmd and cmd.get("cmd") == "stop"):
+            if ctl.eof or _poll_ctl(cmd):
                 stopping = True
             if stopping:
                 break
@@ -169,6 +211,7 @@ def _run_producer(spec: dict, bootstrap: str, ctl: _Stdin) -> dict:
                     del failed[:ROW_CAP]
                 _emit({"type": "stats", "name": name, "produced": produced,
                        "acked": acked, **_lat_summary(hist)})
+                _flight_watch()
             if n == 0:
                 time.sleep(0.002)
     finally:
@@ -238,7 +281,7 @@ def _run_consumer(spec: dict, bootstrap: str, ctl: _Stdin) -> dict:
             if now >= deadline:
                 break
             cmd = ctl.next_cmd(0.0)
-            if ctl.eof or (cmd and cmd.get("cmd") == "stop"):
+            if ctl.eof or _poll_ctl(cmd):
                 break
             m = c.poll(0.1)
             if now >= next_poll_beat:
@@ -256,6 +299,7 @@ def _run_consumer(spec: dict, bootstrap: str, ctl: _Stdin) -> dict:
                     del rows[:ROW_CAP]
                 _emit({"type": "stats", "name": name,
                        "consumed": consumed})
+                _flight_watch()
     finally:
         c.close()
         while rows:
@@ -289,6 +333,14 @@ def main() -> int:
 
     spec = start["spec"]
     name = spec.get("name", "w?")
+    global _TR
+    if spec.get("trace"):
+        # the worker holds its OWN tracer reference (not via client
+        # conf) so the rings survive client close() and can be shipped
+        # inline as the final protocol line
+        from librdkafka_tpu.obs import trace as _obs_trace
+        _TR = _obs_trace
+        _TR.enable(dump_dir=spec.get("flight_dir"))
     try:
         if spec["role"] == "producer":
             summary = _run_producer(spec, start["bootstrap"], ctl)
@@ -299,6 +351,13 @@ def main() -> int:
     except Exception as e:
         _emit({"type": "error", "name": name, "error": repr(e)})
         return 1
+    finally:
+        if _TR is not None:
+            _flight_watch()
+            events = _TR.collect_events()
+            _TR.disable()
+            _emit({"type": "trace", "name": name, "pid": os.getpid(),
+                   "mono_ns": time.monotonic_ns(), "events": events})
 
 
 if __name__ == "__main__":

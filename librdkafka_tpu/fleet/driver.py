@@ -22,6 +22,13 @@ Worker pids are registered in ``mock.external``'s subprocess registry
 (as ``fleet-worker-<name>``) the moment they spawn, so the conftest
 leak fixture fails any test that loses a worker exactly like a lost
 broker relay — and ``reap_leaked()`` covers both.
+
+Observability (ISSUE 20, ``trace=True``): the driver enables its own
+trace rings, tells every worker to do the same (flight dumps land in
+a registered temp dir), runs the clock offset exchange per worker
+(``clock_sync``), ingests streamed flight-dump paths and the final
+inline ring dumps, and hands ``collect_traces()`` the per-process
+dumps that obs/collect.py merges into ONE Perfetto timeline.
 """
 from __future__ import annotations
 
@@ -38,6 +45,9 @@ from ..analysis.locks import new_lock
 from ..analysis.races import shared_dict, shared_list
 from ..chaos.oracle import DeliveryOracle
 from ..mock import external
+from ..obs import collect as _collect
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from .traffic import TrafficPlan
 
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -47,7 +57,7 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 class _Worker:
     """One spawned client process + its stream bookkeeping."""
 
-    __slots__ = ("spec", "proc", "pid", "reader", "done_evt")
+    __slots__ = ("spec", "proc", "pid", "reader", "done_evt", "clock")
 
     def __init__(self, spec: dict, proc: subprocess.Popen):
         self.spec = spec
@@ -55,6 +65,9 @@ class _Worker:
         self.pid = proc.pid
         self.reader: Optional[threading.Thread] = None
         self.done_evt = threading.Event()
+        #: (offset_ns, err_ns) from clock_sync — worker clock into the
+        #: driver's timebase; None until the exchange completes
+        self.clock: Optional[tuple] = None
 
     @property
     def name(self) -> str:
@@ -77,10 +90,17 @@ class FleetDriver:  # lint: ok shared-state
     done: dict
     #: worker/protocol errors observed on any stream
     errors: list
+    #: clock token -> (worker mono_ns, driver recv mono_ns)
+    clock_samples: dict
+    #: worker name -> final inline ring-dump payload
+    traces: dict
+    #: streamed flight-recorder dump records ({worker, path})
+    flight_paths: list
 
     def __init__(self, bootstrap: str, plan: TrafficPlan, *,
                  launch_timeout: float = 30.0,
-                 dump_dir: Optional[str] = None):
+                 dump_dir: Optional[str] = None,
+                 trace: bool = False):
         self.bootstrap = bootstrap
         self.plan = plan
         self.launch_timeout = launch_timeout
@@ -88,6 +108,17 @@ class FleetDriver:  # lint: ok shared-state
         self.stats = shared_dict("fleet.stats")
         self.done = shared_dict("fleet.done")
         self.errors = shared_list("fleet.errors")
+        self.clock_samples = shared_dict("fleet.clock")
+        self.traces = shared_dict("fleet.traces")
+        self.flight_paths = shared_list("fleet.flight")
+        self.trace = trace
+        self.trace_dir: Optional[str] = None
+        if trace:
+            # driver-side rings + a registered flight-dump dir shared
+            # with every worker (released in stop(); conftest fails
+            # tests that leak it)
+            self.trace_dir = _collect.make_dump_dir("tk_fleet_")
+            _trace.enable(dump_dir=self.trace_dir)
         # one oracle per consumer group: every group must deliver the
         # whole acked set (record_acks fans out), its own members feed
         # only its own group ledger
@@ -101,6 +132,7 @@ class FleetDriver:  # lint: ok shared-state
     def start(self) -> "FleetDriver":
         assert not self._started, "fleet already started"
         self._started = True
+        t0 = _trace.now() if _trace.enabled else 0
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         pkg_parent = os.path.dirname(os.path.dirname(
@@ -128,12 +160,19 @@ class FleetDriver:  # lint: ok shared-state
             self.stop()
             raise
         for w in self.workers:
+            spec = (dict(w.spec, trace=True, flight_dir=self.trace_dir)
+                    if self.trace else w.spec)
             self._send(w, {"cmd": "start", "bootstrap": self.bootstrap,
-                           "spec": w.spec})
+                           "spec": spec})
             w.reader = threading.Thread(
                 target=self._read_stream, args=(w,),
                 name=f"fleet-rd-{w.name}", daemon=True)
             w.reader.start()
+        if t0:
+            _trace.complete("fleet", "fleet_start", t0,
+                            {"workers": len(self.workers)})
+        if _metrics.enabled:
+            _metrics.gauge("fleet.workers").set(len(self.workers))
         return self
 
     def _read_handshake(self, w: _Worker, deadline: float) -> dict:
@@ -173,6 +212,8 @@ class FleetDriver:  # lint: ok shared-state
             if t == "acks":
                 rows = [(r[0], r[1], r[2], r[3], r[4], None, r[5])
                         for r in msg["rows"]]
+                if _metrics.enabled:
+                    _metrics.counter("fleet.ack_rows").inc(len(rows))
                 for o in self.oracles:
                     o.record_acks(rows)
             elif t == "consumed":
@@ -201,6 +242,23 @@ class FleetDriver:  # lint: ok shared-state
             elif t == "stats":
                 with self._lock:
                     self.stats[msg["name"]] = msg
+            elif t == "clock":
+                # stamp the receive side of the offset exchange HERE,
+                # in the reader, so queueing in clock_sync's poll loop
+                # never widens the error bound
+                with self._lock:
+                    self.clock_samples[msg.get("token")] = (
+                        msg["mono_ns"], time.monotonic_ns())
+            elif t == "flight":
+                if _trace.enabled:
+                    _trace.instant("fleet", "flight_collected",
+                                   {"worker": w.name})
+                with self._lock:
+                    self.flight_paths.append({"worker": w.name,
+                                              "path": msg.get("path")})
+            elif t == "trace":
+                with self._lock:
+                    self.traces[w.name] = msg
             elif t == "done":
                 with self._lock:
                     self.done[msg["name"]] = msg["summary"]
@@ -214,6 +272,101 @@ class FleetDriver:  # lint: ok shared-state
     def _group_oracle(self, w: _Worker) -> DeliveryOracle:
         gi = w.spec.get("group_idx", 0)
         return self.oracles[gi if gi < len(self.oracles) else 0]
+
+    # -------------------------------------------------- observability --
+    def clock_sync(self, rounds: int = 3, timeout: float = 30.0) -> dict:
+        """Per-worker clock offset exchange (the obs/collect.py model):
+        ping each worker ``rounds`` times, keep the minimum-error
+        round.  The first reply can lag seconds behind the worker's
+        heavy package import, so the deadline covers the whole sync —
+        run this during the traffic window, it costs the fleet
+        nothing."""
+        out: dict = {}
+        deadline = time.monotonic() + timeout
+        for w in self.workers:
+            best = None
+            for i in range(rounds):
+                token = f"ck-{w.name}-{i}"
+                t_send = time.monotonic_ns()
+                self._send(w, {"cmd": "clock", "token": token})
+                sample = None
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        sample = self.clock_samples.get(token)
+                    if sample is not None or w.proc.poll() is not None:
+                        break
+                    time.sleep(0.005)
+                if sample is None:
+                    break
+                off, err = _collect.align_offset(t_send, sample[0],
+                                                 sample[1])
+                if best is None or err < best[1]:
+                    best = (off, err)
+            w.clock = best
+            if best is not None:
+                out[w.name] = {"offset_ns": best[0], "err_ns": best[1]}
+        return out
+
+    def collect_traces(self, timeout: float = 30.0) -> list:
+        """The per-process dumps for obs/collect.merge: the driver's
+        own rings plus every worker's inline ring dump (workers ship
+        theirs as the final protocol line before exiting — wait for
+        stragglers, but never for a dead worker whose pipe drained)."""
+        assert self.trace, "driver not constructed with trace=True"
+        deadline = time.monotonic() + timeout
+        names = {w.name for w in self.workers}
+        while time.monotonic() < deadline:
+            with self._lock:
+                missing = names - set(self.traces)
+            if not missing:
+                break
+            if all(w.proc.poll() is not None
+                   and (w.reader is None or not w.reader.is_alive())
+                   for w in self.workers if w.name in missing):
+                break
+            time.sleep(0.05)
+        dumps = [_collect.ProcessDump("fleet-driver", os.getpid(),
+                                      _trace.collect_events())]
+        with self._lock:
+            traces = dict(self.traces)
+        for w in self.workers:
+            payload = traces.get(w.name)
+            if payload is None:
+                continue
+            off, err = w.clock if w.clock is not None else (0, 0)
+            dumps.append(_collect.ProcessDump(
+                f"worker-{w.name}", payload.get("pid", w.pid),
+                payload.get("events", []), off, err))
+        return dumps
+
+    def flight_dumps(self, inline: bool = True) -> list:
+        """Every flight-recorder dump the fleet produced: streamed
+        paths first, then a sweep of the shared flight dir (a worker
+        killed between writing the dump and streaming its path still
+        left the file).  ``inline`` attaches the parsed payload — a
+        chaos verdict must ship its evidence, not a path into a temp
+        dir that stop() deletes."""
+        with self._lock:
+            records = [dict(r) for r in self.flight_paths]
+        seen = {r["path"] for r in records}
+        if self.trace_dir and os.path.isdir(self.trace_dir):
+            for fn in sorted(os.listdir(self.trace_dir)):
+                p = os.path.join(self.trace_dir, fn)
+                if fn.startswith("tk_flight_") and p not in seen:
+                    records.append({"worker": None, "path": p})
+        for r in records:
+            r["exists"] = bool(r["path"]) and os.path.isfile(r["path"])
+            if inline and r["exists"]:
+                try:
+                    with open(r["path"]) as f:
+                        payload = json.load(f)
+                    r["events"] = sum(
+                        1 for e in payload.get("traceEvents", [])
+                        if e.get("ph") != "M")
+                    r["payload"] = payload
+                except (OSError, ValueError):
+                    r["payload"] = None
+        return records
 
     # ----------------------------------------------------------- stop --
     def stop_role(self, role: str, timeout: float = 60.0) -> None:
@@ -263,6 +416,14 @@ class FleetDriver:  # lint: ok shared-state
                 except OSError:
                     pass
         external.deregister_pids([w.pid for w in self.workers])
+        if self.trace:
+            # release the driver's tracer reference and the shared
+            # flight-dump dir exactly once (conftest fails leaks of
+            # either); callers collect traces/dumps BEFORE stop()
+            self.trace = False
+            _trace.disable()
+            if self.trace_dir is not None:
+                _collect.release_dump_dir(self.trace_dir)
 
     def __enter__(self) -> "FleetDriver":
         return self

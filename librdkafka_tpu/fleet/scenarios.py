@@ -30,6 +30,7 @@ from ..chaos.schedule import (ChaosScheduler, Schedule, env_brownout,
                               proc_kill9, proc_restart)
 from ..chaos.scenarios import storm_metrics
 from ..mock.external import ClusterHandle
+from ..obs import collect as _collect
 from .driver import FleetDriver
 from .traffic import TrafficPlan, bursts, diurnal, flat, stack, zipf
 
@@ -50,14 +51,20 @@ class FleetRun:
                  strategy: str = "range,roundrobin",
                  min_alive: int = 1, duration_s: float = 3.0,
                  drain_s: float = 30.0, converge_s: float = 25.0,
-                 worker_max_s: float = 120.0):
+                 worker_max_s: float = 120.0,
+                 trace_path: Optional[str] = None):
         self.seed = seed
         self.topic = topic
         self.duration_s = duration_s
         self.drain_s = drain_s
         self.converge_s = converge_s
+        self.trace_path = trace_path
         self.handle = ClusterHandle(brokers=brokers,
                                     topics={topic: partitions})
+        if trace_path:
+            # rig-side rings on from the start: supervisor ctl spans
+            # and relay connection spans belong in the merged timeline
+            self.handle.trace_enable()
         self.plan = TrafficPlan(
             seed, producers=producers, groups=groups,
             group_size=group_size, topics=[topic], partitions=partitions,
@@ -66,7 +73,7 @@ class FleetRun:
             strategy=strategy,
             max_s=worker_max_s)
         self.driver = FleetDriver(self.handle.bootstrap_servers(),
-                                  self.plan)
+                                  self.plan, trace=bool(trace_path))
         self.chaos = ChaosScheduler(self.handle, min_alive=min_alive)
 
     def run(self, schedule: Optional[Schedule] = None, *,
@@ -78,6 +85,10 @@ class FleetRun:
             self.driver.start()
             if schedule is not None and schedule.steps:
                 self.chaos.start(schedule)
+            if self.trace_path:
+                # overlaps the traffic window: replies come from the
+                # workers' own run loops, costing the fleet nothing
+                self.driver.clock_sync()
             time.sleep(self.duration_s)
             if schedule is not None and schedule.steps:
                 self.chaos.join(timeout=schedule.duration + 30)
@@ -135,6 +146,28 @@ class FleetRun:
                 1 for e in self.chaos.timeline
                 if e["action"] == "proc_kill9"
                 and (e.get("resolved") or {}).get("broker"))
+            if self.trace_path:
+                # every worker shipped its ring dump on exit; the rig
+                # contributes supervisor + relay dumps over the control
+                # socket — ONE Perfetto file, flow links stitched
+                dumps = self.driver.collect_traces()
+                dumps.extend(self.handle.collect_traces())
+                events = _collect.merge(dumps)
+                events, links = _collect.stitch_flows(events)
+                _collect.write(self.trace_path, events)
+                report["trace"] = {
+                    "path": self.trace_path,
+                    "processes": len(dumps),
+                    "pids": sorted({d.pid for d in dumps}),
+                    "flow_links": links,
+                }
+                # the chaos-evidence satellite: flight dumps ride the
+                # verdict (inline — their temp dir dies with stop())
+                report["flight_dumps"] = self.driver.flight_dumps()
+                if violation is not None:
+                    violation.report["flight_dumps"] = \
+                        report["flight_dumps"]
+                    violation.report["trace"] = report["trace"]
             if violation is not None:
                 raise violation
             return report
@@ -145,20 +178,21 @@ class FleetRun:
 
 
 # ------------------------------------------------------------ library --
-def fleet_mini(seed: int = 47, *,
-               raise_on_violation: bool = True) -> dict:
+def fleet_mini(seed: int = 47, *, raise_on_violation: bool = True,
+               trace_path: Optional[str] = None) -> dict:
     """Smallest real fleet (bench --fleet --smoke): 1 producer + 1
     single-member group — two client OS processes — no faults, merged
     oracle clean.  Proves the spawn/stream/merge machinery in seconds."""
     run = FleetRun(seed=seed, brokers=1, partitions=2,
                    producers=1, groups=1, group_size=1,
                    shape=flat(150.0), duration_s=1.5,
-                   drain_s=15.0, converge_s=15.0)
+                   drain_s=15.0, converge_s=15.0,
+                   trace_path=trace_path)
     return run.run(None, raise_on_violation=raise_on_violation)
 
 
-def fleet_smoke(seed: int = 51, *,
-                raise_on_violation: bool = True) -> dict:
+def fleet_smoke(seed: int = 51, *, raise_on_violation: bool = True,
+                trace_path: Optional[str] = None) -> dict:
     """Tier-1 fleet smoke (<15 s): 4 worker processes (2 producers
     with burst + hot-partition + Zipf-key traffic, one 2-member
     group) sustaining one pid-verified SIGKILL/respawn; per-group
@@ -168,7 +202,8 @@ def fleet_smoke(seed: int = 51, *,
                    shape=stack(flat(60.0), bursts(0.0, 90.0, 1.2, 0.33)),
                    keys=zipf(50, 1.1), hot_partition_weight=0.5,
                    min_alive=1, duration_s=2.5,
-                   drain_s=25.0, converge_s=20.0)
+                   drain_s=25.0, converge_s=20.0,
+                   trace_path=trace_path)
     sched = (Schedule(seed=seed)
              .at(0.9, proc_kill9("any"))
              .at(1.7, proc_restart()))

@@ -30,6 +30,18 @@ matching sockem: **tx** = client->broker, **rx** = broker->client —
 so ``rx_drop`` is the classic half-open partition where the broker
 hears requests but its responses vanish.
 
+**Observability** (ISSUE 20) rides the same stdin channel::
+
+    {"trace": 1|0}      enable/disable this relay's trace rings
+    {"clock": 1}        ack carries mono_ns (clock offset exchange)
+    {"trace_dump": 1}   ack carries pid + the whole ring dump inline
+
+The tracer (obs/trace.py, itself pure stdlib) is loaded BY PATH on
+first enable, so the relay never imports the package and its cold
+startup stays milliseconds.  Instrumentation is per-connection, not
+per-chunk: a ``conn_setup`` span around accept+upstream-connect and a
+``conn`` span over each connection's lifetime.
+
 Handshake: one JSON line on stdout — ``{"broker", "port", "pid"}``.
 Exits when stdin reaches EOF (supervisor died or closed the pipe), so
 an orphaned relay can never linger eating the host.
@@ -51,13 +63,31 @@ BUF_MAX = 1 << 20
 KNOBS = {"rx_drop": False, "tx_drop": False,
          "rx_delay_ms": 0.0, "tx_delay_ms": 0.0}
 
+#: obs/trace.py module once {"trace": 1} loaded it by path (the relay
+#: must never import the package — see the module docstring)
+TRACE = None
+
+
+def _load_trace():
+    global TRACE
+    if TRACE is None:
+        import importlib.util
+        path = os.path.abspath(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            os.pardir, "obs", "trace.py"))
+        spec = importlib.util.spec_from_file_location("_relay_trace", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        TRACE = mod
+    return TRACE
+
 
 class _Half:
     """One direction's state: bytes waiting to be written to ``sock``
     plus any delayed chunks still being 'held in flight'."""
 
     __slots__ = ("sock", "peer", "buf", "reading", "dir_read", "holdq",
-                 "held")
+                 "held", "t0")
 
     def __init__(self, sock, dir_read):
         self.sock = sock
@@ -70,6 +100,7 @@ class _Half:
         #: delayed chunks headed FOR this sock: [(release_t, bytes)]
         self.holdq = []
         self.held = 0               # total bytes in holdq
+        self.t0 = 0                 # trace stamp at accept (conn span)
 
 
 def _events(h: _Half) -> int:
@@ -113,6 +144,12 @@ def main(argv=None) -> int:
     halves: dict[socket.socket, _Half] = {}
 
     def close_pair(h: _Half):
+        if TRACE is not None and TRACE.enabled:
+            for side in (h, h.peer):
+                if side is not None and side.t0 and side.sock in halves:
+                    TRACE.complete("relay", "conn", side.t0,
+                                   {"broker": args.broker_id})
+                    side.t0 = 0
         for side in (h, h.peer):
             if side is None or side.sock not in halves:
                 continue
@@ -157,6 +194,28 @@ def main(argv=None) -> int:
             print(json.dumps({"ok": False, "error": "bad json"}),
                   flush=True)
             return
+        if "trace" in cmd:
+            tr = _load_trace()
+            if cmd["trace"]:
+                tr.enable()
+            else:
+                tr.disable()
+            print(json.dumps({"ok": True, "trace": bool(cmd["trace"])}),
+                  flush=True)
+            return
+        if cmd.get("clock"):
+            print(json.dumps({"ok": True,
+                              "mono_ns": time.monotonic_ns()}),
+                  flush=True)
+            return
+        if cmd.get("trace_dump"):
+            evs = (TRACE.collect_events()
+                   if TRACE is not None and TRACE.enabled else [])
+            print(json.dumps({"ok": True, "pid": os.getpid(),
+                              "mono_ns": time.monotonic_ns(),
+                              "events": evs},
+                             separators=(",", ":")), flush=True)
+            return
         knobs = cmd.get("set") or {}
         for k, v in knobs.items():
             if k in ("rx_drop", "tx_drop"):
@@ -196,6 +255,8 @@ def main(argv=None) -> int:
                         handle_cmd(raw)
                 continue
             if key.data == "accept":
+                t_acc = (TRACE.now() if TRACE is not None
+                         and TRACE.enabled else 0)
                 try:
                     cs, _ = ls.accept()
                 except OSError:
@@ -218,6 +279,12 @@ def main(argv=None) -> int:
                 halves[us] = uh
                 sel.register(cs, _events(ch), "conn")
                 sel.register(us, _events(uh), "conn")
+                if t_acc:
+                    # span over accept + upstream connect; the conn
+                    # span itself closes with the pair
+                    ch.t0 = t_acc
+                    TRACE.complete("relay", "conn_setup", t_acc,
+                                   {"broker": args.broker_id})
                 continue
 
             h = halves.get(key.fileobj)

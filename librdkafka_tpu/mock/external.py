@@ -331,6 +331,34 @@ class ClusterHandle:  # lint: ok shared-state
     def clear_brownout(self, broker_id: int) -> dict:
         return self.brownout(broker_id)
 
+    # --------------------------------------------------- observability --
+    def trace_enable(self) -> dict:
+        """Rig-wide tracing on: supervisor rings + every relay's
+        (ISSUE 20; relays respawned by ``restart`` rejoin)."""
+        return self._ctl_cmd("trace 1")
+
+    def trace_disable(self) -> dict:
+        return self._ctl_cmd("trace 0")
+
+    def collect_traces(self) -> list:
+        """The rig's per-process dumps for ``obs/collect.merge``:
+        supervisor + every alive relay, every clock mapped into THIS
+        process's timebase (handle->supervisor offset from the
+        ``clock`` verb round trip, supervisor->relay offsets measured
+        supervisor-side and composed here)."""
+        from ..obs import collect as _collect
+        t_send = time.monotonic_ns()
+        ck = self._ctl_cmd("clock")
+        t_recv = time.monotonic_ns()
+        sup_off, sup_err = _collect.align_offset(
+            t_send, ck["mono_ns"], t_recv)
+        resp = self._ctl_cmd("trace_dump")
+        return [_collect.ProcessDump(
+                    p["name"], p.get("pid") or 0, p.get("events", []),
+                    sup_off + p.get("offset_ns", 0),
+                    sup_err + p.get("err_ns", 0))
+                for p in resp.get("procs", [])]
+
     # -------------------------------------------------------- teardown --
     def pids(self) -> dict[str, int]:
         with self._lock:

@@ -51,6 +51,16 @@ cannot express; each maps to a chaos ``env_*`` verb):
     rlimit <id> <bytes>  memory pressure: soft RLIMIT_AS on the
                          broker's relay process via prlimit
                          (0 restores infinity)
+
+Observability verbs (ISSUE 20, OBSERVABILITY.md):
+
+    trace <0|1>      rig-wide tracing: the supervisor's obs/trace.py
+                     rings plus every relay's (relay stdin command)
+    clock            reply carries mono_ns — the collector's offset
+                     exchange (obs/collect.align_offset)
+    trace_dump       the rig's whole merged-timeline contribution:
+                     supervisor + per-relay ring dumps inline, relays
+                     clock-aligned to the supervisor
     brownout <id> <json> asymmetric partition: forward one-direction
                          rx/tx drop + latency knobs to the relay's
                          stdin (see mock/_relay.py); all-zero heals
@@ -73,6 +83,8 @@ import threading
 import time
 
 from ..analysis.locks import new_cond
+from ..obs import collect as _obs_collect
+from ..obs import trace as _trace
 from .cluster import MockCluster
 
 _RELAY = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -107,6 +119,9 @@ class Supervisor:  # lint: ok shared-state
         self.paused: set[int] = set()
         #: leftover relay-stdout bytes per broker (brownout acks)
         self._rbufs: dict[int, bytearray] = {}
+        #: rig-side tracing (ISSUE 20): ``trace 1`` enables the
+        #: supervisor's own rings AND every relay's (stdin command)
+        self._tracing = False
         self.shutdown = threading.Event()
 
         for b in range(1, num_brokers + 1):
@@ -148,6 +163,10 @@ class Supervisor:  # lint: ok shared-state
         threading.Thread(target=self._reap, args=(b, proc),
                          name=f"standalone-reap-{b}-{hs['pid']}",
                          daemon=True).start()
+        if self._tracing:
+            # a relay respawned mid-trace (restart verb) joins the
+            # rig-wide trace session like its predecessor
+            self._relay_cmd(b, {"trace": 1})
         return hs
 
     def _reap(self, b: int, proc: subprocess.Popen) -> None:
@@ -168,6 +187,9 @@ class Supervisor:  # lint: ok shared-state
 
     def close(self) -> None:
         self.shutdown.set()
+        if self._tracing:
+            self._tracing = False
+            _trace.disable()
         with self._cond:
             procs = dict(self.procs)
         for proc in procs.values():
@@ -304,6 +326,66 @@ class Supervisor:  # lint: ok shared-state
             return {"error": f"relay did not ack brownout: {ack}"}
         return {"ok": True, "broker": b, "knobs": ack.get("knobs")}
 
+    def _relay_cmd(self, b: int, obj: dict, timeout: float = 5.0):
+        """One JSON command to broker ``b``'s relay stdin, one ack line
+        back (None when the relay is down/paused or never acks)."""
+        with self._cond:
+            proc = self.procs.get(b)
+            if proc is None or b in self.down or b in self.paused:
+                return None
+        line = json.dumps(obj, separators=(",", ":")).encode() + b"\n"
+        try:
+            proc.stdin.write(line)
+            proc.stdin.flush()
+        except (OSError, ValueError):
+            return None
+        return self._read_relay_line(b, proc, timeout=timeout)
+
+    def _cmd_trace(self, on: int) -> dict:
+        """Rig-wide trace switch: the supervisor's rings plus a
+        ``{"trace": n}`` command to every alive relay."""
+        if on and not self._tracing:
+            self._tracing = True
+            _trace.enable()
+        elif not on and self._tracing:
+            self._tracing = False
+            _trace.disable()
+        with self._cond:
+            alive = sorted(b for b in self.procs if b not in self.down)
+        acks = {}
+        for b in alive:
+            ack = self._relay_cmd(b, {"trace": int(bool(on))})
+            acks[str(b)] = bool(ack and ack.get("ok"))
+        return {"ok": True, "trace": bool(on), "relays": acks}
+
+    def _cmd_trace_dump(self) -> dict:
+        """The rig's whole contribution to a merged timeline: the
+        supervisor's ring dump plus every alive relay's, each relay
+        clock-aligned to the SUPERVISOR via a stdin round trip (the
+        collecting client aligns the supervisor to itself with the
+        ``clock`` verb and adds the offsets)."""
+        procs = [{"name": "supervisor", "pid": os.getpid(),
+                  "offset_ns": 0, "err_ns": 0,
+                  "events": (_trace.collect_events()
+                             if self._tracing else [])}]
+        with self._cond:
+            alive = sorted(b for b in self.procs if b not in self.down)
+        for b in alive:
+            t_send = time.monotonic_ns()
+            ck = self._relay_cmd(b, {"clock": 1})
+            t_recv = time.monotonic_ns()
+            dump = self._relay_cmd(b, {"trace_dump": 1}, timeout=10.0)
+            if not dump or not dump.get("ok"):
+                continue
+            off = err = 0
+            if ck and ck.get("ok"):
+                off, err = _obs_collect.align_offset(
+                    t_send, ck["mono_ns"], t_recv)
+            procs.append({"name": f"relay-{b}", "pid": dump.get("pid"),
+                          "offset_ns": off, "err_ns": err,
+                          "events": dump.get("events", [])})
+        return {"ok": True, "procs": procs}
+
     def _read_relay_line(self, b: int, proc, timeout: float):
         """One JSON line from the relay's stdout (raw fd + per-broker
         leftover buffer; the buffered handshake readline left nothing
@@ -400,6 +482,12 @@ class Supervisor:  # lint: ok shared-state
             if cmd == "brownout":
                 return self._cmd_brownout(
                     int(args[0]), json.loads(" ".join(args[1:])))
+            if cmd == "trace":
+                return self._cmd_trace(int(args[0]))
+            if cmd == "clock":
+                return {"ok": True, "mono_ns": time.monotonic_ns()}
+            if cmd == "trace_dump":
+                return self._cmd_trace_dump()
             if cmd == "shutdown":
                 self.shutdown.set()
                 return {"ok": True, "bye": True}
@@ -442,8 +530,13 @@ class Supervisor:  # lint: ok shared-state
                 while b"\n" in bufs[s]:
                     raw, _, rest = bytes(bufs[s]).partition(b"\n")
                     bufs[s] = bytearray(rest)
-                    resp = self._dispatch(raw.decode(errors="replace")
-                                          .strip())
+                    line_s = raw.decode(errors="replace").strip()
+                    t0 = _trace.now() if _trace.enabled else 0
+                    resp = self._dispatch(line_s)
+                    if t0:
+                        _trace.complete(
+                            "rig", "ctl_cmd", t0,
+                            {"cmd": line_s.split()[0] if line_s else ""})
                     try:
                         s.sendall(json.dumps(resp).encode() + b"\n")
                     except OSError:

@@ -1,7 +1,15 @@
-"""librdkafka_tpu.obs — observability: event tracing (trace.py).
+"""librdkafka_tpu.obs — observability: tracing, metrics, collection.
 
-The statistics half of observability lives in client/stats.py (the
-rd_avg_t windowed-histogram JSON of STATISTICS.md); this package holds
-the EVENT half — the flight-recorder trace rings and the Chrome
-trace-event exporter (TRACING.md).
+The per-client statistics half of observability lives in
+client/stats.py (the rd_avg_t windowed-histogram JSON of
+STATISTICS.md); this package holds the rest of the plane:
+
+  * trace.py   — flight-recorder trace rings + Chrome trace-event
+                 export (TRACING.md)
+  * metrics.py — the process-wide metrics registry (counters / gauges
+                 / HdrHistogram windows) every subsystem registers
+                 into (OBSERVABILITY.md)
+  * collect.py — cross-process trace merging: clock alignment, one
+                 Perfetto-loadable timeline, produce->deliver flow
+                 stitching (OBSERVABILITY.md)
 """

@@ -65,6 +65,12 @@ last_flight_path: Optional[str] = None
 #: turn the tracer into a disk-filling loop
 FLIGHT_MAX_DUMPS = 8
 
+#: cross-process flow sampling (ISSUE 20): hot paths emit ``flow_*``
+#: instants keyed by (topic, partition, offset) for offsets where
+#: ``offset % flow_sample_every == 0`` (0 disables); obs/collect.py
+#: stitches the produce->ack->fetch->deliver chain across processes
+flow_sample_every = 64
+
 _lock = threading.Lock()
 _enable_count = 0            # enable()/disable() refcount (N clients)
 _generation = 0              # bumped per enable cycle; stale rings die
@@ -225,6 +231,14 @@ def _collect() -> list[dict]:
             out.append(e)
     out.sort(key=lambda e: e.get("ts", 0))
     return out
+
+
+def collect_events() -> list[dict]:
+    """Public snapshot of every ring as Chrome trace-event dicts —
+    the cross-process collection payload (obs/collect.py): workers,
+    relays and the rig supervisor ship THIS inline over their control
+    channels instead of a file path."""
+    return _collect()
 
 
 def dump(path: str) -> int:

@@ -118,6 +118,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..analysis import lockdep as _lockdep
 from ..analysis.locks import new_cond, new_lock
@@ -1431,6 +1432,8 @@ class AsyncOffloadEngine:
                 self.stage_submit_wait.add((t_launch - j.t_submit) * 1e6)
         rec.t0 = t_launch
         tr0 = _trace.now() if _trace.enabled else 0
+        if _metrics.enabled:
+            _metrics.counter("engine.launches").inc()
         self.compress_stats["launches"] += 1
         self.compress_stats["blocks"] += len(blocks)
         self.compress_stats["bytes_in"] += sum(len(b) for b in blocks)
@@ -1615,6 +1618,8 @@ class AsyncOffloadEngine:
                 self.stage_submit_wait.add((t_launch - j.t_submit) * 1e6)
         rec.t0 = t_launch
         tr0 = _trace.now() if _trace.enabled else 0
+        if _metrics.enabled:
+            _metrics.counter("engine.launches").inc()
         self.stats["launches"] += 1
         if mixed:
             self.stats["fused_launches"] += 1

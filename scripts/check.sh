@@ -10,6 +10,10 @@
 # the whole suite under the instrumented locks / lockset detector.
 cd "$(dirname "$0")/.."
 set -o pipefail
+# SLO trend gate (ISSUE 20): latest BENCH_TREND.jsonl row per leg vs
+# that leg's anchor row — soft-warns with no ledger/anchor, hard-fails
+# naming the regressed metric otherwise
+timeout -k 10 60 python scripts/trendgate.py || exit $?
 # 540s: the stress + races passes each grew a multi-process fleet leg
 # (ISSUE 11) on top of the external SIGKILL storm
 timeout -k 10 540 env JAX_PLATFORMS=cpu \

@@ -4,7 +4,8 @@
 # pre-commit gate: `python bench.py --smoke` (<60 s, one bit-exactness
 # pass over every engine leg).
 cd "$(dirname "$0")/.."
-# concurrency + invariant gate first: lint + lockdep stress (check.sh
-# exits nonzero on any finding, which fails the tier here)
+# concurrency + invariant gate first: SLO trend gate + lint + lockdep
+# stress (check.sh exits nonzero on any finding — including a
+# BENCH_TREND.jsonl regression past the anchor — failing the tier here)
 scripts/check.sh || exit $?
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -13,10 +13,20 @@ Perfetto:
 Used by humans (``python scripts/traceview.py dump.json``) and by the
 ``bench.py --smoke`` trace leg, which loads :func:`summarize` to assert
 a traced e2e run decomposes into the expected pipeline stages.
+
+Cross-process traces (ISSUE 20): merged fleet dumps carry one
+``process_name`` metadata record per OS process, and the summary's
+``by_process`` table attributes span latency per process the way
+``by_device`` attributes launches per chip.  ``--merge a.json b.json
+[--out merged.json]`` concatenates several single-process dumps into
+one timeline (labelling each file's events by basename when the dump
+carries no process metadata) and summarizes the union — the offline
+path when the fleet driver's live collection wasn't running.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 
 
@@ -50,8 +60,15 @@ def summarize(events: list[dict], top: int = 10) -> dict:
     latency is attributable per chip (ISSUE 6)."""
     by_name: dict[tuple, list[float]] = {}
     by_dev: dict[tuple, list[float]] = {}
+    by_proc: dict[tuple, list[float]] = {}
+    proc_names: dict = {}
     spans: list[dict] = []
     instants: dict[str, int] = {}
+    for e in events:
+        ph = e.get("ph")
+        if ph == "M" and e.get("name") == "process_name":
+            proc_names[e.get("pid")] = (e.get("args") or {}).get(
+                "name", str(e.get("pid")))
     for e in events:
         ph = e.get("ph")
         if ph == "X":
@@ -62,6 +79,11 @@ def summarize(events: list[dict], top: int = 10) -> dict:
             if "device" in args:
                 by_dev.setdefault((e["name"], args["device"]),
                                   []).append(dur)
+            # per-process attribution only once processes are labelled
+            # (single-process dumps keep their summary unchanged)
+            if proc_names:
+                proc = proc_names.get(e.get("pid"), str(e.get("pid")))
+                by_proc.setdefault((e["name"], proc), []).append(dur)
             spans.append(e)
         elif ph == "i":
             instants[e["name"]] = instants.get(e["name"], 0) + 1
@@ -94,8 +116,43 @@ def summarize(events: list[dict], top: int = 10) -> dict:
             "max_us": round(durs[-1], 1),
             "total_us": round(sum(durs), 1),
         })
+    by_process = []
+    for (name, proc), durs in sorted(by_proc.items()):
+        durs.sort()
+        by_process.append({
+            "name": name, "process": proc, "cnt": len(durs),
+            "p50_us": round(_pct(durs, 50), 1),
+            "max_us": round(durs[-1], 1),
+            "total_us": round(sum(durs), 1),
+        })
     return {"stages": stages, "widest": widest, "instants": instants,
-            "by_device": by_device}
+            "by_device": by_device, "by_process": by_process}
+
+
+def merge_files(paths: list[str]) -> list[dict]:
+    """Concatenate several trace dumps into one event list.  Files that
+    already carry ``process_name`` metadata keep their labels; a bare
+    single-process dump gets one synthesized from its basename (pid
+    collisions across bare files are disambiguated by index so the
+    per-process attribution stays honest)."""
+    merged: list[dict] = []
+    for i, path in enumerate(paths):
+        events = load_events(path)
+        labelled = any(e.get("ph") == "M"
+                       and e.get("name") == "process_name"
+                       for e in events)
+        if not labelled:
+            pid = next((e.get("pid") for e in events
+                        if e.get("pid") is not None), i)
+            label = os.path.splitext(os.path.basename(path))[0]
+            events = [dict(e, pid=f"{pid}.{i}") for e in events]
+            merged.append({"ph": "M", "name": "process_name",
+                           "pid": f"{pid}.{i}", "tid": 0, "ts": 0,
+                           "args": {"name": label}})
+        merged.extend(events)
+    merged.sort(key=lambda e: (e.get("ph") != "M",
+                               float(e.get("ts", 0.0))))
+    return merged
 
 
 def render(summary: dict) -> str:
@@ -123,6 +180,16 @@ def render(summary: dict) -> str:
             out.append(f"{d['name']:<22}{d['device']:>7}{d['cnt']:>6}"
                        f"{d['p50_us']:>10}{d['max_us']:>10}"
                        f"{d['total_us']:>12}")
+    if summary.get("by_process"):
+        out.append("")
+        out.append("per-process attribution (merged cross-process "
+                   "trace)")
+        out.append(f"{'stage':<22}{'process':<18}{'cnt':>6}{'p50us':>10}"
+                   f"{'maxus':>10}{'totalus':>12}")
+        for p in summary["by_process"]:
+            out.append(f"{p['name']:<22}{p['process']:<18}{p['cnt']:>6}"
+                       f"{p['p50_us']:>10}{p['max_us']:>10}"
+                       f"{p['total_us']:>12}")
     if summary["instants"]:
         out.append("")
         out.append("instant events: " + ", ".join(
@@ -131,11 +198,40 @@ def render(summary: dict) -> str:
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) != 2 or argv[1] in ("-h", "--help"):
+    usage = ("usage: traceview.py <trace.json>\n"
+             "       traceview.py --merge <trace.json>... "
+             "[--out merged.json]")
+    args = argv[1:]
+    if not args or args[0] in ("-h", "--help"):
         print(__doc__.strip(), file=sys.stderr)
-        print("\nusage: traceview.py <trace.json>", file=sys.stderr)
+        print("\n" + usage, file=sys.stderr)
         return 2
-    print(render(summarize(load_events(argv[1]))))
+    if args[0] == "--merge":
+        out_path = None
+        files = args[1:]
+        if "--out" in files:
+            i = files.index("--out")
+            if i + 1 >= len(files):
+                print(usage, file=sys.stderr)
+                return 2
+            out_path = files[i + 1]
+            files = files[:i] + files[i + 2:]
+        if not files:
+            print(usage, file=sys.stderr)
+            return 2
+        events = merge_files(files)
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump({"traceEvents": events,
+                           "displayTimeUnit": "ms"}, f)
+            print(f"merged {len(files)} dumps -> {out_path} "
+                  f"({len(events)} events)", file=sys.stderr)
+        print(render(summarize(events)))
+        return 0
+    if len(args) != 1:
+        print(usage, file=sys.stderr)
+        return 2
+    print(render(summarize(load_events(args[0]))))
     return 0
 
 

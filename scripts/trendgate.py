@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""trendgate — the persistent SLO trend gate over BENCH_TREND.jsonl.
+
+Every ``bench.py --smoke/--fleet/--chaos/--partitions`` run appends one
+ledger row (git rev, leg, direction-tagged headline metrics — see
+OBSERVABILITY.md for the row format).  This gate compares each leg's
+LATEST row against that leg's latest **anchor** row (``bench.py ...
+--anchor`` marks one) and fails — naming the metric, both values and
+the relative delta — when a metric regressed past its tolerance:
+
+  * ``dir: lower``  (latencies, overheads): current > anchor * (1+tol)
+  * ``dir: higher`` (rates, reductions):    current < anchor * (1-tol)
+
+Tolerance is per-metric (``tol`` in the row) with a deliberately
+generous default — the ledger spans different hosts and loaded CI
+machines, so the gate only catches real cliffs, not noise.
+
+Exit codes: 0 = pass (or soft-warn: no ledger / no anchor / unknown
+schema rows only — a fresh clone must not fail tier-1); 1 = at least
+one metric regressed.  Wired into scripts/check.sh.
+
+usage: trendgate.py [--ledger PATH] [--tolerance X] [--quiet]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+#: ledger row schema this gate understands (bench.py TREND_SCHEMA)
+SCHEMA = 1
+#: default relative tolerance: 50% — cross-host CI noise on the
+#: latency legs is routinely 2x smaller than this, a real regression
+#: (an injected sleep, an O(n) slip) is routinely larger
+DEFAULT_TOL = 0.5
+
+
+def default_ledger() -> str:
+    return os.environ.get("BENCH_TREND_PATH") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_TREND.jsonl")
+
+
+def load_rows(path: str) -> list[dict]:
+    """Parse the ledger, skipping malformed lines and rows from a
+    schema this gate does not understand (forward-compat: a newer
+    bench must not brick an older checkout's gate)."""
+    rows = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return rows
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if (isinstance(row, dict) and row.get("schema") == SCHEMA
+                and isinstance(row.get("metrics"), dict)
+                and row.get("leg")):
+            rows.append(row)
+    return rows
+
+
+def compare(anchor: dict, current: dict,
+            default_tol: float = DEFAULT_TOL) -> list[dict]:
+    """Regressions of ``current`` vs ``anchor`` (same leg): one dict
+    per failed metric — name, direction, both values, relative delta,
+    tolerance.  Metrics missing from either row are skipped (legs gain
+    and lose headline metrics across PRs)."""
+    out = []
+    for name, am in anchor["metrics"].items():
+        cm = current["metrics"].get(name)
+        if cm is None:
+            continue
+        av, cv = float(am["v"]), float(cm["v"])
+        direction = am.get("dir", "lower")
+        tol = float(am.get("tol", cm.get("tol", default_tol)))
+        if av == 0:
+            continue
+        # signed relative change in the BAD direction: positive means
+        # "worse by this fraction"
+        worse = ((cv - av) / abs(av) if direction == "lower"
+                 else (av - cv) / abs(av))
+        if worse > tol:
+            out.append({"metric": name, "dir": direction,
+                        "anchor": av, "current": cv,
+                        "worse_pct": round(worse * 100.0, 1),
+                        "tol_pct": round(tol * 100.0, 1)})
+    return out
+
+
+def gate(rows: list[dict], default_tol: float = DEFAULT_TOL) -> dict:
+    """{"status": "pass"|"fail"|"no-anchor"|"empty", "legs": {leg:
+    {"anchor_rev", "current_rev", "regressions": [...]}}}."""
+    if not rows:
+        return {"status": "empty", "legs": {}}
+    by_leg: dict[str, list[dict]] = {}
+    for row in rows:
+        by_leg.setdefault(row["leg"], []).append(row)
+    legs = {}
+    any_anchor = False
+    failed = False
+    for leg, lrows in sorted(by_leg.items()):
+        current = lrows[-1]
+        anchors = [r for r in lrows if r.get("anchor")
+                   and r is not current]
+        if not anchors:
+            legs[leg] = {"anchor_rev": None,
+                         "current_rev": current.get("rev"),
+                         "regressions": []}
+            continue
+        any_anchor = True
+        anchor = anchors[-1]
+        regs = compare(anchor, current, default_tol)
+        failed = failed or bool(regs)
+        legs[leg] = {"anchor_rev": anchor.get("rev"),
+                     "current_rev": current.get("rev"),
+                     "regressions": regs}
+    if failed:
+        status = "fail"
+    elif any_anchor:
+        status = "pass"
+    else:
+        status = "no-anchor"
+    return {"status": status, "legs": legs}
+
+
+def main(argv: list[str]) -> int:
+    args = argv[1:]
+    if "-h" in args or "--help" in args:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    ledger = default_ledger()
+    tol = DEFAULT_TOL
+    quiet = "--quiet" in args
+    if "--ledger" in args:
+        ledger = args[args.index("--ledger") + 1]
+    if "--tolerance" in args:
+        tol = float(args[args.index("--tolerance") + 1])
+
+    if not os.path.exists(ledger):
+        print(f"trendgate: no ledger at {ledger} — nothing to gate "
+              "(run a bench.py SLO leg to start one)", file=sys.stderr)
+        return 0
+    verdict = gate(load_rows(ledger), tol)
+    if verdict["status"] == "empty":
+        print(f"trendgate: {ledger} has no schema-{SCHEMA} rows — "
+              "soft pass", file=sys.stderr)
+        return 0
+    if verdict["status"] == "no-anchor":
+        print("trendgate: no anchor row in any leg — soft pass "
+              "(mark one with `bench.py <leg> --anchor`)",
+              file=sys.stderr)
+        return 0
+    rc = 0
+    for leg, res in verdict["legs"].items():
+        if res["anchor_rev"] is None:
+            if not quiet:
+                print(f"trendgate: {leg}: no anchor — skipped")
+            continue
+        if not res["regressions"]:
+            if not quiet:
+                print(f"trendgate: {leg}: ok (anchor "
+                      f"{res['anchor_rev']} -> {res['current_rev']})")
+            continue
+        rc = 1
+        for r in res["regressions"]:
+            arrow = ">" if r["dir"] == "lower" else "<"
+            print(f"trendgate: FAIL {leg}.{r['metric']}: "
+                  f"{r['current']:g} {arrow} anchor {r['anchor']:g} "
+                  f"— worse by {r['worse_pct']}% "
+                  f"(tolerance {r['tol_pct']}%) "
+                  f"[anchor rev {res['anchor_rev']}, current rev "
+                  f"{res['current_rev']}]")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

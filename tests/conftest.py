@@ -139,6 +139,24 @@ def _no_leaked_engine_threads():
         f"still registered — a client with statistics.interval.ms "
         f"was not closed")
 
+    # ISSUE 20 extends the contract to the rest of the obs plane: the
+    # unified metrics registry is refcounted exactly like the tracer
+    # (the last disable() clears it), and every cross-process dump dir
+    # the collector made must have been released (they hold flight
+    # dumps and worker rings on disk — a leak accumulates temp dirs
+    # across the suite).
+    from librdkafka_tpu.obs import collect as _collect
+    from librdkafka_tpu.obs import metrics as _metrics
+    assert not _metrics.enabled and _metrics.registered_count() == 0, (
+        f"leaked metrics registry: enabled={_metrics.enabled}, "
+        f"{_metrics.registered_count()} instrument(s) registered — an "
+        f"enable() was never paired with disable()")
+    assert _collect.active_dump_dir_count() == 0, (
+        f"leaked collector dump dir(s): "
+        f"{_collect.active_dump_dir_count()} still registered — a "
+        f"FleetDriver with trace=True was not stopped (or "
+        f"release_dump_dir was skipped)")
+
     # ISSUE 6: no compiled shard_map step may outlive its test —
     # compiled steps pin per-device buffers (Q-matrix constants on
     # every chip), so a leak taxes all later tests.  Engine close()

@@ -334,6 +334,20 @@ def test_stats_schema_matches_statistics_md():
             set(row) ^ doc["codec_engine.devices[]"]
         assert isinstance(row["dev_launch_ms"], dict)
 
+    # ISSUE 20: the unified metrics-registry blob — ALWAYS present on
+    # both client types (empty instrument maps while disabled) and
+    # bidirectional against its STATISTICS.md section
+    for blob in (pb, cb):
+        obs = blob["obs"]
+        assert set(obs) == doc["obs"], set(obs) ^ doc["obs"]
+        assert obs["schema"] == 1
+        for m in ("counters", "gauges", "windows"):
+            assert isinstance(obs[m], dict)
+        if not obs["enabled"]:
+            assert not obs["counters"] and not obs["windows"], obs
+        for w in obs["windows"].values():
+            assert set(w) == WINDOW_KEYS, set(w) ^ WINDOW_KEYS
+
     # every `{}`-marked window renders the full rd_avg_t field set;
     # stage_latency.launch_dev is a {device id: window} split, its
     # VALUES are windows

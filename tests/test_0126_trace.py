@@ -339,7 +339,11 @@ def test_bench_json_artifact(tmp_path, monkeypatch):
                         ["bench.py", "--smoke", "--json", out])
     bench._emit({"metric": "unit", "value": 1})
     with open(out) as f:
-        assert json.load(f) == {"metric": "unit", "value": 1}
+        got = json.load(f)
+    # every artifact carries the obs-registry snapshot (ISSUE 20)
+    assert got["obs"]["schema"] == 1
+    del got["obs"]
+    assert got == {"metric": "unit", "value": 1}
     monkeypatch.setattr("sys.argv", ["bench.py", "--smoke"])
     bench._emit({"metric": "unit2"})    # no --json: print only
     with open(out) as f:

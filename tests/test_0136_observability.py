@@ -1,0 +1,700 @@
+"""ISSUE 20: the fleet-wide observability plane.
+
+Pins the three tentpole halves end to end:
+
+  * cross-process trace collection — real-subprocess clock alignment
+    (obs/collect.align_offset over a live stdin/stdout exchange),
+    ordered merge with per-process Perfetto metadata, sampled
+    produce->ack->fetch->deliver flow stitching through the real
+    client hot paths, and the fleet-scale acceptance run (>=3 OS
+    processes in ONE merged trace with >=1 flow link);
+  * the unified metrics registry (obs/metrics.py) — instruments,
+    refcounted clear, snapshot schema, and a real registration site
+    (engine.launches) observed through a live produce;
+  * the SLO trend gate — scripts/trendgate.py comparison semantics
+    plus the CLI contract: an injected regression must fail NAMING
+    the metric; a fresh clone (no ledger / no anchor) must soft-pass.
+
+Also covers the satellites: FleetDriver flight-dump sweep + inline
+payloads, traceview --merge / by_process, and the collector dump-dir
+leak registry the conftest fixture enforces.
+"""
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from librdkafka_tpu import Consumer, Producer
+from librdkafka_tpu.obs import collect, metrics, trace
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+TRACE_PY = os.path.join(ROOT, "librdkafka_tpu", "obs", "trace.py")
+
+WINDOW_KEYS = {"min", "max", "avg", "sum", "cnt", "stddev", "hdrsize",
+               "outofrange", "p50", "p75", "p90", "p95", "p99", "p99_99"}
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"tk_{name}_0136", os.path.join(ROOT, "scripts", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------- metrics registry --
+class TestMetricsRegistry:
+    def test_instruments_and_snapshot_schema(self):
+        metrics.enable()
+        try:
+            c = metrics.counter("t.count")
+            c.inc()
+            c.inc(4)
+            assert c.value == 5
+            assert metrics.counter("t.count") is c, \
+                "get-or-create must hand back the same instrument"
+            g = metrics.gauge("t.level")
+            g.set(2.5)
+            assert g.value == 2.5
+            w = metrics.window("t.lat_us")
+            for v in (100, 200, 300):
+                w.record(v)
+            snap = metrics.snapshot()
+            assert snap["schema"] == metrics.SCHEMA == 1
+            assert snap["enabled"] is True
+            assert snap["counters"]["t.count"] == 5
+            assert snap["gauges"]["t.level"] == 2.5
+            win = snap["windows"]["t.lat_us"]
+            assert set(win) == WINDOW_KEYS, set(win) ^ WINDOW_KEYS
+            assert win["cnt"] == 3 and win["min"] >= 100
+            assert metrics.registered_count() == 3
+        finally:
+            metrics.disable()
+        # the LAST disable clears the registry (conftest contract)
+        assert not metrics.enabled
+        assert metrics.registered_count() == 0
+        snap = metrics.snapshot()
+        assert snap["enabled"] is False and not snap["counters"]
+
+    def test_enable_is_refcounted(self):
+        metrics.enable()
+        metrics.enable()
+        try:
+            metrics.counter("rc.count").inc()
+            metrics.disable()          # one ref left: registry intact
+            assert metrics.enabled
+            assert metrics.counter("rc.count").value == 1
+        finally:
+            metrics.disable()
+        assert not metrics.enabled and metrics.registered_count() == 0
+
+    def test_disabled_guard_sites_register_nothing(self):
+        """The hot-site contract: one module-attribute check, and a
+        guarded site that never runs never registers."""
+        assert metrics.enabled is False
+        if metrics.enabled:            # the exact site idiom
+            metrics.counter("never").inc()
+        assert metrics.registered_count() == 0
+
+    def test_engine_registers_launch_counter_live(self):
+        """A real registration site observed end to end: device
+        launches during a traced produce increment engine.launches,
+        and the per-client stats blob carries the snapshot."""
+        metrics.enable()
+        p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                      "compression.backend": "tpu",
+                      "tpu.transport.min.mb.s": 0,
+                      "tpu.launch.min.batches": 2, "tpu.governor": False,
+                      "tpu.warmup": False, "compression.codec": "lz4",
+                      "linger.ms": 5})
+        try:
+            for i in range(64):
+                p.produce("mx", value=b"v%d" % i * 20, partition=i % 4)
+            assert p.flush(120.0) == 0
+            snap = metrics.snapshot()
+            assert snap["counters"].get("engine.launches", 0) >= 1, snap
+            blob = json.loads(p._rk.stats.emit_json())
+            assert blob["obs"]["enabled"] is True
+            assert blob["obs"]["counters"]["engine.launches"] >= 1
+        finally:
+            p.close()
+            metrics.disable()
+        assert metrics.registered_count() == 0
+
+
+# ------------------------------------------------- clock alignment --
+_CHILD_SRC = r"""
+import importlib.util, json, os, sys, time
+spec = importlib.util.spec_from_file_location("tk_child_trace", sys.argv[1])
+tr = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tr)
+tr.enable()
+for line in sys.stdin:
+    cmd = json.loads(line)
+    if "clock" in cmd:
+        print(json.dumps({"mono_ns": tr.now()}), flush=True)
+    elif "span" in cmd:
+        t0 = tr.now()
+        time.sleep(cmd["span"])
+        tr.complete("xp", "work", t0, {"who": cmd["who"]})
+        print(json.dumps({"ok": True}), flush=True)
+    elif "dump" in cmd:
+        print(json.dumps({"pid": os.getpid(),
+                          "events": tr.collect_events()}), flush=True)
+        break
+"""
+
+
+def _rpc(proc, obj):
+    proc.stdin.write(json.dumps(obj) + "\n")
+    proc.stdin.flush()
+    line = proc.stdout.readline()
+    assert line, "child died mid-exchange"
+    return json.loads(line)
+
+
+class TestClockAlignment:
+    def test_align_offset_math(self):
+        # peer clock 1000ns behind: peer read 5000 at collector
+        # midpoint 6000 -> offset +1000, err = half the 200ns rtt
+        off, err = collect.align_offset(5900, 5000, 6100)
+        assert off == 1000 and err == 100
+        # exact agreement -> zero offset
+        off, err = collect.align_offset(0, 500, 1000)
+        assert off == 0 and err == 500
+
+    def test_two_real_subprocesses_align_and_merge(self, tmp_path):
+        """ACCEPTANCE (clock half): two live child processes running
+        their own obs/trace.py rings, clock-sampled over real pipes;
+        the merge must label both processes, order events on one
+        timeline, and the measured offsets must agree with the
+        machine-wide CLOCK_MONOTONIC ground truth within the
+        exchange's own error bound."""
+        child = tmp_path / "child.py"
+        child.write_text(_CHILD_SRC)
+        procs = []
+        try:
+            for _ in range(2):
+                procs.append(subprocess.Popen(
+                    [sys.executable, str(child), TRACE_PY],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    text=True))
+            clocks = []
+            for p in procs:
+                best = None
+                for _ in range(3):          # keep the tightest round
+                    t_send = time.monotonic_ns()
+                    r = _rpc(p, {"clock": 1})
+                    t_recv = time.monotonic_ns()
+                    off, err = collect.align_offset(
+                        t_send, r["mono_ns"], t_recv)
+                    if best is None or err < best[1]:
+                        best = (off, err)
+                clocks.append(best)
+            # A's span completes before B's starts: wall-clock order
+            # the merged timeline must reproduce across processes
+            _rpc(procs[0], {"span": 0.02, "who": "a"})
+            _rpc(procs[1], {"span": 0.02, "who": "b"})
+            dumps = []
+            for i, p in enumerate(procs):
+                d = _rpc(p, {"dump": 1})
+                dumps.append(collect.ProcessDump(
+                    f"child-{i}", d["pid"], d["events"],
+                    offset_ns=clocks[i][0], err_ns=clocks[i][1]))
+            for p in procs:
+                assert p.wait(timeout=30) == 0
+
+            # same machine, CLOCK_MONOTONIC: the measured offset must
+            # be ~0, within the exchange's own half-RTT bound (+ slack
+            # for a descheduled child between its clock read and our
+            # recv stamp on a loaded host)
+            for off, err in clocks:
+                assert 0 <= err < 250_000_000, err
+                assert abs(off) <= err + 50_000_000, (off, err)
+
+            events = collect.merge(dumps)
+            meta = [e for e in events if e.get("ph") == "M"
+                    and e["name"] == "process_name"]
+            assert {m["args"]["name"] for m in meta} == \
+                {"child-0", "child-1"}
+            for m in meta:
+                assert "clock_err_us" in m["args"]
+            body = [e for e in events if e.get("ph") != "M"]
+            assert len({e["pid"] for e in body}) == 2
+            ts = [e["ts"] for e in body]
+            assert ts == sorted(ts), "merge must ts-sort the timeline"
+            spans = [e for e in body if e.get("ph") == "X"
+                     and e["name"] == "work"]
+            by_who = {e["args"]["who"]: e for e in spans}
+            assert set(by_who) == {"a", "b"}
+            assert by_who["a"]["ts"] + by_who["a"]["dur"] <= \
+                by_who["b"]["ts"] + 1, \
+                "aligned timeline must preserve cross-process order"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
+# --------------------------------------------------- flow stitching --
+class TestFlowStitching:
+    def _pt(self, stage, ts, pid, off=0):
+        return {"name": stage, "ph": "i", "cat": "flow", "pid": pid,
+                "tid": 0, "ts": ts,
+                "args": {"topic": "t", "partition": 0, "offset": off}}
+
+    def test_stitch_unit_links_stage_chain(self):
+        events = [self._pt("flow_produce", 10.0, 1),
+                  self._pt("flow_ack", 20.0, 1),
+                  self._pt("flow_fetch", 30.0, 2),
+                  self._pt("flow_deliver", 40.0, 2),
+                  # a lone point must NOT become a flow
+                  self._pt("flow_produce", 50.0, 1, off=64)]
+        out, links = collect.stitch_flows(events)
+        assert links == 3
+        flows = [e for e in out if e.get("ph") in ("s", "t", "f")]
+        assert [f["ph"] for f in flows] == ["s", "t", "t", "f"]
+        assert len({f["id"] for f in flows}) == 1
+        assert flows[-1]["bp"] == "e", "Chrome flow end needs bp:e"
+        assert [f["args"]["stage"] for f in flows] == \
+            list(collect.FLOW_STAGES)
+        # the consumer-side points keep their own pid: the arrow
+        # genuinely crosses processes
+        assert {f["pid"] for f in flows} == {1, 2}
+        assert collect.flow_link_count(out) == 3
+
+    def test_flow_points_through_real_client_paths(self):
+        """The real hot-path emitters: a produce+consume run with
+        flow_sample_every=1 must emit all four stages for the same
+        (topic, partition, offset) and stitch into one chain."""
+        old = trace.flow_sample_every
+        trace.flow_sample_every = 1
+        trace.enable()
+        c = None
+        p = Producer({"bootstrap.servers": "", "test.mock.num.brokers": 1,
+                      "linger.ms": 2})
+        try:
+            bs = p._rk.mock_cluster.bootstrap_servers()
+            for i in range(3):
+                p.produce("fl", value=b"v%d" % i, partition=0)
+            assert p.flush(60.0) == 0
+            c = Consumer({"bootstrap.servers": bs, "group.id": "g-flow",
+                          "auto.offset.reset": "earliest"})
+            c.subscribe(["fl"])
+            got = 0
+            deadline = time.monotonic() + 60
+            while got < 3 and time.monotonic() < deadline:
+                m = c.poll(0.2)
+                if m is not None and m.error is None:
+                    got += 1
+            assert got == 3, f"consumed {got}/3"
+            events = trace.collect_events()
+        finally:
+            if c is not None:
+                c.close()
+            p.close()
+            trace.disable()
+            trace.flow_sample_every = old
+        names = {e["name"] for e in events if e.get("ph") == "i"}
+        assert set(collect.FLOW_STAGES) <= names, \
+            set(collect.FLOW_STAGES) - names
+        stitched, links = collect.stitch_flows(events)
+        assert links >= 3, "offset 0 must stitch produce->deliver"
+        # at least one full 4-stage chain: an id carrying all stages
+        by_id = {}
+        for e in stitched:
+            if e.get("ph") in ("s", "t", "f"):
+                by_id.setdefault(e["id"], []).append(e["args"]["stage"])
+        assert any(set(v) == set(collect.FLOW_STAGES)
+                   for v in by_id.values()), by_id
+
+
+# ----------------------------------------------- collector registry --
+class TestCollectorDumpDirs:
+    def test_dump_dir_registry_and_release(self):
+        n0 = collect.active_dump_dir_count()
+        d = collect.make_dump_dir()
+        try:
+            assert os.path.isdir(d)
+            assert collect.active_dump_dir_count() == n0 + 1
+        finally:
+            collect.release_dump_dir(d)
+        assert collect.active_dump_dir_count() == n0
+        assert not os.path.exists(d)
+        # double release is harmless (driver.stop() is idempotent)
+        collect.release_dump_dir(d)
+        assert collect.active_dump_dir_count() == n0
+
+    def test_write_is_perfetto_loadable(self, tmp_path):
+        path = str(tmp_path / "m.json")
+        events = [{"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+                   "args": {"name": "x"}},
+                  {"name": "s", "ph": "X", "pid": 1, "tid": 0,
+                   "ts": 1.0, "dur": 2.0}]
+        assert collect.write(path, events) == 1   # non-metadata count
+        with open(path) as f:
+            data = json.load(f)
+        assert isinstance(data["traceEvents"], list)
+        assert data["displayTimeUnit"] == "ms"
+
+
+# ------------------------------------------------ fleet observability --
+class TestFlightDumpSweep:
+    def test_driver_flight_dumps_inline_and_sweep(self, tmp_path):
+        """The chaos-evidence satellite, unit-scale: streamed flight
+        paths come back with inline payloads, and a dump whose
+        announcement line died with its worker is still found by the
+        trace-dir sweep."""
+        from librdkafka_tpu.fleet.driver import FleetDriver
+        from librdkafka_tpu.fleet.traffic import TrafficPlan
+
+        plan = TrafficPlan(7, producers=1, groups=1, group_size=1,
+                           topics=["t"], partitions=1)
+        d = FleetDriver("127.0.0.1:9", plan, trace=True)
+        try:
+            assert d.trace_dir and os.path.isdir(d.trace_dir)
+            streamed = os.path.join(d.trace_dir,
+                                    "tk_flight_111_0_fatal.json")
+            with open(streamed, "w") as f:
+                json.dump({"traceEvents": [
+                    {"name": "flight_record", "ph": "i", "pid": 111,
+                     "tid": 0, "ts": 1.0,
+                     "args": {"reason": "fatal"}}]}, f)
+            d.flight_paths.append({"worker": "p00", "path": streamed})
+            recs = d.flight_dumps()
+            assert len(recs) == 1, recs      # sweep must not duplicate
+            assert recs[0]["worker"] == "p00" and recs[0]["exists"]
+            assert recs[0]["events"] == 1
+            assert recs[0]["payload"]["traceEvents"][0]["args"] == \
+                {"reason": "fatal"}
+            # the orphan: written but never announced
+            orphan = os.path.join(d.trace_dir,
+                                  "tk_flight_222_0_kill.json")
+            with open(orphan, "w") as f:
+                json.dump({"traceEvents": []}, f)
+            recs = d.flight_dumps()
+            assert len(recs) == 2
+            swept = [r for r in recs if r["path"] == orphan]
+            assert swept and swept[0]["worker"] is None
+            assert swept[0]["exists"] and swept[0]["events"] == 0
+        finally:
+            d.stop()
+        assert collect.active_dump_dir_count() == 0
+
+
+@pytest.mark.fleet
+class TestFleetMergedTrace:
+    def test_fleet_mini_one_perfetto_trace_many_processes(self, tmp_path):
+        """ACCEPTANCE: a fleet_mini-scale run with trace_path must
+        produce ONE Perfetto-loadable merged trace containing >=3
+        distinct OS processes with aligned clocks and >=1 stitched
+        produce->deliver flow link."""
+        from librdkafka_tpu.fleet.scenarios import fleet_mini
+        path = str(tmp_path / "fleet_trace.json")
+        r = fleet_mini(trace_path=path)
+        assert r["ok"], r
+        tr = r["trace"]
+        assert tr["path"] == path
+        assert tr["processes"] >= 3, tr
+        assert len(tr["pids"]) >= 3, tr
+        assert tr["flow_links"] >= 1, tr
+        assert isinstance(r["flight_dumps"], list)   # clean run: evidence
+        with open(path) as f:                        # channel still wired
+            data = json.load(f)
+        events = data["traceEvents"]
+        meta = {e["args"]["name"]: e["pid"] for e in events
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert "fleet-driver" in meta, meta
+        assert any(n.startswith("worker-") for n in meta), meta
+        assert "supervisor" in meta, meta
+        assert len(set(meta.values())) >= 3, meta
+        for e in events:
+            if e.get("ph") == "M" and e["name"] == "process_name":
+                assert "clock_err_us" in e["args"]
+        assert collect.flow_link_count(events) == tr["flow_links"]
+        # the flow chain crosses processes: producer- and consumer-side
+        # points carry different pids under one flow id
+        by_id = {}
+        for e in events:
+            if e.get("ph") in ("s", "t", "f") and e.get("cat") == "flow":
+                by_id.setdefault(e["id"], set()).add(e["pid"])
+        assert any(len(pids) >= 2 for pids in by_id.values()), by_id
+
+
+# ------------------------------------------------------ rig traces --
+class TestRigTraces:
+    def test_cluster_handle_collects_supervisor_and_relay_rings(self):
+        """The rig half of the collection protocol: ctl trace verbs
+        reach the supervisor AND its per-broker relays; collect_traces
+        returns ProcessDumps with composed clock offsets and real
+        connection spans from the relay."""
+        from librdkafka_tpu.mock.external import ClusterHandle
+        h = ClusterHandle(brokers=1, topics={"rt": 1})
+        try:
+            h.trace_enable()
+            bs = h.bootstrap_servers()
+            host, port = bs.split(",")[0].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=10)
+            s.close()
+            time.sleep(0.3)              # let the relay log the close
+            dumps = h.collect_traces()
+        finally:
+            h.stop()
+        names = {d.name for d in dumps}
+        assert "supervisor" in names, names
+        assert any(n.startswith("relay-") for n in names), names
+        assert len({d.pid for d in dumps}) == len(dumps)
+        for d in dumps:
+            assert d.err_ns >= 0
+        sup = next(d for d in dumps if d.name == "supervisor")
+        assert any(e.get("name") == "ctl_cmd" for e in sup.events), \
+            "supervisor must span its control commands"
+        relay = next(d for d in dumps if d.name.startswith("relay-"))
+        assert any(e.get("name") in ("conn", "conn_setup")
+                   for e in relay.events), \
+            "relay must span the connection we made"
+        events = collect.merge(dumps)
+        assert len([e for e in events if e.get("ph") == "M"
+                    and e["name"] == "process_name"]) == len(dumps)
+
+
+# ------------------------------------------------------- traceview --
+class TestTraceviewMerge:
+    def _dump(self, tmp_path, name, pid, spans):
+        path = str(tmp_path / f"{name}.json")
+        evs = [{"name": n, "ph": "X", "pid": pid, "tid": 0,
+                "ts": ts, "dur": dur, "cat": "t"}
+               for n, ts, dur in spans]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+        return path
+
+    def test_merge_files_labels_bare_dumps(self, tmp_path):
+        tv = _load_script("traceview")
+        a = self._dump(tmp_path, "prod", 5, [("enqueue", 1.0, 10.0)])
+        b = self._dump(tmp_path, "cons", 5, [("deliver", 2.0, 20.0)])
+        merged = tv.merge_files([a, b])
+        meta = [e for e in merged if e.get("ph") == "M"]
+        assert {m["args"]["name"] for m in meta} == {"prod", "cons"}
+        # same original pid in both files: the merge must keep the two
+        # processes apart
+        assert len({m["pid"] for m in meta}) == 2
+        summary = tv.summarize(merged)
+        procs = {(p["name"], p["process"]) for p in summary["by_process"]}
+        assert procs == {("enqueue", "prod"), ("deliver", "cons")}
+
+    def test_single_process_summary_unchanged(self, tmp_path):
+        tv = _load_script("traceview")
+        a = self._dump(tmp_path, "solo", 1, [("enqueue", 1.0, 10.0)])
+        summary = tv.summarize(tv.load_events(a))
+        assert summary["by_process"] == []   # no labels -> no table
+        assert summary["stages"][0]["name"] == "enqueue"
+
+    def test_merged_trace_from_fleet_summarizes(self, tmp_path):
+        """--merge output of already-labelled dumps keeps labels."""
+        tv = _load_script("traceview")
+        path = str(tmp_path / "labelled.json")
+        evs = [{"name": "process_name", "ph": "M", "pid": 9, "tid": 0,
+                "args": {"name": "w0"}},
+               {"name": "ack", "ph": "X", "pid": 9, "tid": 0,
+                "ts": 1.0, "dur": 5.0, "cat": "produce"}]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+        merged = tv.merge_files([path])
+        summary = tv.summarize(merged)
+        assert summary["by_process"] == [
+            {"name": "ack", "process": "w0", "cnt": 1, "p50_us": 5.0,
+             "max_us": 5.0, "total_us": 5.0}]
+        out = tv.render(summary)
+        assert "per-process attribution" in out and "w0" in out
+
+
+# -------------------------------------------------------- trendgate --
+def _row(leg, rev, anchor=False, **mx):
+    return {"schema": 1, "rev": rev, "utc": "2026-08-07T00:00:00Z",
+            "leg": leg, "anchor": anchor, "ok": True,
+            "metrics": {k: dict(v) for k, v in mx.items()}}
+
+
+class TestTrendgate:
+    def test_compare_direction_aware(self):
+        tg = _load_script("trendgate")
+        anchor = _row("smoke", "aaa", True,
+                      produce_ns_per_msg={"v": 1000.0, "dir": "lower"},
+                      msgs_s={"v": 100.0, "dir": "higher"})
+        # latency doubled -> regression; rate unchanged -> fine
+        cur = _row("smoke", "bbb",
+                   produce_ns_per_msg={"v": 2000.0, "dir": "lower"},
+                   msgs_s={"v": 100.0, "dir": "higher"})
+        regs = tg.compare(anchor, cur)
+        assert [r["metric"] for r in regs] == ["produce_ns_per_msg"]
+        assert regs[0]["worse_pct"] == 100.0
+        # rate halved -> higher-dir regression
+        cur = _row("smoke", "ccc",
+                   produce_ns_per_msg={"v": 1000.0, "dir": "lower"},
+                   msgs_s={"v": 40.0, "dir": "higher"})
+        regs = tg.compare(anchor, cur)
+        assert [r["metric"] for r in regs] == ["msgs_s"]
+        # within the default 50% tolerance -> pass
+        cur = _row("smoke", "ddd",
+                   produce_ns_per_msg={"v": 1400.0, "dir": "lower"},
+                   msgs_s={"v": 60.0, "dir": "higher"})
+        assert tg.compare(anchor, cur) == []
+        # an IMPROVEMENT must never trip the gate
+        cur = _row("smoke", "eee",
+                   produce_ns_per_msg={"v": 100.0, "dir": "lower"},
+                   msgs_s={"v": 900.0, "dir": "higher"})
+        assert tg.compare(anchor, cur) == []
+
+    def test_compare_per_metric_tolerance_and_skips(self):
+        tg = _load_script("trendgate")
+        anchor = _row("chaos", "aaa", True,
+                      tight={"v": 100.0, "dir": "lower", "tol": 0.1},
+                      zeroed={"v": 0.0, "dir": "lower"},
+                      gone={"v": 5.0, "dir": "lower"})
+        cur = _row("chaos", "bbb",
+                   tight={"v": 120.0, "dir": "lower"},
+                   zeroed={"v": 50.0, "dir": "lower"})
+        regs = tg.compare(anchor, cur)
+        # 20% > the row's own 10% tol; zero anchors and metrics the
+        # current row lost are skipped, not crashed on
+        assert [r["metric"] for r in regs] == ["tight"]
+        assert regs[0]["tol_pct"] == 10.0
+
+    def test_gate_statuses(self):
+        tg = _load_script("trendgate")
+        assert tg.gate([])["status"] == "empty"
+        rows = [_row("smoke", "aaa",
+                     m={"v": 1.0, "dir": "lower"})]
+        assert tg.gate(rows)["status"] == "no-anchor"
+        rows = [_row("smoke", "aaa", True, m={"v": 1.0, "dir": "lower"}),
+                _row("smoke", "bbb", m={"v": 1.1, "dir": "lower"})]
+        v = tg.gate(rows)
+        assert v["status"] == "pass"
+        assert v["legs"]["smoke"]["anchor_rev"] == "aaa"
+        rows.append(_row("smoke", "ccc", m={"v": 9.0, "dir": "lower"}))
+        assert tg.gate(rows)["status"] == "fail"
+        # an anchor row that IS the latest row gates against the
+        # previous anchor, not itself
+        rows.append(_row("smoke", "ddd", True,
+                         m={"v": 9.0, "dir": "lower"}))
+        v = tg.gate(rows)
+        assert v["status"] == "fail"
+        assert v["legs"]["smoke"]["anchor_rev"] == "aaa"
+
+    def test_load_rows_skips_junk_and_foreign_schema(self, tmp_path):
+        tg = _load_script("trendgate")
+        path = str(tmp_path / "ledger.jsonl")
+        good = _row("smoke", "aaa", True, m={"v": 1.0, "dir": "lower"})
+        with open(path, "w") as f:
+            f.write("not json\n\n")
+            f.write(json.dumps({"schema": 99, "leg": "smoke",
+                                "metrics": {}}) + "\n")
+            f.write(json.dumps(good) + "\n")
+        rows = tg.load_rows(path)
+        assert len(rows) == 1 and rows[0]["rev"] == "aaa"
+
+    def _cli(self, *args, env=None):
+        e = dict(os.environ)
+        e.pop("BENCH_TREND_PATH", None)
+        if env:
+            e.update(env)
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts",
+                                          "trendgate.py"), *args],
+            capture_output=True, text=True, timeout=60, env=e)
+
+    def test_cli_injected_regression_names_the_metric(self, tmp_path):
+        """ACCEPTANCE: an injected slowdown must FAIL the gate naming
+        which metric regressed and by how much."""
+        path = str(tmp_path / "ledger.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(_row(
+                "smoke", "abc1234", True,
+                produce_ns_per_msg={"v": 1000.0, "dir": "lower"})) + "\n")
+            f.write(json.dumps(_row(
+                "smoke", "def5678",
+                produce_ns_per_msg={"v": 2100.0, "dir": "lower"})) + "\n")
+        r = self._cli("--ledger", path)
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        assert "FAIL smoke.produce_ns_per_msg" in r.stdout
+        assert "2100" in r.stdout and "anchor 1000" in r.stdout
+        assert "worse by 110.0%" in r.stdout
+        assert "tolerance 50.0%" in r.stdout
+        assert "abc1234" in r.stdout and "def5678" in r.stdout
+
+    def test_cli_soft_passes(self, tmp_path):
+        # no ledger at all: a fresh clone must not fail tier-1
+        r = self._cli("--ledger", str(tmp_path / "absent.jsonl"))
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert "no ledger" in r.stderr
+        # rows but no anchor
+        path = str(tmp_path / "ledger.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(_row(
+                "smoke", "aaa",
+                produce_ns_per_msg={"v": 1.0, "dir": "lower"})) + "\n")
+        r = self._cli("--ledger", path)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert "no anchor" in r.stderr
+
+    def test_cli_respects_env_ledger_default(self, tmp_path):
+        path = str(tmp_path / "env.jsonl")
+        with open(path, "w") as f:
+            f.write(json.dumps(_row(
+                "smoke", "aaa", True, m={"v": 1.0, "dir": "lower"})) + "\n")
+            f.write(json.dumps(_row(
+                "smoke", "bbb", m={"v": 5.0, "dir": "lower"})) + "\n")
+        r = self._cli(env={"BENCH_TREND_PATH": path})
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        assert "FAIL smoke.m" in r.stdout
+
+
+class TestBenchTrendAppend:
+    def _bench(self):
+        spec = importlib.util.spec_from_file_location(
+            "tk_bench_0136", os.path.join(ROOT, "bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_trend_metrics_pick_per_leg(self):
+        b = self._bench()
+        mx = b._trend_metrics("smoke", {
+            "elapsed_s": 12.5,
+            "trace_overhead": {"produce_ns_per_msg": 1500.0,
+                               "combined_overhead_pct": 0.4}})
+        assert mx["produce_ns_per_msg"] == {"v": 1500.0, "dir": "lower"}
+        assert mx["obs_overhead_pct"] == {"v": 0.4, "dir": "lower"}
+        assert mx["elapsed_s"]["dir"] == "lower"
+        mx = b._trend_metrics("fleet_smoke", {
+            "fleet_msgs_s": 800.0, "client_p99_ms_max": 40.0,
+            "converged_s": 3.0})
+        assert mx["fleet_msgs_s"] == {"v": 800.0, "dir": "higher"}
+        assert mx["client_p99_ms_max"]["dir"] == "lower"
+        # non-numeric / missing values are dropped, not fabricated
+        assert "recovery_p99_ms" not in mx
+
+    def test_trend_append_writes_schema_row(self, tmp_path, monkeypatch):
+        b = self._bench()
+        path = str(tmp_path / "trend.jsonl")
+        monkeypatch.setenv("BENCH_TREND_PATH", path)
+        monkeypatch.setattr(sys, "argv",
+                            ["bench.py", "--smoke", "--anchor"])
+        b._trend_append({
+            "elapsed_s": 9.0,
+            "trace_overhead": {"produce_ns_per_msg": 1234.0}})
+        tg = _load_script("trendgate")
+        rows = tg.load_rows(path)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["leg"] == "smoke" and row["anchor"] is True
+        assert row["metrics"]["produce_ns_per_msg"]["v"] == 1234.0
+        assert row["rev"] and row["utc"]
